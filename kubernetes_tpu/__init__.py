@@ -1,0 +1,25 @@
+"""kubernetes_tpu — a TPU-native batch scheduling framework.
+
+A from-scratch rebuild of the Kubernetes kube-scheduler's capabilities
+(reference: kubernetes/kubernetes, pkg/scheduler) where the per-pod
+Filter→Score→Normalize cycle (reference: pkg/scheduler/schedule_one.go) is
+lifted into a single batched JAX/XLA program over HBM-resident cluster-state
+matrices, and the host keeps the reference's semantics for queueing, backoff,
+gang quorum, preemption, assume/bind and async API dispatch.
+
+Quantities (CPU milli-units, memory bytes) are carried as int64 end-to-end:
+the reference's fit checks (pkg/scheduler/framework/plugins/noderesources/
+fit.go:649-738) and score arithmetic (least_allocated.go:30-60) are exact
+int64 math, and decision parity with the Go plugins is a hard requirement
+(see BASELINE.json north_star). x64 must therefore be enabled before any
+JAX array is created; importing this package does it.
+"""
+
+import os
+
+if os.environ.get("KTPU_DISABLE_X64", "0") != "1":
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

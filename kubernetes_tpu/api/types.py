@@ -1,0 +1,402 @@
+"""Core API object model — the subset of k8s API types the scheduler reads.
+
+Mirrors the fields consumed by pkg/scheduler in the reference
+(staging/src/k8s.io/api/core/v1/types.go); everything irrelevant to
+scheduling decisions is omitted. These are plain Python dataclasses: the
+"wire format" of this framework is the in-memory object graph fed by the
+cluster-state ingestion layer (backend/eventhandlers), exactly as the
+reference's scheduler only ever sees decoded informer objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# metadata
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    # creation ordering for queue-sort tie-breaks (reference: queuesort
+    # priority_sort.go falls back to QueuedPodInfo timestamp; we also keep
+    # object creation order for deterministic tests).
+    creation_index: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# taints & tolerations (reference: staging api core/v1/toleration.go, taint.go)
+
+
+class TaintEffect(str, enum.Enum):
+    NO_SCHEDULE = "NoSchedule"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+    NO_EXECUTE = "NoExecute"
+
+
+class TolerationOperator(str, enum.Enum):
+    EXISTS = "Exists"
+    EQUAL = "Equal"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TaintEffect.NO_SCHEDULE.value
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = TolerationOperator.EQUAL.value
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference: staging/src/k8s.io/api/core/v1/toleration.go:29-56.
+
+        An empty key with Exists tolerates everything; operator defaults to
+        Equal; empty effect matches all effects.
+        """
+        op = self.operator or TolerationOperator.EQUAL.value
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if op == TolerationOperator.EXISTS.value:
+            return True
+        if op == TolerationOperator.EQUAL.value:
+            # empty key with Equal: key must match (empty key only valid
+            # with Exists), mirror Go behavior of comparing values.
+            return self.value == taint.value
+        return False
+
+
+# ---------------------------------------------------------------------------
+# label selectors (reference: apimachinery pkg/apis/meta/v1/types.go:1214,
+# helpers in pkg/apis/meta/v1/helpers.go LabelSelectorAsSelector)
+
+
+class SelectorOperator(str, enum.Enum):
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+    GT = "Gt"  # node-selector only
+    LT = "Lt"  # node-selector only
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    key: str
+    operator: str
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """match_labels is ANDed with match_expressions; empty selector matches
+    everything, None (absent) matches nothing — callers must distinguish."""
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[LabelSelectorRequirement, ...] = ()
+
+    @staticmethod
+    def of(match_labels: Optional[dict[str, str]] = None,
+           match_expressions: tuple[LabelSelectorRequirement, ...] = ()) -> "LabelSelector":
+        return LabelSelector(
+            match_labels=tuple(sorted((match_labels or {}).items())),
+            match_expressions=tuple(match_expressions),
+        )
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            if not _requirement_matches(req, labels):
+                return False
+        return True
+
+
+def _requirement_matches(req: LabelSelectorRequirement, labels: dict[str, str]) -> bool:
+    op = req.operator
+    if op == SelectorOperator.IN.value:
+        return req.key in labels and labels[req.key] in req.values
+    if op == SelectorOperator.NOT_IN.value:
+        # NotIn requires the key to exist per labels.Requirement semantics
+        # used by LabelSelectorAsSelector (NotIn -> sel.NotIn which matches
+        # when key absent as well).  Reference: apimachinery labels/selector.go
+        # Requirement.Matches: NotIn returns true when key is absent.
+        return not (req.key in labels and labels[req.key] in req.values)
+    if op == SelectorOperator.EXISTS.value:
+        return req.key in labels
+    if op == SelectorOperator.DOES_NOT_EXIST.value:
+        return req.key not in labels
+    if op in (SelectorOperator.GT.value, SelectorOperator.LT.value):
+        if req.key not in labels or len(req.values) != 1:
+            return False
+        try:
+            lhs = int(labels[req.key])
+            rhs = int(req.values[0])
+        except ValueError:
+            return False
+        return lhs > rhs if op == SelectorOperator.GT.value else lhs < rhs
+    return False
+
+
+# ---------------------------------------------------------------------------
+# node affinity (reference: core/v1 NodeSelector / NodeAffinity; matching
+# helpers in staging/src/k8s.io/component-helpers/scheduling/corev1/nodeaffinity)
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    # terms are ORed; expressions within a term are ANDed
+    match_expressions: tuple[LabelSelectorRequirement, ...] = ()
+    match_fields: tuple[LabelSelectorRequirement, ...] = ()  # metadata.name only
+
+
+@dataclass(frozen=True)
+class NodeSelector:
+    terms: tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# pod (anti-)affinity (reference: core/v1 PodAffinity/PodAntiAffinity)
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: tuple[str, ...] = ()  # empty => pod's own namespace
+    namespace_selector: Optional[LabelSelector] = None  # None => no ns selection
+    match_label_keys: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAntiAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# ---------------------------------------------------------------------------
+# topology spread (reference: core/v1 TopologySpreadConstraint)
+
+
+class UnsatisfiableConstraintAction(str, enum.Enum):
+    DO_NOT_SCHEDULE = "DoNotSchedule"
+    SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+    match_label_keys: tuple[str, ...] = ()
+    # NodeAffinityPolicy / NodeTaintsPolicy: Honor (default) or Ignore
+    node_affinity_policy: str = "Honor"
+    node_taints_policy: str = "Ignore"
+
+
+# ---------------------------------------------------------------------------
+# containers / ports / resources
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    # resource requests in canonical int64 units (cpu: milli, memory: bytes,
+    # anything else: unit count). Parse human strings via api.resources.parse.
+    requests: dict[str, int] = field(default_factory=dict)
+    limits: dict[str, int] = field(default_factory=dict)
+    ports: tuple[ContainerPort, ...] = ()
+    image: str = ""
+
+
+@dataclass(frozen=True)
+class PodSchedulingGate:
+    name: str
+
+
+# ---------------------------------------------------------------------------
+# pod
+
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"  # reference: v1.DefaultSchedulerName
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    priority: int = 0
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_spread_constraints: list[TopologySpreadConstraint] = field(default_factory=list)
+    scheduling_gates: list[PodSchedulingGate] = field(default_factory=list)
+    overhead: dict[str, int] = field(default_factory=dict)
+    host_network: bool = False
+    # gang scheduling: name of the Workload/pod-group this pod belongs to
+    # (reference: scheduling/v1alpha1.Workload via pod labels; we model it as
+    # a direct field + the label fallback used by workloadmanager).
+    workload_ref: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    nominated_node_name: str = ""
+    conditions: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def clone(self) -> "Pod":
+        return dataclasses.replace(
+            self,
+            metadata=dataclasses.replace(
+                self.metadata,
+                labels=dict(self.metadata.labels),
+                annotations=dict(self.metadata.annotations),
+            ),
+            spec=dataclasses.replace(self.spec),
+            status=dataclasses.replace(self.status),
+        )
+
+
+# ---------------------------------------------------------------------------
+# node
+
+
+@dataclass(frozen=True)
+class ContainerImage:
+    names: tuple[str, ...]
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeStatus:
+    # canonical int64 units, keyed by resource name ("cpu", "memory", "pods",
+    # "ephemeral-storage", extended resources)
+    capacity: dict[str, int] = field(default_factory=dict)
+    allocatable: dict[str, int] = field(default_factory=dict)
+    images: list[ContainerImage] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling Workload API (reference:
+# staging/src/k8s.io/api/scheduling/v1alpha1/types.go:82 `Workload`)
+
+
+@dataclass
+class PodGroup:
+    """One gang within a Workload: schedule all-or-nothing once at least
+    min_count member pods are available (reference gangscheduling.go:120-158)."""
+
+    name: str
+    min_count: int
+
+
+@dataclass
+class Workload:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_groups: list[PodGroup] = field(default_factory=list)
+
+
+def pod_group_key(pod: Pod) -> str:
+    """Identity of the gang a pod belongs to ("" = not gang-scheduled)."""
+    return pod.spec.workload_ref or pod.metadata.labels.get("scheduling.k8s.io/workload", "")

@@ -1,0 +1,272 @@
+"""Framework data types: NodeInfo, PodInfo, QueuedPodInfo, events, FitError.
+
+Mirrors pkg/scheduler/framework/types.go (NodeInfo :165-208, PodInfo,
+QueuedPodInfo) and the staging ClusterEvent/ActionType bitmask
+(staging/.../framework/types.go:33-130). NodeInfo here is the host-side row
+mirror of the device capacity matrices; `generation` drives the incremental
+scatter-update snapshot (reference: backend/cache/snapshot.go).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import resources as res
+from ..api.types import Node, Pod
+
+_generation = itertools.count(1)
+
+
+def next_generation() -> int:
+    return next(_generation)
+
+
+# ---------------------------------------------------------------------------
+# cluster events (reference: staging framework/types.go ActionType bitmask)
+
+
+class ActionType(enum.IntFlag):
+    ADD = 1
+    DELETE = 2
+    UPDATE_NODE_ALLOCATABLE = 4
+    UPDATE_NODE_LABEL = 8
+    UPDATE_NODE_TAINT = 16
+    UPDATE_NODE_CONDITION = 32
+    UPDATE_NODE_ANNOTATION = 64
+    UPDATE_POD_LABEL = 128
+    UPDATE_POD_SCALE_DOWN = 256
+    UPDATE_POD_TOLERATION = 512
+    UPDATE_POD_SCHEDULING_GATES = 1024
+    UPDATE = (UPDATE_NODE_ALLOCATABLE | UPDATE_NODE_LABEL | UPDATE_NODE_TAINT
+              | UPDATE_NODE_CONDITION | UPDATE_NODE_ANNOTATION | UPDATE_POD_LABEL
+              | UPDATE_POD_SCALE_DOWN | UPDATE_POD_TOLERATION
+              | UPDATE_POD_SCHEDULING_GATES)
+    ALL = ADD | DELETE | UPDATE
+
+
+class EventResource(str, enum.Enum):
+    POD = "Pod"
+    ASSIGNED_POD = "AssignedPod"
+    UNSCHEDULABLE_POD = "UnschedulablePod"
+    NODE = "Node"
+    PVC = "PersistentVolumeClaim"
+    PV = "PersistentVolume"
+    CSI_NODE = "CSINode"
+    WORKLOAD = "Workload"
+    WILDCARD = "*"
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    resource: EventResource
+    action_type: ActionType
+    label: str = ""
+
+    def match(self, other: "ClusterEvent") -> bool:
+        return ((self.resource == other.resource or self.resource == EventResource.WILDCARD)
+                and bool(self.action_type & other.action_type))
+
+
+class QueueingHint(enum.IntEnum):
+    """Reference: staging framework/interface.go QueueingHint."""
+
+    SKIP = 0
+    QUEUE = 1
+
+
+EVENT_UNSCHEDULABLE_TIMEOUT = ClusterEvent(EventResource.WILDCARD, ActionType.ALL, "UnschedulableTimeout")
+EVENT_FORCE_ACTIVATE = ClusterEvent(EventResource.WILDCARD, ActionType.ALL, "ForceActivate")
+
+
+# ---------------------------------------------------------------------------
+# PodInfo: pod + pre-parsed scheduling terms (reference types.go PodInfo —
+# required affinity terms pre-parsed once at ingest)
+
+
+@dataclass
+class PodInfo:
+    pod: Pod
+    # flattened request vectors, computed once
+    requests: dict[str, int] = field(default_factory=dict)
+    cpu_nonzero: int = 0
+    mem_nonzero: int = 0
+
+    @staticmethod
+    def of(pod: Pod) -> "PodInfo":
+        cpu_nz, mem_nz = res.pod_requests_nonzero(pod)
+        return PodInfo(pod=pod, requests=res.pod_requests(pod),
+                       cpu_nonzero=cpu_nz, mem_nonzero=mem_nz)
+
+    @property
+    def required_affinity_terms(self):
+        aff = self.pod.spec.affinity
+        return aff.pod_affinity.required if aff and aff.pod_affinity else ()
+
+    @property
+    def required_anti_affinity_terms(self):
+        aff = self.pod.spec.affinity
+        return aff.pod_anti_affinity.required if aff and aff.pod_anti_affinity else ()
+
+
+# ---------------------------------------------------------------------------
+# QueuedPodInfo (reference types.go QueuedPodInfo)
+
+
+@dataclass
+class QueuedPodInfo:
+    pod_info: PodInfo
+    timestamp: float = 0.0          # when added to queue (for queue-sort tie)
+    initial_attempt_timestamp: Optional[float] = None
+    attempts: int = 0
+    unschedulable_count: int = 0    # backoff exponent driver
+    consecutive_errors_count: int = 0
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    pending_plugins: set[str] = field(default_factory=set)
+    gated: bool = False
+    gating_plugin: str = ""
+
+    @property
+    def pod(self) -> Pod:
+        return self.pod_info.pod
+
+
+# ---------------------------------------------------------------------------
+# NodeInfo (reference types.go:165-208)
+
+
+@dataclass
+class HostPortInfo:
+    """used host ports: set of (protocol, port, ip)."""
+
+    ports: set[tuple[str, int, str]] = field(default_factory=set)
+
+    @staticmethod
+    def _ip(ip: str) -> str:
+        return ip or "0.0.0.0"
+
+    def add(self, protocol: str, port: int, ip: str = "") -> None:
+        if port > 0:
+            self.ports.add((protocol or "TCP", port, self._ip(ip)))
+
+    def remove(self, protocol: str, port: int, ip: str = "") -> None:
+        self.ports.discard((protocol or "TCP", port, self._ip(ip)))
+
+    def conflicts(self, protocol: str, port: int, ip: str = "") -> bool:
+        """Reference: framework/types.go HostPortInfo.CheckConflict —
+        wildcard IP conflicts with any IP on same proto/port."""
+        if port <= 0:
+            return False
+        protocol, ip = protocol or "TCP", self._ip(ip)
+        if ip == "0.0.0.0":
+            return any(p == protocol and pt == port for (p, pt, _) in self.ports)
+        return ((protocol, port, ip) in self.ports
+                or (protocol, port, "0.0.0.0") in self.ports)
+
+
+@dataclass
+class NodeInfo:
+    node: Node
+    pods: list[PodInfo] = field(default_factory=list)
+    pods_with_affinity: list[PodInfo] = field(default_factory=list)
+    pods_with_required_anti_affinity: list[PodInfo] = field(default_factory=list)
+    requested: dict[str, int] = field(default_factory=dict)
+    non_zero_cpu: int = 0
+    non_zero_mem: int = 0
+    used_ports: HostPortInfo = field(default_factory=HostPortInfo)
+    image_sizes: dict[str, int] = field(default_factory=dict)  # image name → size
+    generation: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.generation:
+            self.generation = next_generation()
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def allocatable(self) -> dict[str, int]:
+        return self.node.status.allocatable
+
+    def bump(self) -> None:
+        self.generation = next_generation()
+
+    # -- pod add/remove (reference types.go AddPodInfo/RemovePod) ------------
+
+    def add_pod(self, pi: PodInfo) -> None:
+        self.pods.append(pi)
+        if pi.required_affinity_terms or self._has_preferred_affinity(pi):
+            self.pods_with_affinity.append(pi)
+        if pi.required_anti_affinity_terms:
+            self.pods_with_required_anti_affinity.append(pi)
+        for k, v in pi.requests.items():
+            self.requested[k] = self.requested.get(k, 0) + v
+        self.non_zero_cpu += pi.cpu_nonzero
+        self.non_zero_mem += pi.mem_nonzero
+        self._update_ports(pi.pod, add=True)
+        self.bump()
+
+    def remove_pod(self, pi: PodInfo) -> bool:
+        uid = pi.pod.uid
+        found = False
+        for lst in (self.pods, self.pods_with_affinity, self.pods_with_required_anti_affinity):
+            for i, p in enumerate(lst):
+                if p.pod.uid == uid:
+                    del lst[i]
+                    found = lst is self.pods or found
+                    break
+        if not found:
+            return False
+        for k, v in pi.requests.items():
+            self.requested[k] = self.requested.get(k, 0) - v
+        self.non_zero_cpu -= pi.cpu_nonzero
+        self.non_zero_mem -= pi.mem_nonzero
+        self._update_ports(pi.pod, add=False)
+        self.bump()
+        return True
+
+    @staticmethod
+    def _has_preferred_affinity(pi: PodInfo) -> bool:
+        aff = pi.pod.spec.affinity
+        if not aff:
+            return False
+        return bool((aff.pod_affinity and aff.pod_affinity.preferred)
+                    or (aff.pod_anti_affinity and aff.pod_anti_affinity.preferred))
+
+    def _update_ports(self, pod: Pod, add: bool) -> None:
+        for c in pod.spec.containers:
+            for p in c.ports:
+                if p.host_port > 0:
+                    if add:
+                        self.used_ports.add(p.protocol, p.host_port, p.host_ip)
+                    else:
+                        self.used_ports.remove(p.protocol, p.host_port, p.host_ip)
+
+
+# ---------------------------------------------------------------------------
+# failures / diagnosis (reference types.go FitError/Diagnosis)
+
+
+@dataclass
+class Diagnosis:
+    node_to_status: dict[str, Status] = field(default_factory=dict)
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    pending_plugins: set[str] = field(default_factory=set)
+    pre_filter_msg: str = ""
+
+
+@dataclass
+class FitError(Exception):
+    pod: Pod
+    num_all_nodes: int
+    diagnosis: Diagnosis = field(default_factory=Diagnosis)
+
+    def __str__(self) -> str:
+        return (f"0/{self.num_all_nodes} nodes are available for pod "
+                f"{self.pod.namespace}/{self.pod.name}")
+
+
+from .interface import Status  # noqa: E402  (bottom import to avoid cycle)

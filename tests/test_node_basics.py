@@ -1,0 +1,130 @@
+"""NodeName / NodeUnschedulable / TaintToleration / NodePorts /
+SchedulingGates / PrioritySort oracle tests."""
+
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.types import NodeInfo, PodInfo, QueuedPodInfo
+from kubernetes_tpu.plugins.node_basics import (NodeName, NodePorts,
+                                                NodeUnschedulable,
+                                                PrioritySort, SchedulingGates,
+                                                TaintToleration)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def ni(node):
+    return NodeInfo(node=node)
+
+
+class TestNodeName:
+    def test_match(self):
+        p = NodeName()
+        pod = make_pod().node("n1").obj()
+        assert p.filter(CycleState(), pod, ni(make_node("n1").obj())).is_success()
+        st = p.filter(CycleState(), pod, ni(make_node("n2").obj()))
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_empty_matches_all(self):
+        p = NodeName()
+        assert p.filter(CycleState(), make_pod().obj(), ni(make_node("n2").obj())).is_success()
+
+
+class TestNodeUnschedulable:
+    def test_unschedulable_rejected(self):
+        p = NodeUnschedulable()
+        node = make_node("n1").unschedulable().obj()
+        st = p.filter(CycleState(), make_pod().obj(), ni(node))
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_toleration_lets_through(self):
+        p = NodeUnschedulable()
+        node = make_node("n1").unschedulable().obj()
+        pod = make_pod().toleration(key="node.kubernetes.io/unschedulable",
+                                    operator="Exists", effect="NoSchedule").obj()
+        assert p.filter(CycleState(), pod, ni(node)).is_success()
+
+
+class TestTaintToleration:
+    def test_untolerated_noschedule(self):
+        p = TaintToleration()
+        node = make_node("n1").taint("k", "v", "NoSchedule").obj()
+        st = p.filter(CycleState(), make_pod().obj(), ni(node))
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_tolerated(self):
+        p = TaintToleration()
+        node = make_node("n1").taint("k", "v", "NoSchedule").obj()
+        pod = make_pod().toleration(key="k", operator="Equal", value="v",
+                                    effect="NoSchedule").obj()
+        assert p.filter(CycleState(), pod, ni(node)).is_success()
+
+    def test_exists_empty_key_tolerates_everything(self):
+        p = TaintToleration()
+        node = make_node("n1").taint("k", "v", "NoExecute").obj()
+        pod = make_pod().toleration(operator="Exists").obj()
+        assert p.filter(CycleState(), pod, ni(node)).is_success()
+
+    def test_prefer_no_schedule_not_filtered_but_scored(self):
+        p = TaintToleration()
+        node = make_node("n1").taint("k", "v", "PreferNoSchedule").obj()
+        pod = make_pod().obj()
+        cs = CycleState()
+        assert p.filter(cs, pod, ni(node)).is_success()
+        p.pre_score(cs, pod, [])
+        score, _ = p.score(cs, pod, ni(node))
+        assert score == 1
+
+    def test_normalize_reversed(self):
+        p = TaintToleration()
+        scores = [2, 0, 1]
+        p.normalize_scores(CycleState(), make_pod().obj(), scores)
+        assert scores == [0, 100, 50]  # more intolerable taints → lower
+
+
+class TestNodePorts:
+    def run(self, pod, node_info):
+        p = NodePorts()
+        cs = CycleState()
+        p.pre_filter(cs, pod, [])
+        return p.filter(cs, pod, node_info)
+
+    def test_no_conflict(self):
+        n = ni(make_node("n1").obj())
+        pod = make_pod().host_port(8080).obj()
+        assert self.run(pod, n).is_success()
+
+    def test_conflict(self):
+        n = ni(make_node("n1").obj())
+        n.add_pod(PodInfo.of(make_pod().host_port(8080).obj()))
+        pod = make_pod().host_port(8080).obj()
+        st = self.run(pod, n)
+        assert st.code == Code.UNSCHEDULABLE
+
+    def test_wildcard_ip_conflicts(self):
+        n = ni(make_node("n1").obj())
+        n.add_pod(PodInfo.of(make_pod().host_port(8080, ip="10.0.0.1").obj()))
+        pod = make_pod().host_port(8080).obj()  # 0.0.0.0 wildcard
+        assert self.run(pod, n).code == Code.UNSCHEDULABLE
+
+    def test_different_protocol_ok(self):
+        n = ni(make_node("n1").obj())
+        n.add_pod(PodInfo.of(make_pod().host_port(8080, protocol="UDP").obj()))
+        pod = make_pod().host_port(8080).obj()
+        assert self.run(pod, n).is_success()
+
+
+class TestSchedulingGates:
+    def test_gated(self):
+        p = SchedulingGates()
+        pod = make_pod().scheduling_gate("wait-for-quota").obj()
+        assert p.pre_enqueue(pod).code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert p.pre_enqueue(make_pod().obj()).is_success()
+
+
+class TestPrioritySort:
+    def test_priority_then_timestamp(self):
+        p = PrioritySort()
+        hi = QueuedPodInfo(PodInfo.of(make_pod().priority(10).obj()), timestamp=2.0)
+        lo = QueuedPodInfo(PodInfo.of(make_pod().priority(1).obj()), timestamp=1.0)
+        assert p.less(hi, lo) and not p.less(lo, hi)
+        a = QueuedPodInfo(PodInfo.of(make_pod().priority(5).obj()), timestamp=1.0)
+        b = QueuedPodInfo(PodInfo.of(make_pod().priority(5).obj()), timestamp=2.0)
+        assert p.less(a, b) and not p.less(b, a)

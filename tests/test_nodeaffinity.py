@@ -1,0 +1,110 @@
+"""NodeAffinity filter/score + framework runtime schedule_pod oracle tests."""
+
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.runtime import Framework, schedule_pod
+from kubernetes_tpu.framework.types import FitError, NodeInfo
+from kubernetes_tpu.plugins import noderesources as nr
+from kubernetes_tpu.plugins.node_basics import (NodeName, NodePorts,
+                                                NodeUnschedulable,
+                                                TaintToleration)
+from kubernetes_tpu.plugins.nodeaffinity import NodeAffinity
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+import pytest
+
+
+def ni(node):
+    return NodeInfo(node=node)
+
+
+class TestNodeAffinityFilter:
+    def test_node_selector_map(self):
+        p = NodeAffinity()
+        pod = make_pod().node_selector({"disktype": "ssd"}).obj()
+        good = ni(make_node("a").label("disktype", "ssd").obj())
+        bad = ni(make_node("b").label("disktype", "hdd").obj())
+        assert p.filter(CycleState(), pod, good).is_success()
+        assert p.filter(CycleState(), pod, bad).code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_required_affinity_in(self):
+        p = NodeAffinity()
+        pod = make_pod().node_affinity_in("zone", ["z1", "z2"]).obj()
+        assert p.filter(CycleState(), pod, ni(make_node("a").label("zone", "z2").obj())).is_success()
+        assert not p.filter(CycleState(), pod, ni(make_node("b").label("zone", "z3").obj())).is_success()
+
+    def test_prefilter_metadata_name_shortcut(self):
+        from kubernetes_tpu.api.types import (LabelSelectorRequirement,
+                                              NodeSelector, NodeSelectorTerm,
+                                              Affinity, NodeAffinity as NA)
+        p = NodeAffinity()
+        term = NodeSelectorTerm(match_fields=(
+            LabelSelectorRequirement("metadata.name", "In", ("n1",)),))
+        pod = make_pod().obj()
+        pod.spec.affinity = Affinity(node_affinity=NA(required=NodeSelector((term,))))
+        result, st = p.pre_filter(CycleState(), pod, [])
+        assert st.is_success()
+        assert result.node_names == {"n1"}
+
+    def test_preferred_scoring(self):
+        p = NodeAffinity()
+        pod = make_pod().preferred_node_affinity_in("zone", ["z1"], 10).obj()
+        cs = CycleState()
+        p.pre_score(cs, pod, [])
+        s1, _ = p.score(cs, pod, ni(make_node("a").label("zone", "z1").obj()))
+        s2, _ = p.score(cs, pod, ni(make_node("b").label("zone", "z2").obj()))
+        assert (s1, s2) == (10, 0)
+
+
+def default_framework() -> Framework:
+    """The default plugin set (reference v1/default_plugins.go:30-93 weights:
+    TaintToleration 3, NodeAffinity 2, NodeResourcesFit 1, Balanced 1)."""
+    return Framework("default-scheduler", [
+        NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
+        NodePorts(), nr.Fit(), nr.BalancedAllocation(),
+    ], weights={"TaintToleration": 3, "NodeAffinity": 2,
+                "NodeResourcesFit": 1, "NodeResourcesBalancedAllocation": 1})
+
+
+class TestSchedulePod:
+    def test_picks_least_allocated(self):
+        fwk = default_framework()
+        nodes = [ni(make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+                 for i in range(3)]
+        from kubernetes_tpu.framework.types import PodInfo
+        nodes[0].add_pod(PodInfo.of(make_pod().req({"cpu": "3"}).obj()))
+        nodes[2].add_pod(PodInfo.of(make_pod().req({"cpu": "1"}).obj()))
+        pod = make_pod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        result = schedule_pod(fwk, CycleState(), pod, nodes)
+        assert result.suggested_host == "n1"  # emptiest node
+        assert result.feasible_nodes == 3
+
+    def test_fit_error_when_no_node_fits(self):
+        fwk = default_framework()
+        nodes = [ni(make_node("n0").capacity({"cpu": "1"}).obj())]
+        pod = make_pod().req({"cpu": "8"}).obj()
+        with pytest.raises(FitError) as err:
+            schedule_pod(fwk, CycleState(), pod, nodes)
+        assert "NodeResourcesFit" in err.value.diagnosis.unschedulable_plugins
+
+    def test_taint_weight_dominates(self):
+        fwk = default_framework()
+        # n0 empty but has PreferNoSchedule taint; n1 half full.
+        n0 = ni(make_node("n0").capacity({"cpu": "4", "memory": "8Gi"})
+                .taint("k", "v", "PreferNoSchedule").obj())
+        n1 = ni(make_node("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        from kubernetes_tpu.framework.types import PodInfo
+        n1.add_pod(PodInfo.of(make_pod().req({"cpu": "2", "memory": "4Gi"}).obj()))
+        pod = make_pod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        result = schedule_pod(fwk, CycleState(), pod, [n0, n1])
+        # TaintToleration: n0 → 0, n1 → 100, weighted ×3 dominates the
+        # LeastAllocated advantage of the empty node.
+        assert result.suggested_host == "n1"
+
+    def test_single_feasible_short_circuit(self):
+        fwk = default_framework()
+        nodes = [ni(make_node("n0").obj()),
+                 ni(make_node("n1").unschedulable().obj())]
+        pod = make_pod().req({"cpu": "1"}).obj()
+        result = schedule_pod(fwk, CycleState(), pod, nodes)
+        assert result.suggested_host == "n0"
+        assert result.feasible_nodes == 1

@@ -1,0 +1,155 @@
+"""NodeResourcesFit filter + scoring oracle tests.
+
+Expected values mirror the reference's unit tests for
+noderesources/fit_test.go and least_allocated/balanced_allocation tests
+(recomputed by hand from the documented formulas, not copied)."""
+
+from kubernetes_tpu.api import resources as res
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.types import NodeInfo, PodInfo
+from kubernetes_tpu.plugins import noderesources as nr
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def node_info(cpu="32", memory="64Gi", pods=110, **extra) -> NodeInfo:
+    caps = {"cpu": cpu, "memory": memory, "pods": pods}
+    caps.update(extra)
+    return NodeInfo(node=make_node().capacity(caps).obj())
+
+
+def add_pod(ni: NodeInfo, cpu="0", memory="0"):
+    ni.add_pod(PodInfo.of(make_pod().req({"cpu": cpu, "memory": memory}).obj()))
+
+
+class TestFitFilter:
+    def run(self, pod, ni):
+        f = nr.Fit()
+        cs = CycleState()
+        f.pre_filter(cs, pod, [ni])
+        return f.filter(cs, pod, ni)
+
+    def test_fits(self):
+        ni = node_info()
+        pod = make_pod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        assert self.run(pod, ni).is_success()
+
+    def test_insufficient_cpu(self):
+        ni = node_info(cpu="2")
+        add_pod(ni, cpu="1500m")
+        pod = make_pod().req({"cpu": "1"}).obj()
+        st = self.run(pod, ni)
+        assert st.code == Code.UNSCHEDULABLE
+        assert "Insufficient cpu" in st.reasons
+
+    def test_unresolvable_when_bigger_than_node(self):
+        ni = node_info(cpu="2")
+        pod = make_pod().req({"cpu": "4"}).obj()
+        st = self.run(pod, ni)
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_too_many_pods(self):
+        ni = node_info(pods=1)
+        add_pod(ni)
+        pod = make_pod().req({"cpu": "1"}).obj()
+        st = self.run(pod, ni)
+        assert st.code == Code.UNSCHEDULABLE
+        assert "Too many pods" in st.reasons
+
+    def test_zero_request_only_checks_pods(self):
+        ni = node_info(cpu="1")
+        add_pod(ni, cpu="1")  # node full on cpu
+        pod = make_pod().obj()  # best-effort
+        assert self.run(pod, ni).is_success()
+
+    def test_extended_resource(self):
+        ni = node_info(**{"example.com/gpu": 2})
+        add_pod(ni)
+        pod = make_pod().req({"cpu": "1", "example.com/gpu": 4}).obj()
+        st = self.run(pod, ni)
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert "Insufficient example.com/gpu" in st.reasons
+
+    def test_ignored_extended_resource(self):
+        ni = node_info()
+        pod = make_pod().req({"cpu": "1", "example.com/gpu": 4}).obj()
+        f = nr.Fit(nr.FitArgs(ignored_resources=frozenset({"example.com/gpu"})))
+        cs = CycleState()
+        f.pre_filter(cs, pod, [ni])
+        assert f.filter(cs, pod, ni).is_success()
+
+
+class TestLeastAllocated:
+    def score(self, pod, ni, args=None):
+        f = nr.Fit(args)
+        cs = CycleState()
+        f.pre_score(cs, pod, [ni])
+        s, st = f.score(cs, pod, ni)
+        assert st.is_success()
+        return s
+
+    def test_empty_node_max_score(self):
+        # cpu: (4000-1000)*100/4000 = 75 ; mem: (10000-2000)*100/10000 = 80
+        ni = node_info(cpu="4", memory=10000)
+        pod = make_pod().req({"cpu": "1", "memory": 2000}).obj()
+        assert self.score(pod, ni) == (75 + 80) // 2
+
+    def test_with_existing_usage(self):
+        # requested(after pod) cpu = 3000/4000 → (4000-3000)*100/4000 = 25
+        # mem = 5000/10000 → 50 → avg 37 (int division of sum by weight)
+        ni = node_info(cpu="4", memory=10000)
+        add_pod(ni, cpu="2", memory=3000)
+        pod = make_pod().req({"cpu": "1", "memory": 2000}).obj()
+        assert self.score(pod, ni) == (25 + 50) // 2
+
+    def test_overcommitted_scores_zero(self):
+        ni = node_info(cpu="1", memory=1000)
+        pod = make_pod().req({"cpu": "2", "memory": 2000}).obj()
+        assert self.score(pod, ni) == 0
+
+    def test_nonzero_defaults_for_best_effort(self):
+        # best-effort pod gets 100m/200Mi defaults in scoring
+        ni = node_info(cpu="1", memory=str(400 * 2**20))
+        pod = make_pod().obj()
+        # cpu: (1000-100)*100/1000 = 90 ; mem: (400Mi-200Mi)*100/400Mi = 50
+        assert self.score(pod, ni) == (90 + 50) // 2
+
+
+class TestBalancedAllocation:
+    def score(self, pod, ni):
+        p = nr.BalancedAllocation()
+        cs = CycleState()
+        st = p.pre_score(cs, pod, [ni])
+        if st.is_skip():
+            return None
+        s, st = p.score(cs, pod, ni)
+        assert st.is_success()
+        return s
+
+    def test_perfectly_balanced(self):
+        ni = node_info(cpu="4", memory=4000)
+        pod = make_pod().req({"cpu": "2", "memory": 2000}).obj()
+        # fractions 0.5/0.5 → std 0 → 100
+        assert self.score(pod, ni) == 100
+
+    def test_imbalanced(self):
+        ni = node_info(cpu="4", memory=4000)
+        pod = make_pod().req({"cpu": "3", "memory": 1000}).obj()
+        # fractions 0.75/0.25 → std = |0.75-0.25|/2 = 0.25 → int(0.75*100) = 75
+        assert self.score(pod, ni) == 75
+
+    def test_best_effort_skipped(self):
+        ni = node_info()
+        pod = make_pod().obj()
+        assert self.score(pod, ni) is None
+
+
+class TestMostAllocated:
+    def test_most_allocated(self):
+        ni = node_info(cpu="4", memory=10000)
+        pod = make_pod().req({"cpu": "1", "memory": 2000}).obj()
+        f = nr.Fit(nr.FitArgs(scoring_strategy=nr.MOST_ALLOCATED))
+        cs = CycleState()
+        f.pre_score(cs, pod, [ni])
+        s, _ = f.score(cs, pod, ni)
+        # cpu 1000/4000 → 25 ; mem 2000/10000 → 20 → 22
+        assert s == (25 + 20) // 2

@@ -1,0 +1,66 @@
+"""Quantity parsing + pod request flattening (reference semantics:
+component-helpers resource.PodRequests, scheduler util non-zero defaults)."""
+
+from kubernetes_tpu.api import resources as res
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+def test_parse_quantity_cpu():
+    assert res.parse_quantity("100m", res.CPU) == 100
+    assert res.parse_quantity("2", res.CPU) == 2000
+    assert res.parse_quantity("1.5", res.CPU) == 1500
+    assert res.parse_quantity(2, res.CPU) == 2000
+    assert res.parse_quantity(0.5, res.CPU) == 500
+
+
+def test_parse_quantity_memory():
+    assert res.parse_quantity("1Gi", res.MEMORY) == 2**30
+    assert res.parse_quantity("500Mi", res.MEMORY) == 500 * 2**20
+    assert res.parse_quantity("1G", res.MEMORY) == 10**9
+    assert res.parse_quantity("128", res.MEMORY) == 128
+    assert res.parse_quantity(1024, res.MEMORY) == 1024
+
+
+def test_pod_requests_sums_containers():
+    pod = (make_pod("p").req({"cpu": "100m", "memory": "1Gi"})
+           .container({"cpu": "200m", "memory": "1Gi"}).obj())
+    req = res.pod_requests(pod)
+    assert req[res.CPU] == 300
+    assert req[res.MEMORY] == 2 * 2**30
+
+
+def test_pod_requests_init_container_max():
+    pod = (make_pod("p").req({"cpu": "100m"})
+           .init_req({"cpu": "1"}).obj())
+    req = res.pod_requests(pod)
+    assert req[res.CPU] == 1000  # init max dominates
+
+
+def test_pod_requests_overhead_added():
+    pod = make_pod("p").req({"cpu": "100m"}).overhead({"cpu": "50m"}).obj()
+    assert res.pod_requests(pod)[res.CPU] == 150
+
+
+def test_nonmissing_defaults_per_container():
+    # two containers, both missing requests → two sets of defaults
+    pod = make_pod("p").container({}).obj()  # c0 empty + c1 empty
+    req = res.pod_requests_nonmissing(pod)
+    assert req[res.CPU] == 2 * res.DEFAULT_MILLI_CPU_REQUEST
+    assert req[res.MEMORY] == 2 * res.DEFAULT_MEMORY_REQUEST
+
+
+def test_nonmissing_defaults_partial():
+    pod = make_pod("p").req({"cpu": "250m"}).obj()
+    req = res.pod_requests_nonmissing(pod)
+    assert req[res.CPU] == 250
+    assert req[res.MEMORY] == res.DEFAULT_MEMORY_REQUEST
+
+
+def test_resource_table_interning():
+    t = res.ResourceTable()
+    assert t.index[res.CPU] == res.CPU_IDX
+    gpu = t.intern("example.com/gpu")
+    assert gpu == 4
+    assert t.intern("example.com/gpu") == 4
+    vec = t.vector({"cpu": 500, "example.com/gpu": 2})
+    assert vec[res.CPU_IDX] == 500 and vec[gpu] == 2
