@@ -153,7 +153,8 @@ class PreScorePlugin(Protocol):
 class ScorePlugin(Protocol):
     def score(self, state: CycleState, pod, node_info) -> tuple[int, Status]: ...
 
-    def normalize_scores(self, state: CycleState, pod, scores: list[int]) -> Status: ...
+    def normalize_scores(self, state: CycleState, pod, scores: list[int],
+                         node_names: Optional[list[str]] = None) -> Status: ...
 
 
 class ReservePlugin(Protocol):

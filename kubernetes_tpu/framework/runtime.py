@@ -158,7 +158,8 @@ class Framework:
                     status.plugin = status.plugin or p.name()
                     return totals, status
                 scores.append(s)
-            status = p.normalize_scores(state, pod, scores)
+            status = p.normalize_scores(state, pod, scores,
+                                        node_names=[ni.name for ni in nodes])
             if not status.is_success():
                 return totals, status
             w = self.plugin_weight(p)

@@ -114,7 +114,8 @@ class TaintToleration:
             and not any(t.tolerates(taint) for t in tolerations))
         return count, Status.success()
 
-    def normalize_scores(self, state: CycleState, pod: Pod, scores: list[int]) -> Status:
+    def normalize_scores(self, state: CycleState, pod: Pod, scores: list[int],
+                         node_names=None) -> Status:
         scores[:] = default_normalize(scores, reverse=True)
         return Status.success()
 

@@ -136,7 +136,8 @@ class NodeAffinity:
                     if t.weight and _term_matches(t.preference, labels, node_info.name))
         return score, Status.success()
 
-    def normalize_scores(self, state: CycleState, pod: Pod, scores: list[int]) -> Status:
+    def normalize_scores(self, state: CycleState, pod: Pod, scores: list[int],
+                         node_names=None) -> Status:
         scores[:] = default_normalize(scores)
         return Status.success()
 

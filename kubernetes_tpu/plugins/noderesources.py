@@ -249,7 +249,7 @@ class Fit:
             reqs = pod_resource_request_list(pod, self.args.resources, use_requested=False)
         return _score(node_info, reqs, self.args.resources, False, self._scorer), Status.success()
 
-    def normalize_scores(self, state, pod, scores) -> Status:
+    def normalize_scores(self, state, pod, scores, node_names=None) -> Status:
         return Status.success()
 
     def sign(self, pod: Pod) -> tuple:
@@ -330,7 +330,7 @@ class BalancedAllocation:
                        lambda r, a: balanced_resource_scorer(r, a))
         return score, Status.success()
 
-    def normalize_scores(self, state, pod, scores) -> Status:
+    def normalize_scores(self, state, pod, scores, node_names=None) -> Status:
         return Status.success()
 
     def sign(self, pod: Pod) -> tuple:

@@ -1,0 +1,40 @@
+"""ImageLocality tests (reference image_locality_test.go essentials)."""
+
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.plugins.imagelocality import (MB, ImageLocality,
+                                                  calculate_priority,
+                                                  normalized_image_name)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def test_normalized_image_name():
+    assert normalized_image_name("nginx") == "nginx:latest"
+    assert normalized_image_name("nginx:1.25") == "nginx:1.25"
+    assert normalized_image_name("reg:5000/nginx") == "reg:5000/nginx:latest"
+    assert normalized_image_name("reg:5000/nginx:tag") == "reg:5000/nginx:tag"
+
+
+def test_calculate_priority_clamps():
+    assert calculate_priority(0, 1) == 0
+    assert calculate_priority(23 * MB, 1) == 0
+    assert calculate_priority(1000 * MB, 1) == 100
+    assert calculate_priority(5000 * MB, 1) == 100
+    mid = calculate_priority(500 * MB, 1)
+    assert 0 < mid < 100
+
+
+def test_score_prefers_node_with_image():
+    ni_with = NodeInfo(node=make_node("with").obj())
+    ni_with.image_sizes["nginx:latest"] = 900 * MB
+    ni_without = NodeInfo(node=make_node("without").obj())
+
+    pod = make_pod("p").obj()
+    pod.spec.containers[0].image = "nginx"
+
+    pl = ImageLocality()
+    state = CycleState()
+    pl.pre_score(state, pod, [ni_with, ni_without])
+    s_with, _ = pl.score(state, pod, ni_with)
+    s_without, _ = pl.score(state, pod, ni_without)
+    assert s_with > s_without == 0

@@ -1,0 +1,203 @@
+"""InterPodAffinity parity tests (modeled on reference
+pkg/scheduler/framework/plugins/interpodaffinity/filtering_test.go and
+scoring_test.go canonical cases)."""
+
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.types import NodeInfo, PodInfo
+from kubernetes_tpu.plugins.interpodaffinity import (
+    InterPodAffinity, InterPodAffinityArgs, NamespaceLister)
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def mk_cluster():
+    nodes = {}
+    for name, zone in (("node-a", "zoneA"), ("node-b", "zoneA"),
+                       ("node-x", "zoneB"), ("node-y", "zoneB")):
+        n = make_node(name).zone(zone).label(HOST, name).obj()
+        nodes[name] = NodeInfo(node=n)
+    return nodes
+
+
+def place(nodes, node_name, pod):
+    nodes[node_name].add_pod(PodInfo.of(pod))
+
+
+def run_filter(plugin, pod, nodes):
+    state = CycleState()
+    nis = list(nodes.values())
+    _, status = plugin.pre_filter(state, pod, nis)
+    if status.is_skip():
+        return {ni.name: status for ni in nis}, state, True
+    return {ni.name: plugin.filter(state, pod, ni) for ni in nis}, state, False
+
+
+class TestFilter:
+    def test_required_affinity_zone(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("store").label("app", "store").obj())
+        pod = make_pod("incoming").pod_affinity(ZONE, {"app": "store"}).obj()
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert statuses["node-a"].is_success()
+        assert statuses["node-b"].is_success()  # same zone
+        assert statuses["node-x"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert statuses["node-y"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_first_pod_escape_hatch(self):
+        # pod has affinity matching itself and no pod in the cluster matches
+        # → allowed everywhere (filtering.go:381-397).
+        nodes = mk_cluster()
+        pod = (make_pod("incoming").label("app", "store")
+               .pod_affinity(ZONE, {"app": "store"}).obj())
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert all(s.is_success() for s in statuses.values())
+
+    def test_first_pod_no_self_match_stays_pending(self):
+        nodes = mk_cluster()
+        pod = make_pod("incoming").pod_affinity(ZONE, {"app": "store"}).obj()
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert all(not s.is_success() for s in statuses.values())
+
+    def test_incoming_anti_affinity_hostname(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("web1").label("app", "web").obj())
+        pod = make_pod("incoming").pod_affinity(HOST, {"app": "web"}, anti=True).obj()
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert statuses["node-a"].code == Code.UNSCHEDULABLE
+        for n in ("node-b", "node-x", "node-y"):
+            assert statuses[n].is_success()
+
+    def test_existing_pods_anti_affinity(self):
+        nodes = mk_cluster()
+        # existing pod on node-a anti-affines (zone) to app=web pods
+        existing = (make_pod("guard").label("app", "guard")
+                    .pod_affinity(ZONE, {"app": "web"}, anti=True).obj())
+        place(nodes, "node-a", existing)
+        pod = make_pod("incoming").label("app", "web").obj()
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert statuses["node-a"].code == Code.UNSCHEDULABLE
+        assert statuses["node-b"].code == Code.UNSCHEDULABLE  # same zone
+        assert statuses["node-x"].is_success()
+        assert statuses["node-y"].is_success()
+
+    def test_skip_when_nothing_relevant(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("p").label("app", "x").obj())
+        pod = make_pod("incoming").obj()
+        _, _, skipped = run_filter(InterPodAffinity(), pod, nodes)
+        assert skipped
+
+    def test_namespace_scoping(self):
+        nodes = mk_cluster()
+        # store pod lives in ns "other"; incoming pod in "default" with a
+        # term that has no explicit namespaces → scoped to default → no match.
+        place(nodes, "node-a",
+              make_pod("store", namespace="other").label("app", "store").obj())
+        pod = make_pod("incoming").pod_affinity(ZONE, {"app": "store"}).obj()
+        statuses, _, _ = run_filter(InterPodAffinity(), pod, nodes)
+        assert all(not s.is_success() for s in statuses.values())
+        # explicit namespaces=("other",) → matches zoneA
+        pod2 = make_pod("incoming2").pod_affinity(
+            ZONE, {"app": "store"}, namespaces=("other",)).obj()
+        statuses2, _, _ = run_filter(InterPodAffinity(), pod2, nodes)
+        assert statuses2["node-a"].is_success()
+        assert not statuses2["node-x"].is_success()
+
+    def test_namespace_selector(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a",
+              make_pod("store", namespace="team-a").label("app", "store").obj())
+        pod = make_pod("incoming").pod_affinity(ZONE, {"app": "store"}).obj()
+        # rewrite the term with a namespaceSelector matching team=a
+        aff = pod.spec.affinity
+        import dataclasses
+        term = dataclasses.replace(aff.pod_affinity.required[0],
+                                   namespace_selector=LabelSelector.of({"team": "a"}))
+        pod.spec.affinity = dataclasses.replace(
+            aff, pod_affinity=dataclasses.replace(aff.pod_affinity, required=(term,)))
+        ns_lister = NamespaceLister({"team-a": {"team": "a"}, "default": {}})
+        statuses, _, _ = run_filter(InterPodAffinity(ns_lister=ns_lister), pod, nodes)
+        assert statuses["node-a"].is_success()
+        assert not statuses["node-x"].is_success()
+
+    def test_add_remove_pod_extensions(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("web1").label("app", "web").obj())
+        pod = make_pod("incoming").pod_affinity(HOST, {"app": "web"}, anti=True).obj()
+        pl = InterPodAffinity()
+        state = CycleState()
+        pl.pre_filter(state, pod, list(nodes.values()))
+        assert not pl.filter(state, pod, nodes["node-a"]).is_success()
+        victim = nodes["node-a"].pods[0]
+        pl.remove_pod(state, pod, victim, nodes["node-a"])
+        assert pl.filter(state, pod, nodes["node-a"]).is_success()
+        pl.add_pod(state, pod, victim, nodes["node-a"])
+        assert not pl.filter(state, pod, nodes["node-a"]).is_success()
+
+
+class TestScore:
+    def run(self, pod, nodes, args=None):
+        pl = InterPodAffinity(args=args)
+        state = CycleState()
+        nis = list(nodes.values())
+        status = pl.pre_score(state, pod, nis)
+        if status.is_skip():
+            return None
+        scores = []
+        for ni in nis:
+            s, st = pl.score(state, pod, ni)
+            assert st.is_success()
+            scores.append(s)
+        pl.normalize_scores(state, pod, scores)
+        return dict(zip(nodes.keys(), scores))
+
+    def test_preferred_affinity(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("store").label("app", "store").obj())
+        pod = (make_pod("incoming")
+               .preferred_pod_affinity(ZONE, {"app": "store"}, weight=5).obj())
+        scores = self.run(pod, nodes)
+        assert scores["node-a"] == scores["node-b"] == 100
+        assert scores["node-x"] == scores["node-y"] == 0
+
+    def test_preferred_anti_affinity(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("noisy").label("app", "noisy").obj())
+        pod = (make_pod("incoming")
+               .preferred_pod_affinity(ZONE, {"app": "noisy"}, weight=5, anti=True).obj())
+        scores = self.run(pod, nodes)
+        assert scores["node-x"] == scores["node-y"] == 100
+        assert scores["node-a"] == scores["node-b"] == 0
+
+    def test_symmetric_preferred_of_existing(self):
+        # existing pod prefers app=web neighbors; incoming pod has app=web
+        # and no terms of its own → symmetric credit.
+        nodes = mk_cluster()
+        existing = (make_pod("social").label("app", "social")
+                    .preferred_pod_affinity(ZONE, {"app": "web"}, weight=3).obj())
+        place(nodes, "node-a", existing)
+        pod = make_pod("incoming").label("app", "web").obj()
+        scores = self.run(pod, nodes)
+        assert scores["node-a"] == scores["node-b"] == 100
+        assert scores["node-x"] == 0
+
+    def test_hard_affinity_weight_symmetry(self):
+        # existing pod on node-a REQUIRES app=web neighbors; with
+        # HardPodAffinityWeight>0 incoming app=web pods get credit there.
+        nodes = mk_cluster()
+        existing = (make_pod("needy").label("app", "needy")
+                    .pod_affinity(ZONE, {"app": "web"}).obj())
+        place(nodes, "node-a", existing)
+        pod = make_pod("incoming").label("app", "web").obj()
+        scores = self.run(pod, nodes, args=InterPodAffinityArgs(hard_pod_affinity_weight=10))
+        assert scores["node-a"] == 100
+        assert scores["node-x"] == 0
+
+    def test_skip_when_no_terms_anywhere(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("plain").label("app", "x").obj())
+        pod = make_pod("incoming").obj()
+        assert self.run(pod, nodes) is None

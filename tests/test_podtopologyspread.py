@@ -1,0 +1,166 @@
+"""PodTopologySpread parity tests (modeled on reference
+pkg/scheduler/framework/plugins/podtopologyspread/filtering_test.go and
+scoring_test.go canonical cases)."""
+
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.types import NodeInfo, PodInfo
+from kubernetes_tpu.plugins.podtopologyspread import (
+    LABEL_HOSTNAME, LABEL_ZONE, PodTopologySpread)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def mk_cluster():
+    """2 zones: zoneA{node-a,node-b} zoneB{node-x,node-y}; hostname labels."""
+    nodes = {}
+    for name, zone in (("node-a", "zoneA"), ("node-b", "zoneA"),
+                       ("node-x", "zoneB"), ("node-y", "zoneB")):
+        n = make_node(name).zone(zone).label(LABEL_HOSTNAME, name).obj()
+        nodes[name] = NodeInfo(node=n)
+    return nodes
+
+
+def place(nodes, node_name, pod):
+    nodes[node_name].add_pod(PodInfo.of(pod))
+
+
+def run_filter(plugin, pod, nodes):
+    state = CycleState()
+    nis = list(nodes.values())
+    _, status = plugin.pre_filter(state, pod, nis)
+    if not status.is_success():
+        return {ni.name: status for ni in nis}, state
+    return {ni.name: plugin.filter(state, pod, ni) for ni in nis}, state
+
+
+class TestFilter:
+    def test_zone_spread_max_skew_1(self):
+        nodes = mk_cluster()
+        # 2 matching pods in zoneA, 1 in zoneB → min=1; skew of zoneA would be
+        # 2+1-1=2 > 1 → only zoneB feasible.
+        for node, i in (("node-a", 0), ("node-b", 1), ("node-x", 2)):
+            place(nodes, node, make_pod(f"p{i}").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert not statuses["node-a"].is_success()
+        assert not statuses["node-b"].is_success()
+        assert statuses["node-x"].is_success()
+        assert statuses["node-y"].is_success()
+
+    def test_hostname_spread(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_HOSTNAME, "DoNotSchedule", {"foo": ""}).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        # min = 0 (3 empty nodes); node-a would get skew 1+1-0=2 > 1
+        assert not statuses["node-a"].is_success()
+        for n in ("node-b", "node-x", "node-y"):
+            assert statuses[n].is_success()
+
+    def test_missing_topology_label_unresolvable(self):
+        nodes = mk_cluster()
+        bare = make_node("node-bare").obj()  # no zone label
+        nodes["node-bare"] = NodeInfo(node=bare)
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert statuses["node-bare"].code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_self_match_counts(self):
+        nodes = mk_cluster()
+        # 1 matching pod in zoneA, 0 in zoneB → min=0. A pod that matches its
+        # own selector adds selfMatch=1: zoneA skew = 1+1-0 = 2 > 1.
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert not statuses["node-a"].is_success()
+        assert statuses["node-x"].is_success()
+
+    def test_non_matching_selector_ignores_self(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        # incoming pod does NOT match the selector → selfMatch=0, zoneA skew
+        # = 1+0-0 = 1 ≤ 1 → all feasible.
+        pod = (make_pod("incoming")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert all(s.is_success() for s in statuses.values())
+
+    def test_min_domains_forces_spread(self):
+        nodes = mk_cluster()
+        # minDomains=3 but only 2 zone domains exist → global min treated as
+        # 0 (filtering.go:66-77). 1 matching pod in each zone; skew anywhere
+        # = 1+1-0 = 2 > 1 → nothing fits.
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        place(nodes, "node-x", make_pod("p1").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""},
+                                  min_domains=3).obj())
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert all(not s.is_success() for s in statuses.values())
+
+    def test_add_remove_pod_extensions(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        place(nodes, "node-a", make_pod("p1").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(2, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        pl = PodTopologySpread()
+        state = CycleState()
+        pl.pre_filter(state, pod, list(nodes.values()))
+        assert not pl.filter(state, pod, nodes["node-a"]).is_success()
+        # removing one victim from node-a brings zoneA down to 1 match:
+        # skew = 1+1-0 = 2 ≤ 2 → fits.
+        victim = nodes["node-a"].pods[0]
+        pl.remove_pod(state, pod, victim, nodes["node-a"])
+        assert pl.filter(state, pod, nodes["node-a"]).is_success()
+        pl.add_pod(state, pod, victim, nodes["node-a"])
+        assert not pl.filter(state, pod, nodes["node-a"]).is_success()
+
+
+class TestScore:
+    def run(self, pod, nodes):
+        pl = PodTopologySpread()
+        state = CycleState()
+        nis = list(nodes.values())
+        status = pl.pre_score(state, pod, nis)
+        assert status.is_success(), status
+        scores = []
+        for ni in nis:
+            s, st = pl.score(state, pod, ni)
+            assert st.is_success()
+            scores.append(s)
+        pl.normalize_scores(state, pod, scores, node_names=[ni.name for ni in nis])
+        return dict(zip(nodes.keys(), scores))
+
+    def test_prefers_less_crowded_zone(self):
+        nodes = mk_cluster()
+        for node, i in (("node-a", 0), ("node-b", 1), ("node-x", 2)):
+            place(nodes, node, make_pod(f"p{i}").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "ScheduleAnyway", {"foo": ""}).obj())
+        scores = self.run(pod, nodes)
+        assert scores["node-x"] > scores["node-a"]
+        assert scores["node-y"] > scores["node-b"]
+        assert scores["node-a"] == scores["node-b"]
+
+    def test_hostname_scoring_prefers_empty_nodes(self):
+        nodes = mk_cluster()
+        place(nodes, "node-a", make_pod("p0").label("foo", "").obj())
+        place(nodes, "node-a", make_pod("p1").label("foo", "").obj())
+        place(nodes, "node-b", make_pod("p2").label("foo", "").obj())
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_HOSTNAME, "ScheduleAnyway", {"foo": ""}).obj())
+        scores = self.run(pod, nodes)
+        assert scores["node-x"] == scores["node-y"] == 100
+        assert scores["node-b"] > scores["node-a"]
+
+    def test_skip_without_soft_constraints(self):
+        nodes = mk_cluster()
+        pod = (make_pod("incoming").label("foo", "")
+               .spread_constraint(1, LABEL_ZONE, "DoNotSchedule", {"foo": ""}).obj())
+        pl = PodTopologySpread()
+        status = pl.pre_score(CycleState(), pod, list(nodes.values()))
+        assert status.is_skip()
