@@ -133,9 +133,14 @@ class Framework:
 
     # -- Score (three phases, reference runtime:1286-1390) -------------------
 
-    def run_pre_score_plugins(self, state: CycleState, pod: Pod, nodes: list[NodeInfo]) -> Status:
+    def run_pre_score_plugins(self, state: CycleState, pod: Pod,
+                              nodes: list[NodeInfo],
+                              all_nodes: Optional[list[NodeInfo]] = None) -> Status:
+        """`nodes` is the feasible set; `all_nodes` the full snapshot list —
+        several plugins count over all nodes (e.g. interpodaffinity
+        scoring.go:148 uses the shared lister, not the filtered list)."""
         for p in self.pre_score_plugins:
-            status = p.pre_score(state, pod, nodes)
+            status = p.pre_score(state, pod, nodes, all_nodes=all_nodes)
             if status.is_skip():
                 state.skip_score_plugins.add(p.name())
                 continue
@@ -252,7 +257,7 @@ def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
                               frozenset([feasible[0].name]),
                               {feasible[0].name: 0})
 
-    status = fwk.run_pre_score_plugins(state, pod, feasible)
+    status = fwk.run_pre_score_plugins(state, pod, feasible, all_nodes=nodes)
     if not status.is_success():
         raise RuntimeError(f"prescore error: {status.reasons}")
     totals, status = fwk.run_score_plugins(state, pod, feasible)

@@ -86,22 +86,3 @@ class ImageLocality:
         return ("images", tuple(normalized_image_name(c.image)
                                 for c in (list(pod.spec.init_containers)
                                           + list(pod.spec.containers))))
-
-
-class DefaultBinder:
-    """B — reference defaultbinder/default_binder.go:51: POST the Binding
-    subresource; here, a call into the API client's `bind` (async via the
-    dispatcher when enabled)."""
-
-    def __init__(self, client):
-        self.client = client
-
-    def name(self) -> str:
-        return "DefaultBinder"
-
-    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
-        try:
-            self.client.bind(pod, node_name)
-        except Exception as e:  # API failure surfaces as Error status
-            return Status.error(str(e), plugin=self.name())
-        return Status.success()

@@ -97,7 +97,7 @@ class TaintToleration:
                 plugin=TAINT_TOLERATION)
         return Status.success()
 
-    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def pre_score(self, state: CycleState, pod: Pod, nodes, all_nodes=None) -> Status:
         prefer_tolerations = [t for t in pod.spec.tolerations
                               if not t.effect or t.effect == TaintEffect.PREFER_NO_SCHEDULE.value]
         state.write(_TAINT_PRE_SCORE_KEY, prefer_tolerations)

@@ -120,7 +120,7 @@ class NodeAffinity:
             terms = terms + tuple(self.args.added_affinity.preferred)
         return terms
 
-    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def pre_score(self, state: CycleState, pod: Pod, nodes, all_nodes=None) -> Status:
         terms = self._preferred_terms(pod)
         state.write(_PRE_SCORE_KEY, terms)
         if not terms:

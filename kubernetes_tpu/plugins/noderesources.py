@@ -238,7 +238,7 @@ class Fit:
 
     # -- Score --------------------------------------------------------------
 
-    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def pre_score(self, state: CycleState, pod: Pod, nodes, all_nodes=None) -> Status:
         state.write(_PRE_SCORE_KEY,
                     pod_resource_request_list(pod, self.args.resources, use_requested=False))
         return Status.success()
@@ -311,7 +311,7 @@ class BalancedAllocation:
     def name(self) -> str:
         return BALANCED_NAME
 
-    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+    def pre_score(self, state: CycleState, pod: Pod, nodes, all_nodes=None) -> Status:
         reqs = pod_resource_request_list(pod, self.args.resources, use_requested=True)
         if all(r == 0 for r in reqs):
             # best-effort pod: skip to avoid piling onto one node

@@ -1,0 +1,164 @@
+"""Cache tests (reference backend/cache/cache_test.go essentials)."""
+
+import pytest
+
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def bound_pod(name, node, cpu="1"):
+    return make_pod(name).req({"cpu": cpu}).node(node).obj()
+
+
+class TestAssumeFlow:
+    def test_assume_confirm(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        p = bound_pod("p1", "n1")
+        c.assume_pod(p)
+        assert c.is_assumed_pod(p)
+        assert c.get_node_info("n1").requested["cpu"] == 1000
+        c.add_pod(p)  # informer confirms
+        assert not c.is_assumed_pod(p)
+        assert c.get_node_info("n1").requested["cpu"] == 1000
+        assert len(c.get_node_info("n1").pods) == 1
+
+    def test_forget(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        p = bound_pod("p1", "n1")
+        c.assume_pod(p)
+        c.forget_pod(p)
+        assert not c.is_assumed_pod(p)
+        assert c.get_node_info("n1").requested.get("cpu", 0) == 0
+
+    def test_double_assume_raises(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        p = bound_pod("p1", "n1")
+        c.assume_pod(p)
+        with pytest.raises(KeyError):
+            c.assume_pod(p)
+
+    def test_expiry(self):
+        clock = FakeClock()
+        c = Cache(ttl=30.0, clock=clock)
+        c.add_node(make_node("n1").obj())
+        p = bound_pod("p1", "n1")
+        c.assume_pod(p)
+        c.finish_binding(p)
+        clock.t = 10.0
+        assert c.cleanup_expired_assumed_pods() == []
+        clock.t = 31.0
+        assert [x.uid for x in c.cleanup_expired_assumed_pods()] == [p.uid]
+        assert c.pod_count() == 0
+
+    def test_no_expiry_with_zero_ttl(self):
+        clock = FakeClock()
+        c = Cache(ttl=0.0, clock=clock)
+        c.add_node(make_node("n1").obj())
+        p = bound_pod("p1", "n1")
+        c.assume_pod(p)
+        c.finish_binding(p)
+        clock.t = 1e9
+        assert c.cleanup_expired_assumed_pods() == []
+
+    def test_pod_before_node(self):
+        c = Cache()
+        p = bound_pod("p1", "nX")
+        c.add_pod(p)
+        assert c.get_node_info("nX").requested["cpu"] == 1000
+        c.remove_pod(p)
+        assert c.get_node_info("nX") is None  # imputed node garbage-collected
+
+
+class TestSnapshot:
+    def test_incremental_dirty_tracking(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        c.add_node(make_node("n2").obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        assert snap.dirty_nodes == {"n1", "n2"}
+        assert len(snap.node_info_list) == 2
+
+        c.update_snapshot(snap)
+        assert snap.dirty_nodes == set()  # nothing changed
+
+        c.add_pod(bound_pod("p1", "n1"))
+        c.update_snapshot(snap)
+        assert snap.dirty_nodes == {"n1"}
+        assert snap.get("n1").requested["cpu"] == 1000
+
+    def test_snapshot_isolation(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        c.add_pod(bound_pod("p1", "n1"))
+        # snapshot unchanged until refreshed
+        assert snap.get("n1").requested.get("cpu", 0) == 0
+        c.update_snapshot(snap)
+        assert snap.get("n1").requested["cpu"] == 1000
+
+    def test_node_removal(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        c.add_node(make_node("n2").obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        c.remove_node(c.get_node_info("n2").node)
+        c.update_snapshot(snap)
+        assert snap.get("n2") is None
+        assert [ni.name for ni in snap.node_info_list] == ["n1"]
+
+    def test_affinity_list_membership(self):
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        assert snap.have_pods_with_affinity_list == []
+        p = (make_pod("p1").node("n1")
+             .pod_affinity("topology.kubernetes.io/zone", {"app": "x"}).obj())
+        c.add_pod(p)
+        c.update_snapshot(snap)
+        assert [ni.name for ni in snap.have_pods_with_affinity_list] == ["n1"]
+        c.remove_pod(p)
+        c.update_snapshot(snap)
+        assert snap.have_pods_with_affinity_list == []
+
+    def test_removed_node_with_pods_not_schedulable(self):
+        # a node deleted while pods remain keeps its entry for pod removal
+        # bookkeeping but must not appear in the schedulable list
+        c = Cache()
+        c.add_node(make_node("n1").obj())
+        c.add_node(make_node("n2").obj())
+        p = bound_pod("p1", "n2")
+        c.add_pod(p)
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        c.remove_node(c.get_node_info("n2").node)
+        c.update_snapshot(snap)
+        assert [ni.name for ni in snap.node_info_list] == ["n1"]
+        # once its last pod is removed the entry disappears entirely
+        c.remove_pod(p)
+        c.update_snapshot(snap)
+        assert c.get_node_info("n2") is None
+
+    def test_zone_round_robin_order(self):
+        c = Cache()
+        for name, zone in (("a1", "z1"), ("a2", "z1"), ("b1", "z2"), ("b2", "z2")):
+            c.add_node(make_node(name).zone(zone).obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        order = [ni.name for ni in snap.node_info_list]
+        # round-robin across zones (node_tree.go), not insertion order
+        assert order == ["a1", "b1", "a2", "b2"]

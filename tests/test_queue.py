@@ -1,0 +1,164 @@
+"""SchedulingQueue tests (reference backend/queue/scheduling_queue_test.go
+essentials)."""
+
+from kubernetes_tpu.backend.queue import (ClusterEventWithHint, SchedulingQueue)
+from kubernetes_tpu.framework.interface import Status
+from kubernetes_tpu.framework.types import (ActionType, ClusterEvent,
+                                            EventResource, QueueingHint)
+from kubernetes_tpu.testing.wrappers import make_pod
+
+NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_queue(**kw):
+    clock = kw.pop("clock", FakeClock())
+    return SchedulingQueue(clock=clock, **kw), clock
+
+
+class TestPopOrder:
+    def test_priority_then_fifo(self):
+        q, _ = mk_queue()
+        low = make_pod("low").priority(1).obj()
+        high = make_pod("high").priority(10).obj()
+        mid = make_pod("mid").priority(5).obj()
+        for p in (low, high, mid):
+            q.add(p)
+        assert [q.pop().pod.name for _ in range(3)] == ["high", "mid", "low"]
+
+    def test_drain_whole_queue(self):
+        q, _ = mk_queue()
+        for i in range(5):
+            q.add(make_pod(f"p{i}").obj())
+        batch = q.drain()
+        assert len(batch) == 5
+        assert q.pop() is None
+
+
+class TestUnschedulableFlow:
+    def test_parked_until_event(self):
+        q, clock = mk_queue()
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi)
+        assert q.pop() is None
+        assert len(q.unschedulable_pods) == 1
+
+        # no hints registered for the plugin → any matching event requeues
+        q.move_all_to_active_or_backoff_queue(NODE_ADD)
+        clock.t += 2.0  # past backoff (1s for first failure)
+        assert q.pop().pod.name == "p"
+
+    def test_hint_skip_keeps_parked(self):
+        hints = {"NodeResourcesFit": [ClusterEventWithHint(
+            NODE_ADD, hint_fn=lambda pod, old, new: QueueingHint.SKIP)]}
+        q, _ = mk_queue(queueing_hints=hints)
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi)
+        assert q.move_all_to_active_or_backoff_queue(NODE_ADD) == 0
+        assert len(q.unschedulable_pods) == 1
+
+    def test_hint_queue_moves(self):
+        hints = {"NodeResourcesFit": [ClusterEventWithHint(
+            NODE_ADD, hint_fn=lambda pod, old, new: QueueingHint.QUEUE)]}
+        q, clock = mk_queue(queueing_hints=hints)
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi)
+        assert q.move_all_to_active_or_backoff_queue(NODE_ADD) == 1
+        clock.t += 2.0
+        assert q.pop().pod.name == "p"
+
+    def test_in_flight_event_requeues_to_backoff(self):
+        # an event arriving DURING the scheduling attempt must not be lost
+        # (active_queue.go:358-431)
+        q, clock = mk_queue()
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        q.move_all_to_active_or_backoff_queue(NODE_ADD)  # while in flight
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi)
+        # went to backoffQ, not the unschedulable pool
+        assert len(q.unschedulable_pods) == 0
+        clock.t += 2.0
+        assert q.pop().pod.name == "p"
+
+    def test_backoff_grows_exponentially(self):
+        q, clock = mk_queue()
+        q.add(make_pod("p").obj())
+        for attempt, expected_backoff in ((1, 1.0), (2, 2.0), (3, 4.0)):
+            qpi = q.pop()
+            assert qpi is not None, f"attempt {attempt}"
+            qpi.unschedulable_plugins = {"X"}
+            q.add_unschedulable_if_not_present(qpi)
+            q.move_all_to_active_or_backoff_queue(NODE_ADD)
+            clock.t += expected_backoff - 0.01
+            assert q.pop() is None  # still backing off
+            clock.t += 0.02
+
+    def test_unschedulable_timeout_flush(self):
+        q, clock = mk_queue()
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"X"}
+        q.add_unschedulable_if_not_present(qpi)
+        clock.t += 299.0
+        assert q.flush_unschedulable_leftover() == 0
+        clock.t += 2.0
+        assert q.flush_unschedulable_leftover() == 1
+
+
+class TestGating:
+    def test_pre_enqueue_gate(self):
+        gate_open = {"open": False}
+
+        def pre_enqueue(pod):
+            return (Status.success() if gate_open["open"]
+                    else Status.unschedulable("gated", plugin="SchedulingGates"))
+
+        q, _ = mk_queue(pre_enqueue=pre_enqueue)
+        q.add(make_pod("p").obj())
+        assert q.pop() is None
+        assert len(q.gated_pods_could_be_ungated()) == 1
+        gate_open["open"] = True
+        assert q.retry_gated() == 1
+        assert q.pop().pod.name == "p"
+
+    def test_gated_pods_ignore_events(self):
+        q, _ = mk_queue(pre_enqueue=lambda pod: Status.unschedulable(
+            "g", plugin="SchedulingGates"))
+        q.add(make_pod("p").obj())
+        assert q.move_all_to_active_or_backoff_queue(NODE_ADD) == 0
+
+
+class TestActivateAndNominator:
+    def test_activate_skips_backoff(self):
+        q, _ = mk_queue()
+        q.add(make_pod("p").obj())
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"X"}
+        q.add_unschedulable_if_not_present(qpi)
+        q.activate([qpi.pod])
+        assert q.pop().pod.name == "p"  # no backoff wait
+
+    def test_nominator(self):
+        q, _ = mk_queue()
+        p = make_pod("p").obj()
+        q.add(p)
+        qpi = q.pop()
+        q.nominator.add(qpi, "node-1")
+        assert q.nominator.nominated_node_for(p) == "node-1"
+        assert [x.pod.name for x in q.nominator.pods_for_node("node-1")] == ["p"]
+        q.nominator.delete(p)
+        assert q.nominator.pods_for_node("node-1") == []
