@@ -53,6 +53,8 @@ class Snapshot:
     have_pods_with_affinity_list: list[NodeInfo] = field(default_factory=list)
     have_pods_with_required_anti_affinity_list: list[NodeInfo] = field(default_factory=list)
     generation: int = 0
+    # names backing node_info_list (schedulable set at last rebuild)
+    tree_names: frozenset[str] = frozenset()
     # node indices whose arrays changed since the previous snapshot — the
     # TPU scatter-update set (not in the reference; our §7.3 addition)
     dirty_nodes: set[str] = field(default_factory=set)
@@ -323,8 +325,10 @@ class Cache:
                     del snapshot.node_infos[name]
                     snapshot.dirty_nodes.add(name)
                     update_all = True
-        if update_all or len(snapshot.node_info_list) != len(snapshot.node_infos):
+        tree_names = frozenset(n for names in self.node_tree.values() for n in names)
+        if update_all or tree_names != snapshot.tree_names:
             self._rebuild_lists(snapshot)
+            snapshot.tree_names = tree_names
         else:
             # refresh references in the flat lists for dirty nodes
             for lst in (snapshot.node_info_list,
