@@ -53,8 +53,8 @@ class Snapshot:
     have_pods_with_affinity_list: list[NodeInfo] = field(default_factory=list)
     have_pods_with_required_anti_affinity_list: list[NodeInfo] = field(default_factory=list)
     generation: int = 0
-    # names backing node_info_list (schedulable set at last rebuild)
-    tree_names: frozenset[str] = frozenset()
+    # node_tree generation at last list rebuild (schedulable-set change marker)
+    tree_generation: int = -1
     # node indices whose arrays changed since the previous snapshot — the
     # TPU scatter-update set (not in the reference; our §7.3 addition)
     dirty_nodes: set[str] = field(default_factory=set)
@@ -77,6 +77,7 @@ class Cache:
         # nodeTree: zone → node names for zone-round-robin ordering
         # (backend/cache/node_tree.go:32-37)
         self.node_tree: dict[str, list[str]] = {}
+        self._tree_generation = 0  # bumped on any node_tree membership change
         self._imputed_nodes: set[str] = set()  # nodes created only by pod adds
 
     # -- linked-list maintenance (cache.go:118-167) --------------------------
@@ -285,11 +286,13 @@ class Cache:
         names = self.node_tree.setdefault(zone, [])
         if node.name not in names:
             names.append(node.name)
+            self._tree_generation += 1
 
     def _node_tree_remove(self, name: str, zone: str) -> None:
         names = self.node_tree.get(zone)
         if names and name in names:
             names.remove(name)
+            self._tree_generation += 1
             if not names:
                 del self.node_tree[zone]
 
@@ -325,10 +328,9 @@ class Cache:
                     del snapshot.node_infos[name]
                     snapshot.dirty_nodes.add(name)
                     update_all = True
-        tree_names = frozenset(n for names in self.node_tree.values() for n in names)
-        if update_all or tree_names != snapshot.tree_names:
+        if update_all or self._tree_generation != snapshot.tree_generation:
             self._rebuild_lists(snapshot)
-            snapshot.tree_names = tree_names
+            snapshot.tree_generation = self._tree_generation
         else:
             # refresh references in the flat lists for dirty nodes
             for lst in (snapshot.node_info_list,

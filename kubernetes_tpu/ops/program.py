@@ -1,0 +1,362 @@
+"""The batched scheduling program: one jitted lax.scan over the pod axis.
+
+This is the TPU replacement for the reference's hot loops (SURVEY §3.2):
+`findNodesThatPassFilters` (schedule_one.go:630, Parallelizer over nodes) and
+`prioritizeNodes`' three score phases (runtime/framework.go:1286-1390) become
+node-axis vectorized kernels, and `ScheduleOne`'s serial pod loop becomes the
+scan — sequential in pods (exact greedy parity: each placement updates the
+carried `used`/`npods`/`ports` before the next pod sees them), parallel in
+nodes.
+
+Filter kernels (all → bool[N]):
+  fit          noderesources/fit.go:649-738 (per-column compare, pod count)
+  node_name    nodename/node_name.go (interned id equality)
+  unschedulable node_unschedulable.go (+ toleration escape)
+  taints       tainttoleration (NoSchedule/NoExecute untolerated)
+  selector     nodeaffinity + spec.nodeSelector (compiled id tables)
+  ports        nodeports (interned (proto,port) id collision)
+
+Score kernels (int64, reference formulas + normalization exactly):
+  least_allocated   least_allocated.go:30-60 (int division, NonZeroRequested)
+  balanced          balanced_allocation.go:195-237 (std of fractions)
+  taint_score       PreferNoSchedule count, DefaultNormalize reverse
+  node_affinity     preferred term weights, DefaultNormalize
+
+Tie-break: masked argmax picks the FIRST max index — the deterministic
+tie-break the host oracle uses (runtime.py), a legal member of the Go score
+heap's randomized argmax set (schedule_one.go:940-944).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..state.batch import (OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN,
+                           OP_LT, OP_NOT_IN, TOL_EQUAL, TOL_EXISTS)
+from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
+                               EFFECT_PREFER_NO_SCHEDULE, NodeArrays)
+
+MAX_SCORE = 100
+
+
+class ScoreConfig(NamedTuple):
+    """Static per-profile scoring configuration (hashable → jit cache key)."""
+
+    score_cols: tuple[int, ...] = (0, 1)        # resource columns to score
+    col_weights: tuple[int, ...] = (1, 1)       # per-column weights
+    col_nonzero: tuple[bool, ...] = (True, True)  # use NonZeroRequested path
+    nonzero_slot: tuple[int, ...] = (0, 1)      # index into nonzero arrays
+    w_fit: int = 1
+    w_balanced: int = 1
+    w_taint: int = 3
+    w_node_affinity: int = 2
+    strategy: str = "LeastAllocated"            # or MostAllocated
+
+
+class Carry(NamedTuple):
+    used: jnp.ndarray          # i64 [N, R]
+    nonzero_used: jnp.ndarray  # i64 [N, 2]
+    npods: jnp.ndarray         # i32 [N]
+    ports: jnp.ndarray         # i32 [N, P]
+
+
+# ---------------------------------------------------------------------------
+# filter kernels (operate on full node axis)
+
+
+def fit_mask(cap, used, npods, allowed_pods, req):
+    pods_ok = npods + 1 <= allowed_pods
+    cols_ok = jnp.all((req[None, :] == 0) | (used + req[None, :] <= cap), axis=1)
+    return pods_ok & cols_ok
+
+
+def tolerates(tol_key, tol_val, tol_eff, tol_op, taint_key, taint_val, taint_eff):
+    """toleration.go:29-56 broadcast: [T_n, TT] → does toleration tt cover
+    taint tn. Empty toleration key (id 0) matches all keys; empty effect
+    (0) matches all effects; Exists ignores value."""
+    key_ok = (tol_key[None, :] == 0) | (tol_key[None, :] == taint_key[:, None])
+    eff_ok = (tol_eff[None, :] == 0) | (tol_eff[None, :] == taint_eff[:, None])
+    val_ok = (tol_op[None, :] == TOL_EXISTS) | (tol_val[None, :] == taint_val[:, None])
+    return (tol_op[None, :] != 0) & key_ok & eff_ok & val_ok
+
+
+def taint_filter_mask(na: NodeArrays, pod):
+    """No untolerated NoSchedule/NoExecute taint."""
+    # [N, T_n, TT]
+    tol = jax.vmap(tolerates, in_axes=(None, None, None, None, 0, 0, 0))(
+        pod.tol_key, pod.tol_val, pod.tol_eff, pod.tol_op,
+        na.taint_key, na.taint_val, na.taint_eff)
+    tolerated = jnp.any(tol, axis=2)                       # [N, T_n]
+    hard = ((na.taint_eff == EFFECT_NO_SCHEDULE)
+            | (na.taint_eff == EFFECT_NO_EXECUTE))
+    return ~jnp.any(hard & ~tolerated, axis=1)
+
+
+def taint_prefer_count(na: NodeArrays, pod):
+    """tainttoleration Score: count untolerated PreferNoSchedule taints;
+    only tolerations with empty or PreferNoSchedule effect participate
+    (taint_toleration.go getAllTolerationPreferNoSchedule)."""
+    prefer_tol_op = jnp.where(
+        (pod.tol_eff == 0) | (pod.tol_eff == EFFECT_PREFER_NO_SCHEDULE),
+        pod.tol_op, 0)
+    tol = jax.vmap(tolerates, in_axes=(None, None, None, None, 0, 0, 0))(
+        pod.tol_key, pod.tol_val, pod.tol_eff, prefer_tol_op,
+        na.taint_key, na.taint_val, na.taint_eff)
+    tolerated = jnp.any(tol, axis=2)
+    prefer = na.taint_eff == EFFECT_PREFER_NO_SCHEDULE
+    return jnp.sum(prefer & ~tolerated, axis=1).astype(jnp.int64)
+
+
+def _requirement_ok(label_key, label_kv, label_num, key, op, num, vals):
+    """One selector requirement vs one node's label rows.
+    label_*: [L]; vals: [V] → bool."""
+    key_hit = (label_key == key) & (key != 0)
+    key_present = jnp.any(key_hit)
+    kv_match = jnp.any((label_kv[:, None] == vals[None, :]) & (vals[None, :] != 0))
+    # numeric value of `key` on this node (NON_NUMERIC if absent/non-int)
+    numeric = jnp.max(jnp.where(key_hit, label_num, jnp.iinfo(jnp.int64).min))
+    has_numeric = key_present & (numeric != jnp.iinfo(jnp.int64).min)
+    return jnp.select(
+        [op == OP_IN, op == OP_NOT_IN, op == OP_EXISTS, op == OP_DOES_NOT_EXIST,
+         op == OP_GT, op == OP_LT],
+        [kv_match, ~kv_match, key_present, ~key_present,
+         has_numeric & (numeric > num), has_numeric & (numeric < num)],
+        default=jnp.array(True),  # op 0 = padding
+    )
+
+
+def _term_ok(label_key, label_kv, label_num, keys, ops, nums, vals):
+    """[Q] requirements ANDed."""
+    f = jax.vmap(_requirement_ok, in_axes=(None, None, None, 0, 0, 0, 0))
+    return jnp.all(f(label_key, label_kv, label_num, keys, ops, nums, vals))
+
+
+def selector_mask(na: NodeArrays, pod):
+    """spec.nodeSelector conjuncts AND required nodeAffinity terms (ORed) —
+    component-helpers nodeaffinity.GetRequiredNodeAffinity semantics."""
+    # nodeSelector: every (key, kv) must be present
+    def one_node_sel(label_kv):
+        present = (pod.ns_sel_val[:, None] == label_kv[None, :]).any(axis=1)
+        return jnp.all((pod.ns_sel_val == 0) | present)
+    sel_ok = jax.vmap(one_node_sel)(na.label_kv)
+
+    def one_node_aff(label_key, label_kv, label_num):
+        terms = jax.vmap(_term_ok, in_axes=(None, None, None, 0, 0, 0, 0))(
+            label_key, label_kv, label_num,
+            pod.aff_key, pod.aff_op, pod.aff_num, pod.aff_val)
+        return jnp.any(terms & pod.aff_term_valid)
+    aff_ok = jnp.where(pod.aff_has,
+                       jax.vmap(one_node_aff)(na.label_key, na.label_kv, na.label_num),
+                       True)
+    return sel_ok & aff_ok
+
+
+def preferred_affinity_score(na: NodeArrays, pod):
+    """nodeaffinity Score: Σ weight over matching preferred terms."""
+    def one_node(label_key, label_kv, label_num):
+        match = jax.vmap(_term_ok, in_axes=(None, None, None, 0, 0, 0, 0))(
+            label_key, label_kv, label_num,
+            pod.pref_key, pod.pref_op, pod.pref_num, pod.pref_val)
+        return jnp.sum(jnp.where(match, pod.pref_weight, 0))
+    return jax.vmap(one_node)(na.label_key, na.label_kv, na.label_num)
+
+
+def ports_mask(ports, pod_port_ids):
+    """nodeports: no interned (proto,port) id collision. Also requires
+    enough free row slots to record the pod's ports — without this a
+    placement could silently drop port bookkeeping and let a later pod in
+    the batch double-book the port (divergence from the host cache)."""
+    collide = (ports[:, :, None] == pod_port_ids[None, None, :]) & (
+        pod_port_ids[None, None, :] != 0)
+    ok = ~jnp.any(collide, axis=(1, 2))
+    free = jnp.sum(ports == 0, axis=1)
+    needed = jnp.sum(pod_port_ids != 0)
+    return ok & (free >= needed)
+
+
+# ---------------------------------------------------------------------------
+# score kernels
+
+
+def least_allocated(cfg: ScoreConfig, cap, used_cols):
+    """least_allocated.go:30-60 exact int64 arithmetic, per node.
+    cap/used_cols: [N, C] for the configured score columns. Padding rows
+    score 0 harmlessly; feasibility masking excludes them from argmax."""
+    w = jnp.array(cfg.col_weights, jnp.int64)
+    col_ok = cap > 0
+    if cfg.strategy == "MostAllocated":
+        raw = jnp.where((cap == 0) | (used_cols > cap), 0,
+                        used_cols * MAX_SCORE // jnp.maximum(cap, 1))
+    else:
+        raw = jnp.where((cap == 0) | (used_cols > cap), 0,
+                        (cap - used_cols) * MAX_SCORE // jnp.maximum(cap, 1))
+    score_sum = jnp.sum(jnp.where(col_ok, raw * w[None, :], 0), axis=1)
+    w_sum = jnp.sum(jnp.where(col_ok, w[None, :], 0), axis=1)
+    return jnp.where(w_sum > 0, score_sum // jnp.maximum(w_sum, 1), 0)
+
+
+def balanced_allocation(cap, used_cols):
+    """balanced_allocation.go:195-237: 100·(1−std of utilization fractions)."""
+    col_ok = cap > 0
+    frac = jnp.where(col_ok, jnp.minimum(used_cols / jnp.maximum(cap, 1), 1.0), 0.0)
+    cnt = jnp.sum(col_ok, axis=1)
+    total = jnp.sum(frac, axis=1)
+    mean = total / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(col_ok, (frac - mean[:, None]) ** 2, 0.0), axis=1) / jnp.maximum(cnt, 1)
+    # population std; for the 2-column case this equals the reference's
+    # |f0−f1|/2 special case (balanced_allocation.go:224-227) exactly
+    std = jnp.sqrt(var)
+    # int truncation with epsilon guard against float error at exact integers
+    return jnp.floor((1.0 - std) * MAX_SCORE + 1e-9).astype(jnp.int64)
+
+
+def default_normalize(scores, feasible, reverse: bool):
+    """plugins/helper DefaultNormalizeScore over the feasible set."""
+    maxc = jnp.max(jnp.where(feasible, scores, 0))
+    scaled = jnp.where(maxc > 0, scores * MAX_SCORE // jnp.maximum(maxc, 1),
+                       jnp.where(reverse, MAX_SCORE, scores))
+    if reverse:
+        scaled = jnp.where(maxc > 0, MAX_SCORE - scaled, scaled)
+    return scaled
+
+
+# ---------------------------------------------------------------------------
+# the scan
+
+
+class PodRow(NamedTuple):
+    """One pod's slice of the PodBatch tensors (scan xs)."""
+
+    valid: jnp.ndarray
+    req: jnp.ndarray
+    nonzero_req: jnp.ndarray
+    node_name_id: jnp.ndarray
+    tol_key: jnp.ndarray
+    tol_val: jnp.ndarray
+    tol_eff: jnp.ndarray
+    tol_op: jnp.ndarray
+    tolerates_unsched: jnp.ndarray
+    ns_sel_val: jnp.ndarray
+    aff_has: jnp.ndarray
+    aff_term_valid: jnp.ndarray
+    aff_key: jnp.ndarray
+    aff_op: jnp.ndarray
+    aff_num: jnp.ndarray
+    aff_val: jnp.ndarray
+    pref_weight: jnp.ndarray
+    pref_key: jnp.ndarray
+    pref_op: jnp.ndarray
+    pref_num: jnp.ndarray
+    pref_val: jnp.ndarray
+    port_ids: jnp.ndarray
+    skip_balanced: jnp.ndarray
+
+
+def pod_rows_from_batch(batch) -> PodRow:
+    """PodBatch (B-leading arrays) → PodRow pytree for scan xs."""
+    return PodRow(
+        valid=jnp.asarray(batch.valid),
+        req=jnp.asarray(batch.req),
+        nonzero_req=jnp.asarray(batch.nonzero_req),
+        node_name_id=jnp.asarray(batch.node_name_id),
+        tol_key=jnp.asarray(batch.tol_key),
+        tol_val=jnp.asarray(batch.tol_val),
+        tol_eff=jnp.asarray(batch.tol_eff),
+        tol_op=jnp.asarray(batch.tol_op),
+        tolerates_unsched=jnp.asarray(batch.tolerates_unsched),
+        ns_sel_val=jnp.asarray(batch.ns_sel_val),
+        aff_has=jnp.asarray(batch.aff_has),
+        aff_term_valid=jnp.asarray(batch.aff_term_valid),
+        aff_key=jnp.asarray(batch.aff_key),
+        aff_op=jnp.asarray(batch.aff_op),
+        aff_num=jnp.asarray(batch.aff_num),
+        aff_val=jnp.asarray(batch.aff_val),
+        pref_weight=jnp.asarray(batch.pref_weight),
+        pref_key=jnp.asarray(batch.pref_key),
+        pref_op=jnp.asarray(batch.pref_op),
+        pref_num=jnp.asarray(batch.pref_num),
+        pref_val=jnp.asarray(batch.pref_val),
+        port_ids=jnp.asarray(batch.port_ids),
+        skip_balanced=jnp.asarray(batch.skip_balanced),
+    )
+
+
+def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
+    """Feasibility + total score for one pod over all nodes → (mask, score)."""
+    cols = jnp.array(cfg.score_cols, jnp.int32)
+
+    # ---- filters ----
+    m = na.valid
+    m &= fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods, pod.req)
+    m &= (pod.node_name_id == 0) | (na.name_id == pod.node_name_id)
+    m &= ~na.unschedulable | pod.tolerates_unsched
+    m &= taint_filter_mask(na, pod)
+    m &= selector_mask(na, pod)
+    m &= ports_mask(carry.ports, pod.port_ids)
+
+    # ---- scores ----
+    cap_cols = na.cap[:, cols]                        # [N, C]
+    nz = jnp.array(cfg.col_nonzero)
+    slots = jnp.array(cfg.nonzero_slot, jnp.int32)
+    used_nonzero = carry.nonzero_used[:, slots] + pod.nonzero_req[slots][None, :]
+    used_plain = carry.used[:, cols] + pod.req[cols][None, :]
+    used_cols = jnp.where(nz[None, :], used_nonzero, used_plain)
+    s_fit = least_allocated(cfg, cap_cols, used_cols)
+
+    used_bal = carry.used[:, cols] + pod.req[cols][None, :]
+    s_bal = jnp.where(pod.skip_balanced, 0, balanced_allocation(cap_cols, used_bal))
+
+    s_taint = default_normalize(taint_prefer_count(na, pod), m, reverse=True)
+    s_na = default_normalize(preferred_affinity_score(na, pod), m, reverse=False)
+
+    total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
+             + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)
+    return m, total
+
+
+def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
+                      assigned: jnp.ndarray) -> Carry:
+    onehot = (jnp.arange(carry.npods.shape[0], dtype=jnp.int32) == best) & assigned
+    used = carry.used + jnp.where(onehot[:, None], pod.req[None, :], 0)
+    nonzero = carry.nonzero_used + jnp.where(onehot[:, None],
+                                             pod.nonzero_req[None, :], 0)
+    npods = carry.npods + onehot.astype(carry.npods.dtype)
+    # place pod port ids into the first free slots of the chosen node's row
+    row = carry.ports[best]
+    free = row == 0
+    rank = jnp.cumsum(free) - 1
+    pod_ports = pod.port_ids
+    nport = pod_ports.shape[0]
+    incoming = jnp.where((rank >= 0) & (rank < nport) & free,
+                         pod_ports[jnp.clip(rank, 0, nport - 1)], 0)
+    new_row = jnp.where(free, incoming, row)
+    ports = jnp.where(
+        (onehot[:, None]) & (jnp.any(pod_ports != 0)),
+        jnp.broadcast_to(new_row, carry.ports.shape), carry.ports)
+    return Carry(used=used, nonzero_used=nonzero, npods=npods, ports=ports)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodRow):
+    """Scan the batch; returns (final carry, assignments int32[B] (-1 = none))."""
+
+    def step(c: Carry, pod: PodRow):
+        mask, score = _eval_pod(cfg, na, c, pod)
+        masked = jnp.where(mask, score, -1)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        assigned = (masked[best] >= 0) & pod.valid
+        c2 = _apply_assignment(c, pod, best, assigned)
+        return c2, jnp.where(assigned, best, -1)
+
+    final, assignments = lax.scan(step, carry, pods)
+    return final, assignments
+
+
+def initial_carry(na: NodeArrays) -> Carry:
+    return Carry(used=na.used, nonzero_used=na.nonzero_used,
+                 npods=na.npods, ports=na.ports)

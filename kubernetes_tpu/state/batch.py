@@ -1,0 +1,251 @@
+"""Pod-batch tensorization: compile a queue drain into device tensors.
+
+Each pending pod becomes a row of fixed-width tensors; arbitrary label
+selectors compile to padded (term × requirement × value) id tables evaluated
+against the node label arrays on device (SURVEY §7 hard-part 6). Pods whose
+constraints exceed the padding (or use semantics with no tensor form yet)
+get `host_fallback=True` and are scheduled by the host oracle instead — the
+analog of the reference disabling batching for plugins without SignPlugin
+(runtime/framework.go:772-816).
+
+Selector op encoding (0 = padding → vacuously true):
+  1=In  2=NotIn  3=Exists  4=DoesNotExist  5=Gt  6=Lt
+Toleration op: 1=Equal 2=Exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..api import resources as res
+from ..api.types import NodeSelectorTerm, Pod, SelectorOperator
+from ..state.tensorize import _EFFECTS, ClusterState, pow2_at_least
+from ..plugins.node_basics import NodeUnschedulable
+
+OP_IN = 1
+OP_NOT_IN = 2
+OP_EXISTS = 3
+OP_DOES_NOT_EXIST = 4
+OP_GT = 5
+OP_LT = 6
+
+_SEL_OPS = {
+    SelectorOperator.IN.value: OP_IN,
+    SelectorOperator.NOT_IN.value: OP_NOT_IN,
+    SelectorOperator.EXISTS.value: OP_EXISTS,
+    SelectorOperator.DOES_NOT_EXIST.value: OP_DOES_NOT_EXIST,
+    SelectorOperator.GT.value: OP_GT,
+    SelectorOperator.LT.value: OP_LT,
+}
+
+TOL_EQUAL = 1
+TOL_EXISTS = 2
+
+
+@dataclass
+class BatchDims:
+    pods: int = 8          # B (padded)
+    sel_terms: int = 4     # T — required node affinity terms
+    sel_reqs: int = 6      # Q — requirements per term (incl. nodeSelector merge)
+    sel_vals: int = 8      # V — values per requirement
+    pref_terms: int = 4    # PT — preferred node affinity terms
+    tolerations: int = 8   # TT
+    ports: int = 8         # P
+
+
+class PodBatch(NamedTuple):
+    valid: object            # bool [B]
+    host_fallback: object    # bool [B] (numpy only; never shipped to device)
+    req: object              # i64 [B, R]
+    nonzero_req: object      # i64 [B, 2]
+    node_name_id: object     # i32 [B] (0 = unset)
+    # tolerations
+    tol_key: object          # i32 [B, TT]
+    tol_val: object          # i32 [B, TT]
+    tol_eff: object          # i32 [B, TT] (0 = all effects)
+    tol_op: object           # i32 [B, TT] (0 = padding)
+    tolerates_unsched: object  # bool [B]
+    # required node selector+affinity: nodeSelector is term -1 semantics —
+    # compiled as an extra ANDed conjunct via ns_sel_*
+    ns_sel_val: object       # i32 [B, Q] (kv id — encodes key=value; 0 = padding)
+    aff_has: object          # bool [B] (has required affinity terms)
+    aff_term_valid: object   # bool [B, T]
+    aff_key: object          # i32 [B, T, Q]
+    aff_op: object           # i32 [B, T, Q]
+    aff_num: object          # i64 [B, T, Q]
+    aff_val: object          # i32 [B, T, Q, V]
+    # preferred node affinity
+    pref_weight: object      # i64 [B, PT] (0 = unused term)
+    pref_key: object         # i32 [B, PT, Q]
+    pref_op: object          # i32 [B, PT, Q]
+    pref_num: object         # i64 [B, PT, Q]
+    pref_val: object         # i32 [B, PT, Q, V]
+    # ports
+    port_ids: object         # i32 [B, P]
+    # score gates
+    skip_balanced: object    # bool [B]
+
+
+class BatchCapacityError(ValueError):
+    pass
+
+
+class BatchBuilder:
+    def __init__(self, state: ClusterState, dims: Optional[BatchDims] = None):
+        self.state = state
+        self.dims = dims or BatchDims()
+
+    def build(self, pods: list[Pod]) -> PodBatch:
+        d = self.dims
+        B = pow2_at_least(len(pods))
+        R = self.state.dims.resources
+        batch = _zero_batch(B, R, d)
+
+        for i, pod in enumerate(pods):
+            try:
+                self._fill_row(batch, i, pod)
+                batch.valid[i] = True
+            except BatchCapacityError:
+                # zero the partially-filled row; the host oracle schedules it
+                for arr in batch:
+                    if arr.dtype == bool:
+                        arr[i] = False
+                    else:
+                        arr[i] = 0
+                batch.host_fallback[i] = True
+        return batch
+
+    def _fill_row(self, b: PodBatch, i: int, pod: Pod) -> None:
+        d = self.dims
+        intr = self.state.interner
+        # resources
+        reqs = res.pod_requests(pod)
+        row = self.state.rtable.vector(reqs)
+        if len(row) > b.req.shape[1]:
+            raise BatchCapacityError("resource table grew past batch width")
+        b.req[i, :len(row)] = row
+        nz_cpu, nz_mem = res.pod_requests_nonzero(pod)
+        b.nonzero_req[i, 0] = nz_cpu
+        b.nonzero_req[i, 1] = nz_mem
+        b.skip_balanced[i] = all(v == 0 for v in reqs.values())
+        # nodeName
+        if pod.spec.node_name:
+            b.node_name_id[i] = self.state.node_id(pod.spec.node_name)
+        # tolerations
+        tols = pod.spec.tolerations
+        if len(tols) > d.tolerations:
+            raise BatchCapacityError("too many tolerations")
+        for t, tol in enumerate(tols):
+            b.tol_key[i, t] = intr.key.intern(tol.key) if tol.key else 0
+            b.tol_val[i, t] = intr.kv.intern(f"tv:{tol.value}")
+            b.tol_eff[i, t] = _EFFECTS.get(tol.effect, 0) if tol.effect else 0
+            op = tol.operator or "Equal"
+            b.tol_op[i, t] = TOL_EXISTS if op == "Exists" else TOL_EQUAL
+        b.tolerates_unsched[i] = any(
+            t.tolerates(NodeUnschedulable.TAINT) for t in tols)
+        # nodeSelector → equality conjuncts
+        sel = pod.spec.node_selector
+        if len(sel) > d.sel_reqs:
+            raise BatchCapacityError("nodeSelector too wide")
+        for q, (k, v) in enumerate(sorted(sel.items())):
+            b.ns_sel_val[i, q] = intr.label_kv(k, v)
+        # required node affinity
+        aff = pod.spec.affinity
+        na = aff.node_affinity if aff else None
+        if na and na.required is not None:
+            terms = na.required.terms
+            if len(terms) > d.sel_terms:
+                raise BatchCapacityError("too many nodeAffinity terms")
+            b.aff_has[i] = True
+            for t, term in enumerate(terms):
+                b.aff_term_valid[i, t] = True
+                self._fill_term(term, b.aff_key[i, t], b.aff_op[i, t],
+                                b.aff_num[i, t], b.aff_val[i, t])
+        # preferred node affinity
+        if na and na.preferred:
+            prefs = na.preferred
+            if len(prefs) > d.pref_terms:
+                raise BatchCapacityError("too many preferred terms")
+            for t, p in enumerate(prefs):
+                if p.weight == 0:
+                    continue
+                b.pref_weight[i, t] = p.weight
+                self._fill_term(p.preference, b.pref_key[i, t], b.pref_op[i, t],
+                                b.pref_num[i, t], b.pref_val[i, t])
+        # ports
+        ports = [(p.protocol or "TCP", p.host_port, p.host_ip)
+                 for c in pod.spec.containers for p in c.ports if p.host_port > 0]
+        if any(ip not in ("", "0.0.0.0") for (_, _, ip) in ports):
+            # host-IP-scoped ports keep reference semantics via host path
+            raise BatchCapacityError("host-IP-scoped port")
+        if len(ports) > d.ports:
+            raise BatchCapacityError("too many host ports")
+        for q, (proto, port, _ip) in enumerate(ports):
+            b.port_ids[i, q] = intr.port_id(proto, port)
+        # pods with inter-pod affinity / spread constraints are handled by the
+        # group tensors (ops/groups.py); nothing to do per-row here.
+
+    def _fill_term(self, term: NodeSelectorTerm, key_row, op_row, num_row, val_row) -> None:
+        d = self.dims
+        intr = self.state.interner
+        reqs = list(term.match_expressions)
+        # matchFields (metadata.name) compile to ordinary requirements against
+        # the synthetic metadata.name label (tensorize.py)
+        for f in term.match_fields:
+            reqs.append(f)
+        if len(reqs) > d.sel_reqs:
+            raise BatchCapacityError("too many requirements in term")
+        for q, r in enumerate(reqs):
+            opc = _SEL_OPS.get(r.operator)
+            if opc is None:
+                raise BatchCapacityError(f"unsupported operator {r.operator}")
+            if r.key == "metadata.name":
+                key = intr.key.intern("metadata.name")
+            else:
+                key = intr.key.intern(r.key)
+            key_row[q] = key
+            op_row[q] = opc
+            if opc in (OP_IN, OP_NOT_IN):
+                if len(r.values) > d.sel_vals:
+                    raise BatchCapacityError("too many values in requirement")
+                for v, value in enumerate(r.values):
+                    val_row[q, v] = intr.label_kv(r.key, value)
+            elif opc in (OP_GT, OP_LT):
+                if len(r.values) != 1:
+                    raise BatchCapacityError("Gt/Lt needs exactly one value")
+                try:
+                    num_row[q] = int(r.values[0])
+                except ValueError:
+                    raise BatchCapacityError("non-integer Gt/Lt value")
+
+
+def _zero_batch(B: int, R: int, d: BatchDims) -> PodBatch:
+    return PodBatch(
+        valid=np.zeros((B,), bool),
+        host_fallback=np.zeros((B,), bool),
+        req=np.zeros((B, R), np.int64),
+        nonzero_req=np.zeros((B, 2), np.int64),
+        node_name_id=np.zeros((B,), np.int32),
+        tol_key=np.zeros((B, d.tolerations), np.int32),
+        tol_val=np.zeros((B, d.tolerations), np.int32),
+        tol_eff=np.zeros((B, d.tolerations), np.int32),
+        tol_op=np.zeros((B, d.tolerations), np.int32),
+        tolerates_unsched=np.zeros((B,), bool),
+        ns_sel_val=np.zeros((B, d.sel_reqs), np.int32),
+        aff_has=np.zeros((B,), bool),
+        aff_term_valid=np.zeros((B, d.sel_terms), bool),
+        aff_key=np.zeros((B, d.sel_terms, d.sel_reqs), np.int32),
+        aff_op=np.zeros((B, d.sel_terms, d.sel_reqs), np.int32),
+        aff_num=np.zeros((B, d.sel_terms, d.sel_reqs), np.int64),
+        aff_val=np.zeros((B, d.sel_terms, d.sel_reqs, d.sel_vals), np.int32),
+        pref_weight=np.zeros((B, d.pref_terms), np.int64),
+        pref_key=np.zeros((B, d.pref_terms, d.sel_reqs), np.int32),
+        pref_op=np.zeros((B, d.pref_terms, d.sel_reqs), np.int32),
+        pref_num=np.zeros((B, d.pref_terms, d.sel_reqs), np.int64),
+        pref_val=np.zeros((B, d.pref_terms, d.sel_reqs, d.sel_vals), np.int32),
+        port_ids=np.zeros((B, d.ports), np.int32),
+        skip_balanced=np.zeros((B,), bool),
+    )

@@ -1,0 +1,232 @@
+"""Device-program ↔ host-oracle decision parity.
+
+The hard requirement (BASELINE.json): bind decisions identical to the default
+Go plugins. The host runtime (framework/runtime.py) is the transliterated
+oracle; here the batched device program's every assignment is checked to land
+in the oracle's argmax set on the same evolving cluster state, across
+randomized clusters exercising every v1 kernel.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.framework.runtime import Framework, schedule_pod
+from kubernetes_tpu.framework.types import FitError, PodInfo
+from kubernetes_tpu.ops.program import (ScoreConfig, initial_carry,
+                                        pod_rows_from_batch, run_batch)
+from kubernetes_tpu.plugins import noderesources as nr
+from kubernetes_tpu.plugins.node_basics import (NodeName, NodePorts,
+                                                NodeUnschedulable,
+                                                TaintToleration)
+from kubernetes_tpu.plugins.nodeaffinity import NodeAffinity
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+WEIGHTS = {"TaintToleration": 3, "NodeAffinity": 2,
+           "NodeResourcesFit": 1, "NodeResourcesBalancedAllocation": 1}
+
+
+def default_framework():
+    return Framework("default-scheduler",
+                     [NodeUnschedulable(), NodeName(), TaintToleration(),
+                      NodeAffinity(), NodePorts(), nr.Fit(),
+                      nr.BalancedAllocation()],
+                     weights=WEIGHTS)
+
+
+def assert_device_matches_oracle(nodes, pods, cfg=ScoreConfig()):
+    """Run the device batch; verify each assignment is in the oracle argmax
+    set on the same evolving state; apply device choices to the host state."""
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    batch = builder.build(pods)
+    assert not batch.host_fallback.any(), "test pods must be tensorizable"
+
+    na = state.device_arrays()
+    carry, assignments = run_batch(cfg, na, initial_carry(na), pod_rows_from_batch(batch))
+    assignments = np.asarray(assignments)[:len(pods)]  # drop padding rows
+
+    fwk = default_framework()
+    for i, pod in enumerate(pods):
+        chosen = assignments[i]
+        node_name = state.node_names[chosen] if chosen >= 0 else None
+        try:
+            result = schedule_pod(fwk, CycleState(), pod, snap.node_info_list)
+        except FitError:
+            assert node_name is None, (
+                f"pod {pod.name}: device chose {node_name}, oracle found none")
+            continue
+        assert node_name is not None, (
+            f"pod {pod.name}: device found none, oracle chose "
+            f"{result.suggested_host} (argmax {sorted(result.argmax_set)})")
+        assert node_name in result.argmax_set, (
+            f"pod {pod.name}: device chose {node_name} "
+            f"(score {result.scores.get(node_name)}), oracle argmax set "
+            f"{sorted(result.argmax_set)} scores {result.scores}")
+        # evolve host state with the DEVICE's choice (both are legal picks)
+        pod.spec.node_name = node_name
+        cache.assume_pod(pod)
+        cache.update_snapshot(snap)
+    return assignments
+
+
+class TestBasicParity:
+    def test_least_allocated_round_robin(self):
+        nodes = [make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+                 for i in range(4)]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj()
+                for i in range(12)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert (a >= 0).all()
+        # perfect balance: 3 pods per node
+        assert sorted(np.bincount(a, minlength=4)) == [3, 3, 3, 3]
+
+    def test_capacity_exhaustion(self):
+        nodes = [make_node("n0").capacity({"cpu": "2", "memory": "4Gi", "pods": 110}).obj()]
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert list(a >= 0) == [True, True, False, False]
+
+    def test_pod_count_limit(self):
+        nodes = [make_node("n0").capacity({"cpu": "32", "pods": 2}).obj()]
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(3)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert list(a >= 0) == [True, True, False]
+
+    def test_heterogeneous_capacities(self):
+        nodes = [make_node("big").capacity({"cpu": "16", "memory": "32Gi"}).obj(),
+                 make_node("small").capacity({"cpu": "2", "memory": "4Gi"}).obj()]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj()
+                for i in range(8)]
+        assert_device_matches_oracle(nodes, pods)
+
+    def test_best_effort_pods(self):
+        # zero requests: balanced-allocation skips, nonzero defaults drive fit
+        nodes = [make_node(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(3)]
+        pods = [make_pod(f"p{i}").req({}).obj() for i in range(6)]
+        assert_device_matches_oracle(nodes, pods)
+
+
+class TestConstraintParity:
+    def test_node_name_pinning(self):
+        nodes = [make_node(f"n{i}").obj() for i in range(3)]
+        p = make_pod("pin2").obj()
+        p.spec.node_name = "n2"
+        a = assert_device_matches_oracle(nodes, [p])
+        assert a[0] == 2
+
+    def test_unschedulable_node(self):
+        nodes = [make_node("up").obj(), make_node("down").unschedulable().obj()]
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert (a == 0).all()
+
+    def test_taints_and_tolerations(self):
+        nodes = [make_node("tainted").taint("dedicated", "gpu").obj(),
+                 make_node("open").obj()]
+        plain = make_pod("plain").req({"cpu": "1"}).obj()
+        tolerant = (make_pod("tolerant").req({"cpu": "1"})
+                    .toleration(key="dedicated", operator="Equal", value="gpu",
+                                effect="NoSchedule").obj())
+        a = assert_device_matches_oracle(nodes, [plain, tolerant])
+        assert a[0] == 1  # plain pod forced onto open node
+
+    def test_prefer_no_schedule_scoring(self):
+        nodes = [make_node("soft").taint("x", "y", effect="PreferNoSchedule").obj(),
+                 make_node("clean").obj()]
+        pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(2)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert a[0] == 1  # clean preferred
+
+    def test_node_selector(self):
+        nodes = [make_node("ssd").label("disk", "ssd").obj(),
+                 make_node("hdd").label("disk", "hdd").obj()]
+        pod = make_pod("p").node_selector({"disk": "ssd"}).req({"cpu": "1"}).obj()
+        a = assert_device_matches_oracle(nodes, [pod])
+        assert a[0] == 0
+
+    def test_required_node_affinity_in(self):
+        nodes = [make_node(f"n{i}").label("zone", f"z{i}").obj() for i in range(3)]
+        pod = (make_pod("p").node_affinity_in("zone", ["z1", "z2"])
+               .req({"cpu": "1"}).obj())
+        a = assert_device_matches_oracle(nodes, [pod])
+        assert a[0] in (1, 2)
+
+    def test_preferred_node_affinity(self):
+        nodes = [make_node("plain").obj(),
+                 make_node("preferred").label("tier", "gold").obj()]
+        pod = (make_pod("p").preferred_node_affinity_in("tier", ["gold"], weight=10)
+               .req({"cpu": "1"}).obj())
+        a = assert_device_matches_oracle(nodes, [pod])
+        assert a[0] == 1
+
+    def test_host_ports(self):
+        nodes = [make_node(f"n{i}").obj() for i in range(2)]
+        pods = [make_pod(f"p{i}").host_port(8080).req({"cpu": "1"}).obj()
+                for i in range(3)]
+        a = assert_device_matches_oracle(nodes, pods)
+        assert sorted(a[:2]) == [0, 1]
+        assert a[2] == -1  # both nodes' 8080 taken
+
+    def test_gt_lt_selector(self):
+        nodes = [make_node("few").label("gpus", "2").obj(),
+                 make_node("many").label("gpus", "8").obj()]
+        import kubernetes_tpu.api.types as T
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        term = T.NodeSelectorTerm(match_expressions=(
+            T.LabelSelectorRequirement("gpus", "Gt", ("4",)),))
+        pod.spec.affinity = T.Affinity(node_affinity=T.NodeAffinity(
+            required=T.NodeSelector(terms=(term,))))
+        a = assert_device_matches_oracle(nodes, [pod])
+        assert a[0] == 1
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_fuzz(self, seed):
+        rng = random.Random(seed)
+        nodes = []
+        for i in range(rng.randint(3, 12)):
+            w = make_node(f"n{i}").capacity({
+                "cpu": str(rng.choice([2, 4, 8, 16])),
+                "memory": f"{rng.choice([4, 8, 16, 32])}Gi",
+                "pods": rng.choice([5, 110])})
+            if rng.random() < 0.3:
+                w = w.label("disk", rng.choice(["ssd", "hdd"]))
+            if rng.random() < 0.3:
+                w = w.zone(f"z{rng.randint(0, 2)}")
+            if rng.random() < 0.2:
+                w = w.taint("dedicated", "batch",
+                            effect=rng.choice(["NoSchedule", "PreferNoSchedule"]))
+            if rng.random() < 0.1:
+                w = w.unschedulable()
+            nodes.append(w.obj())
+        pods = []
+        for i in range(rng.randint(5, 30)):
+            w = make_pod(f"p{i}").req({
+                "cpu": rng.choice(["100m", "500m", "1", "2"]),
+                "memory": rng.choice(["128Mi", "1Gi", "2Gi"])})
+            if rng.random() < 0.3:
+                w = w.node_selector({"disk": rng.choice(["ssd", "hdd"])})
+            if rng.random() < 0.3:
+                w = w.toleration(key="dedicated", operator="Exists")
+            if rng.random() < 0.2:
+                w = w.preferred_node_affinity_in(
+                    "topology.kubernetes.io/zone", [f"z{rng.randint(0, 2)}"],
+                    weight=rng.randint(1, 10))
+            if rng.random() < 0.15:
+                w = w.host_port(rng.choice([80, 443, 8080]))
+            pods.append(w.obj())
+        assert_device_matches_oracle(nodes, pods)
