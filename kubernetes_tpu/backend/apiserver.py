@@ -1,0 +1,163 @@
+"""In-memory API server + clientset + informer fan-out.
+
+The reference's entire distributed substrate is etcd + watch/list over HTTP/2
+(SURVEY §2.7); its scheduler tests talk to an in-process apiserver
+(test/integration, apiservertesting.StartTestServer) or a fake clientset with
+an object tracker (client-go/kubernetes/fake). This module is both at once:
+an object store with Binding/status subresources and synchronous watch
+delivery to registered handlers — the process boundary collapses, the
+interface shape stays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import Node, Pod, Workload
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+@dataclass
+class WatchHandlers:
+    """The informer event-handler triple (client-go ResourceEventHandler)."""
+
+    on_add: Optional[Callable] = None
+    on_update: Optional[Callable] = None
+    on_delete: Optional[Callable] = None
+
+
+@dataclass
+class APIServer:
+    """Object store + watch fan-out."""
+
+    pods: dict[str, Pod] = field(default_factory=dict)
+    nodes: dict[str, Node] = field(default_factory=dict)
+    workloads: dict[str, Workload] = field(default_factory=dict)
+    namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
+    pod_handlers: list[WatchHandlers] = field(default_factory=list)
+    node_handlers: list[WatchHandlers] = field(default_factory=list)
+    binding_count: int = 0
+
+    # -- watch registration ---------------------------------------------------
+
+    def watch_pods(self, h: WatchHandlers) -> None:
+        self.pod_handlers.append(h)
+
+    def watch_nodes(self, h: WatchHandlers) -> None:
+        self.node_handlers.append(h)
+
+    # -- pods -----------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        if pod.uid in self.pods:
+            raise Conflict(f"pod {pod.uid} exists")
+        self.pods[pod.uid] = pod
+        for h in self.pod_handlers:
+            if h.on_add:
+                h.on_add(pod)
+        return pod
+
+    def update_pod(self, pod: Pod) -> Pod:
+        old = self.pods.get(pod.uid)
+        if old is None:
+            raise NotFound(pod.uid)
+        self.pods[pod.uid] = pod
+        for h in self.pod_handlers:
+            if h.on_update:
+                h.on_update(old, pod)
+        return pod
+
+    def delete_pod(self, uid: str) -> None:
+        pod = self.pods.pop(uid, None)
+        if pod is None:
+            raise NotFound(uid)
+        for h in self.pod_handlers:
+            if h.on_delete:
+                h.on_delete(pod)
+
+    def get_pod(self, uid: str) -> Pod:
+        pod = self.pods.get(uid)
+        if pod is None:
+            raise NotFound(uid)
+        return pod
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """POST pods/<name>/binding (reference default_binder.go:51 →
+        registry/core/pod/storage BindingREST: sets spec.nodeName, fails on
+        conflict if already bound to a different node)."""
+        current = self.pods.get(pod.uid)
+        if current is None:
+            raise NotFound(pod.uid)
+        if current.spec.node_name and current.spec.node_name != node_name:
+            raise Conflict(
+                f"pod {pod.uid} is already assigned to node {current.spec.node_name}")
+        if node_name not in self.nodes:
+            raise NotFound(f"node {node_name}")
+        old = current
+        new = current.clone()
+        new.spec.node_name = node_name
+        new.status.phase = "Running"
+        self.pods[pod.uid] = new
+        self.binding_count += 1
+        for h in self.pod_handlers:
+            if h.on_update:
+                h.on_update(old, new)
+
+    def patch_pod_status(self, pod: Pod, condition: dict,
+                         nominated_node_name: str = "") -> None:
+        current = self.pods.get(pod.uid)
+        if current is None:
+            raise NotFound(pod.uid)
+        conditions = [c for c in current.status.conditions
+                      if c.get("type") != condition.get("type")]
+        conditions.append(condition)
+        current.status.conditions = conditions
+        if nominated_node_name:
+            current.status.nominated_node_name = nominated_node_name
+
+    # -- nodes ----------------------------------------------------------------
+
+    def create_node(self, node: Node) -> Node:
+        if node.name in self.nodes:
+            raise Conflict(node.name)
+        self.nodes[node.name] = node
+        for h in self.node_handlers:
+            if h.on_add:
+                h.on_add(node)
+        return node
+
+    def update_node(self, node: Node) -> Node:
+        old = self.nodes.get(node.name)
+        if old is None:
+            raise NotFound(node.name)
+        self.nodes[node.name] = node
+        for h in self.node_handlers:
+            if h.on_update:
+                h.on_update(old, node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node is None:
+            raise NotFound(name)
+        for h in self.node_handlers:
+            if h.on_delete:
+                h.on_delete(node)
+
+    # -- workloads (gang API) -------------------------------------------------
+
+    def create_workload(self, w: Workload) -> Workload:
+        self.workloads[w.metadata.name] = w
+        return w
+
+    def get_workload(self, name: str) -> Optional[Workload]:
+        return self.workloads.get(name)

@@ -1,0 +1,387 @@
+"""The Scheduler: host orchestrator around the batched device program.
+
+Mirrors pkg/scheduler/scheduler.go (struct :74, New :282, Run :538) and
+schedule_one.go, with one structural change (SURVEY §7): the serial
+`ScheduleOne` loop becomes `schedule_pending`, which drains the whole activeQ
+and assigns it in device-sized batches — one `run_batch` call per segment —
+while pods whose constraints have no tensor form yet fall back to the host
+oracle (`schedule_one_host`) in queue order, preserving the sequential-greedy
+semantics end to end.
+
+Cycle anatomy per batch (device segment):
+  update_snapshot (incremental, cache.go:194) → apply_snapshot scatter →
+  run_batch scan (ops/program.py) → per pod: assume (cache.go:369) +
+  enqueue bind (api_dispatcher) | handleSchedulingFailure
+  (schedule_one.go:1038) → adopt carry → flush dispatcher.
+
+Bind failures forget the assumed pod and requeue (schedule_one.go:361-393).
+Informer events feed the cache/queue exactly like eventhandlers.go and fire
+MoveAllToActiveOrBackoffQueue with the matching ClusterEvent.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from .api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
+from .backend.apiserver import APIServer, WatchHandlers
+from .backend.cache import Cache, Snapshot
+from .backend.dispatcher import APICall, APIDispatcher, CallType
+from .backend.queue import ClusterEventWithHint, SchedulingQueue
+from .framework.interface import CycleState, Status
+from .framework.runtime import Framework, schedule_pod
+from .framework.types import (ActionType, ClusterEvent, EventResource,
+                              FitError, PodInfo, QueuedPodInfo)
+from .ops.program import (ScoreConfig, initial_carry, pod_rows_from_batch,
+                          run_batch)
+from .plugins import noderesources as nr
+from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
+                                  PrioritySort, SchedulingGates,
+                                  TaintToleration)
+from .plugins.imagelocality import ImageLocality
+from .plugins.interpodaffinity import InterPodAffinity
+from .plugins.nodeaffinity import NodeAffinity
+from .plugins.podtopologyspread import PodTopologySpread
+from .state.batch import BatchBuilder, BatchDims
+from .state.tensorize import ClusterState
+
+EVENT_NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
+EVENT_NODE_UPDATE = ClusterEvent(EventResource.NODE, ActionType.UPDATE)
+EVENT_ASSIGNED_POD_DELETE = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)
+EVENT_ASSIGNED_POD_ADD = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD)
+EVENT_POD_UPDATE = ClusterEvent(EventResource.POD, ActionType.UPDATE)
+
+# default plugin weights (apis/config/v1/default_plugins.go:30-93)
+DEFAULT_WEIGHTS = {
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}
+
+
+def default_plugins(client=None, ns_lister=None) -> list:
+    from .plugins.defaultbinder import DefaultBinder
+    plugins = [
+        SchedulingGates(), PrioritySort(), NodeUnschedulable(), NodeName(),
+        TaintToleration(), NodeAffinity(), NodePorts(), nr.Fit(),
+        nr.BalancedAllocation(), PodTopologySpread(),
+        InterPodAffinity(ns_lister=ns_lister), ImageLocality(),
+    ]
+    if client is not None:
+        plugins.append(DefaultBinder(client))
+    return plugins
+
+
+@dataclass
+class Profile:
+    name: str = DEFAULT_SCHEDULER_NAME
+    framework: Optional[Framework] = None
+    score_config: ScoreConfig = ScoreConfig()
+
+
+class Scheduler:
+    """scheduler.Scheduler (scheduler.go:74)."""
+
+    def __init__(self, client: APIServer,
+                 profiles: Optional[list[Profile]] = None,
+                 batch_size: int = 512,
+                 batch_dims: Optional[BatchDims] = None,
+                 clock: Callable[[], float] = _time.monotonic,
+                 percentage_of_nodes_to_score: int = 100):
+        self.client = client
+        self.clock = clock
+        self.batch_size = batch_size
+        if profiles is None:
+            fwk = Framework(DEFAULT_SCHEDULER_NAME, default_plugins(client),
+                            weights=dict(DEFAULT_WEIGHTS))
+            profiles = [Profile(framework=fwk)]
+        self.profiles: dict[str, Profile] = {p.name: p for p in profiles}
+
+        self.cache = Cache(clock=clock)
+        self.snapshot = Snapshot()
+        self.state = ClusterState()
+        self.builder = BatchBuilder(self.state, batch_dims)
+        self.dispatcher = APIDispatcher(
+            client=client, on_bind_error=self._on_bind_error)
+
+        default_fwk = next(iter(self.profiles.values())).framework
+        self.queue = SchedulingQueue(
+            pre_enqueue=default_fwk.run_pre_enqueue_plugins,
+            queueing_hints=self._build_queueing_hints(default_fwk),
+            clock=clock)
+
+        self._register_event_handlers()
+        # stats (metrics/metrics.go essentials; full registry in metrics/)
+        self.schedule_attempts = 0
+        self.scheduled_count = 0
+        self.unschedulable_count = 0
+        self.error_count = 0
+        self.device_batches = 0
+        self.host_scheduled = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    @staticmethod
+    def _build_queueing_hints(fwk: Framework) -> dict[str, list[ClusterEventWithHint]]:
+        hints: dict[str, list[ClusterEventWithHint]] = {}
+        for p in fwk.plugins:
+            if hasattr(p, "events_to_register"):
+                hints[p.name()] = list(p.events_to_register())
+        return hints
+
+    def _register_event_handlers(self) -> None:
+        """eventhandlers.go:499 addAllEventHandlers."""
+        self.client.watch_pods(WatchHandlers(
+            on_add=self._on_pod_add, on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete))
+        self.client.watch_nodes(WatchHandlers(
+            on_add=self._on_node_add, on_update=self._on_node_update,
+            on_delete=self._on_node_delete))
+
+    def _responsible(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name in self.profiles
+
+    # -- event handlers (eventhandlers.go) ------------------------------------
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.add_pod(pod)
+            self.queue.move_all_to_active_or_backoff_queue(
+                EVENT_ASSIGNED_POD_ADD, None, pod)
+        elif self._responsible(pod):
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if new.spec.node_name:
+            if old.spec.node_name:
+                self.cache.update_pod(old, new)
+            else:
+                # became bound (possibly our own bind echo): confirm
+                self.cache.add_pod(new)
+                self.queue.delete(new)
+                self.queue.move_all_to_active_or_backoff_queue(
+                    EVENT_ASSIGNED_POD_ADD, old, new)
+        elif self._responsible(new):
+            self.queue.update(old, new)
+            self.queue.move_all_to_active_or_backoff_queue(
+                EVENT_POD_UPDATE, old, new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.remove_pod(pod)
+            self.queue.move_all_to_active_or_backoff_queue(
+                EVENT_ASSIGNED_POD_DELETE, pod, None)
+        else:
+            self.queue.delete(pod)
+
+    def _on_node_add(self, node: Node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active_or_backoff_queue(EVENT_NODE_ADD, None, node)
+
+    def _on_node_update(self, old: Node, new: Node) -> None:
+        self.cache.update_node(old, new)
+        self.queue.move_all_to_active_or_backoff_queue(EVENT_NODE_UPDATE, old, new)
+
+    def _on_node_delete(self, node: Node) -> None:
+        self.cache.remove_node(node)
+
+    # -- scheduling: batch path ----------------------------------------------
+
+    def schedule_pending(self, max_batches: int = 0) -> int:
+        """Drain + schedule everything currently pending. Returns #bound."""
+        scheduled = 0
+        batches = 0
+        while True:
+            qpis = self.queue.drain(self.batch_size)
+            if not qpis:
+                break
+            scheduled += self._schedule_batch(qpis)
+            self.dispatcher.flush()
+            batches += 1
+            if max_batches and batches >= max_batches:
+                break
+        return scheduled
+
+    def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
+        pods = [q.pod for q in qpis]
+        batch = self.builder.build(pods)
+        fallback = batch.host_fallback
+        bound = 0
+        i = 0
+        while i < len(qpis):
+            if fallback[i]:
+                bound += 1 if self._schedule_one_host(qpis[i]) else 0
+                i += 1
+                continue
+            j = i + 1
+            while j < len(qpis) and not fallback[j]:
+                j += 1
+            bound += self._schedule_device_segment(qpis[i:j])
+            i = j
+        return bound
+
+    def _schedule_device_segment(self, qpis: list[QueuedPodInfo]) -> int:
+        profile = next(iter(self.profiles.values()))
+        self.cache.update_snapshot(self.snapshot)
+        self.state.apply_snapshot(self.snapshot)
+        segment_batch = self.builder.build([q.pod for q in qpis])
+        na = self.state.device_arrays()
+        carry, assignments = run_batch(profile.score_config, na,
+                                       initial_carry(na),
+                                       pod_rows_from_batch(segment_batch))
+        assignments = np.asarray(assignments)[:len(qpis)]
+        self.device_batches += 1
+        bound = 0
+        for qpi, a in zip(qpis, assignments):
+            self.schedule_attempts += 1
+            if a >= 0:
+                node_name = self.state.node_names[int(a)]
+                self._assume_and_bind(qpi, node_name)
+                bound += 1
+            else:
+                self._handle_failure(qpi, self._device_fit_error(qpi))
+        self.state.adopt_carry(carry.used, carry.nonzero_used,
+                               carry.npods, carry.ports)
+        return bound
+
+    def _device_fit_error(self, qpi: QueuedPodInfo) -> FitError:
+        """Device reports only infeasibility; attribute to the plugins whose
+        constraints the pod carries so queueing hints stay precise enough."""
+        err = FitError(qpi.pod, len(self.snapshot.node_info_list))
+        plugins = {"NodeResourcesFit"}
+        spec = qpi.pod.spec
+        if spec.node_selector or (spec.affinity and spec.affinity.node_affinity):
+            plugins.add("NodeAffinity")
+        if spec.node_name:
+            plugins.add("NodeName")
+        if any(p.host_port > 0 for c in spec.containers for p in c.ports):
+            plugins.add("NodePorts")
+        err.diagnosis.unschedulable_plugins = plugins
+        return err
+
+    # -- scheduling: host path (oracle + fallback) ----------------------------
+
+    def schedule_one(self) -> bool:
+        """Reference ScheduleOne: pop + host-schedule a single pod."""
+        qpi = self.queue.pop()
+        if qpi is None:
+            return False
+        ok = self._schedule_one_host(qpi)
+        self.dispatcher.flush()
+        return ok
+
+    def _schedule_one_host(self, qpi: QueuedPodInfo) -> bool:
+        self.schedule_attempts += 1
+        pod = qpi.pod
+        profile = self.profiles.get(pod.spec.scheduler_name)
+        if profile is None:
+            self.queue.done(pod.uid)
+            return False
+        if self._skip_pod_schedule(pod):
+            self.queue.done(pod.uid)
+            return False
+        self.cache.update_snapshot(self.snapshot)
+        state = CycleState()
+        try:
+            result = schedule_pod(profile.framework, state, pod,
+                                  self.snapshot.node_info_list)
+        except FitError as err:
+            self._handle_failure(qpi, err, state)
+            return False
+        except Exception:
+            qpi.consecutive_errors_count += 1
+            self.error_count += 1
+            self.queue.add_unschedulable_if_not_present(qpi)
+            return False
+        self.host_scheduled += 1
+        self._assume_and_bind(qpi, result.suggested_host, state)
+        return True
+
+    def _skip_pod_schedule(self, pod: Pod) -> bool:
+        """schedule_one.go:404: deleted or already-assumed pods."""
+        return self.cache.is_assumed_pod(pod)
+
+    # -- assume + bind (shared) -----------------------------------------------
+
+    def _assume_and_bind(self, qpi: QueuedPodInfo,
+                         node_name: str,
+                         state: Optional[CycleState] = None) -> None:
+        pod = qpi.pod
+        assumed = pod.clone()
+        assumed.spec.node_name = node_name
+        try:
+            self.cache.assume_pod(assumed)
+        except KeyError:
+            self.queue.done(pod.uid)
+            return
+        self.queue.nominator.delete(pod)
+        profile = self.profiles.get(pod.spec.scheduler_name)
+        fwk = profile.framework
+        cs = state or CycleState()
+        status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
+        if not status.is_success():
+            fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qpi, FitError(pod, 0))
+            return
+        status = fwk.run_permit_plugins(cs, assumed, node_name)
+        if status.is_rejected():
+            fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qpi, FitError(pod, 0))
+            return
+        # Wait status (gang quorum) parks the pod; WaitOnPermit resolves at
+        # flush time via the workload manager (gang plugin allows all).
+        self.queue.done(pod.uid)
+        self.cache.finish_binding(assumed)
+        self.dispatcher.add(APICall(CallType.BIND, assumed, node_name=node_name))
+        self.scheduled_count += 1
+        qpi.unschedulable_plugins = set()
+        qpi.consecutive_errors_count = 0
+
+    def _on_bind_error(self, pod: Pod, node_name: str, err: Exception) -> None:
+        """schedule_one.go:361-393: forget + requeue via AssignedPodDelete."""
+        self.scheduled_count -= 1
+        self.error_count += 1
+        try:
+            self.cache.forget_pod(pod)
+        except (KeyError, ValueError):
+            pass
+        fresh = pod.clone()
+        fresh.spec.node_name = ""
+        self.queue.add(fresh)
+        self.queue.move_all_to_active_or_backoff_queue(
+            EVENT_ASSIGNED_POD_DELETE, pod, None)
+
+    # -- failure path ---------------------------------------------------------
+
+    def _handle_failure(self, qpi: QueuedPodInfo, err: FitError,
+                        state: Optional[CycleState] = None) -> None:
+        """schedule_one.go:1038 handleSchedulingFailure (PostFilter/preemption
+        wired in plugins/preemption integration)."""
+        self.unschedulable_count += 1
+        qpi.unschedulable_plugins = set(err.diagnosis.unschedulable_plugins)
+        qpi.pending_plugins = set(err.diagnosis.pending_plugins)
+        self.queue.add_unschedulable_if_not_present(qpi)
+        self.dispatcher.add(APICall(
+            CallType.STATUS_PATCH, qpi.pod,
+            condition={"type": "PodScheduled", "status": "False",
+                       "reason": "Unschedulable", "message": str(err)}))
+
+    # -- housekeeping ---------------------------------------------------------
+
+    def flush_queues(self) -> None:
+        """SchedulingQueue.Run periodic work (scheduling_queue.go:406-413)."""
+        self.queue.flush_backoff_completed()
+        self.queue.flush_unschedulable_leftover()
+
+    def pending_summary(self) -> str:
+        return self.queue.pending_pods()[1]

@@ -102,6 +102,9 @@ class BatchBuilder:
         d = self.dims
         B = pow2_at_least(len(pods))
         R = self.state.dims.resources
+        arrays = self.state.arrays
+        self._cluster_has_images = bool(
+            arrays is not None and arrays.image_id.any())
         batch = _zero_batch(B, R, d)
 
         for i, pod in enumerate(pods):
@@ -121,6 +124,16 @@ class BatchBuilder:
     def _fill_row(self, b: PodBatch, i: int, pod: Pod) -> None:
         d = self.dims
         intr = self.state.interner
+        # constraints the device program doesn't cover yet → host oracle
+        # (group tensors for spread/interpod land in ops/groups.py)
+        aff = pod.spec.affinity
+        if pod.spec.topology_spread_constraints:
+            raise BatchCapacityError("topology spread: host path")
+        if aff and (aff.pod_affinity or aff.pod_anti_affinity):
+            raise BatchCapacityError("inter-pod affinity: host path")
+        if self._cluster_has_images and any(
+                c.image for c in pod.spec.containers + pod.spec.init_containers):
+            raise BatchCapacityError("image locality: host path")
         # resources
         reqs = res.pod_requests(pod)
         row = self.state.rtable.vector(reqs)
