@@ -135,9 +135,9 @@ def _match_node_inclusion_policies(c: _Constraint, pod: Pod, node_info: NodeInfo
         if not required_node_affinity_matches(pod, node.metadata.labels, node.name):
             return False
     if c.node_taints_policy == HONOR:
-        do_not_schedule = [t for t in node.spec.taints
-                           if t.effect in ("NoSchedule", "NoExecute")]
-        if find_matching_untolerated_taint(do_not_schedule, pod.spec.tolerations) is not None:
+        if find_matching_untolerated_taint(
+                node.spec.taints, pod.spec.tolerations,
+                ("NoSchedule", "NoExecute")) is not None:
             return False
     return True
 

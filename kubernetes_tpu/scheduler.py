@@ -196,29 +196,37 @@ class Scheduler:
     # -- scheduling: batch path ----------------------------------------------
 
     def schedule_pending(self, max_batches: int = 0) -> int:
-        """Drain + schedule everything currently pending. Returns #bound."""
-        scheduled = 0
+        """Drain + schedule everything currently pending. Returns the net
+        number of successful binds (flush failures are not counted)."""
+        start = self.scheduled_count
         batches = 0
         while True:
             qpis = self.queue.drain(self.batch_size)
             if not qpis:
                 break
-            scheduled += self._schedule_batch(qpis)
+            self._schedule_batch(qpis)
             self.dispatcher.flush()
             batches += 1
             if max_batches and batches >= max_batches:
                 break
-        return scheduled
+        return self.scheduled_count - start
 
     def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
         pods = [q.pod for q in qpis]
-        batch = self.builder.build(pods)
+        self.cache.update_snapshot(self.snapshot)
+        batch = self.builder.build(pods, snapshot=self.snapshot)
         fallback = batch.host_fallback
         bound = 0
         i = 0
         while i < len(qpis):
             if fallback[i]:
+                pod = qpis[i].pod
                 bound += 1 if self._schedule_one_host(qpis[i]) else 0
+                aff = pod.spec.affinity
+                if aff and (aff.pod_affinity or aff.pod_anti_affinity):
+                    # the bind just introduced (anti-)affinity pods into the
+                    # cluster; later pods in this batch lose device eligibility
+                    fallback[i + 1:] = True
                 i += 1
                 continue
             j = i + 1
@@ -348,7 +356,11 @@ class Scheduler:
         qpi.consecutive_errors_count = 0
 
     def _on_bind_error(self, pod: Pod, node_name: str, err: Exception) -> None:
-        """schedule_one.go:361-393: forget + requeue via AssignedPodDelete."""
+        """schedule_one.go:361-393: forget + requeue via the failure handler.
+
+        The requeue MUST apply error backoff (consecutive_errors_count) — a
+        straight activeQ re-add livelocks schedule_pending when the bind
+        error is persistent (drain → bind fail → re-add → drain ...)."""
         self.scheduled_count -= 1
         self.error_count += 1
         try:
@@ -357,7 +369,10 @@ class Scheduler:
             pass
         fresh = pod.clone()
         fresh.spec.node_name = ""
-        self.queue.add(fresh)
+        qpi = QueuedPodInfo(pod_info=PodInfo.of(fresh),
+                            timestamp=self.clock(),
+                            consecutive_errors_count=1)
+        self.queue.add_unschedulable_if_not_present(qpi)
         self.queue.move_all_to_active_or_backoff_queue(
             EVENT_ASSIGNED_POD_DELETE, pod, None)
 

@@ -97,14 +97,26 @@ class BatchBuilder:
     def __init__(self, state: ClusterState, dims: Optional[BatchDims] = None):
         self.state = state
         self.dims = dims or BatchDims()
+        self._cluster_has_images = False
+        self._cluster_has_affinity_pods = False
 
-    def build(self, pods: list[Pod]) -> PodBatch:
+    def build(self, pods: list[Pod], snapshot=None) -> PodBatch:
         d = self.dims
         B = pow2_at_least(len(pods))
         R = self.state.dims.resources
         arrays = self.state.arrays
         self._cluster_has_images = bool(
             arrays is not None and arrays.image_id.any())
+        # InterPodAffinity is symmetric: existing pods carrying required
+        # anti-affinity can veto ANY incoming pod (filtering.go:204-228), and
+        # existing pods with (anti-)affinity terms feed the score of ANY
+        # incoming pod (scoring.go:81-124). Until those count tensors ride the
+        # scan carry (ops/groups.py), the whole batch must take the host path
+        # whenever such pods exist anywhere in the cluster.
+        self._cluster_has_affinity_pods = bool(
+            snapshot is not None
+            and (snapshot.have_pods_with_affinity_list
+                 or snapshot.have_pods_with_required_anti_affinity_list))
         batch = _zero_batch(B, R, d)
 
         for i, pod in enumerate(pods):
@@ -131,6 +143,9 @@ class BatchBuilder:
             raise BatchCapacityError("topology spread: host path")
         if aff and (aff.pod_affinity or aff.pod_anti_affinity):
             raise BatchCapacityError("inter-pod affinity: host path")
+        if self._cluster_has_affinity_pods:
+            raise BatchCapacityError(
+                "cluster has (anti-)affinity pods: host path")
         if self._cluster_has_images and any(
                 c.image for c in pod.spec.containers + pod.spec.init_containers):
             raise BatchCapacityError("image locality: host path")

@@ -169,6 +169,7 @@ class ClusterState:
                     self._free.append(idx)
                 continue
             self._write_row(self._slot(name), ni)
+        snapshot.dirty_nodes.clear()
         self._device_dirty = True
 
     def _write_row(self, idx: int, ni: NodeInfo) -> None:

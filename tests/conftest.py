@@ -8,9 +8,24 @@ dryrun_multichip uses).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unconditionally: the ambient environment may pin JAX to a real
+# accelerator (e.g. a tunneled TPU), and running the suite there pays a remote
+# compile per distinct shape — the round-1 "recompilation storm" was exactly
+# this. Parity/semantics tests are platform-independent; bench.py is the TPU
+# path. The env var alone is NOT enough: accelerator site hooks may call
+# jax.config.update("jax_platforms", ...) at interpreter start, so we update
+# the config directly after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: repeated suite runs skip identical compiles.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -164,3 +164,45 @@ class TestScore:
         pl = PodTopologySpread()
         status = pl.pre_score(CycleState(), pod, list(nodes.values()))
         assert status.is_skip()
+
+
+class TestNodeTaintsPolicyHonor:
+    """nodeTaintsPolicy: Honor (common.go:43-57) — tainted nodes are excluded
+    from the count domains and from feasibility. Round-1 regression: this
+    path crashed with a TypeError."""
+
+    def test_honor_excludes_tainted_node(self):
+        from kubernetes_tpu.api.types import (TopologySpreadConstraint,
+                                              LabelSelector, Taint)
+        nodes = mk_cluster()
+        nodes["node-a"].node.spec.taints.append(
+            Taint(key="dedicated", value="gpu", effect="NoSchedule"))
+        pod = make_pod("incoming").label("foo", "").obj()
+        pod.spec.topology_spread_constraints.append(TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_HOSTNAME,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"foo": ""}),
+            node_taints_policy="Honor"))
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        # must not crash; tainted node-a is excluded from domains but the
+        # other three hosts are feasible (0 pods everywhere → skew ok)
+        assert statuses["node-b"].is_success()
+        assert statuses["node-x"].is_success()
+        assert statuses["node-y"].is_success()
+
+    def test_honor_with_toleration_keeps_node(self):
+        from kubernetes_tpu.api.types import (TopologySpreadConstraint,
+                                              LabelSelector, Taint)
+        nodes = mk_cluster()
+        nodes["node-a"].node.spec.taints.append(
+            Taint(key="dedicated", value="gpu", effect="NoSchedule"))
+        pod = (make_pod("incoming").label("foo", "")
+               .toleration(key="dedicated", operator="Equal", value="gpu",
+                           effect="NoSchedule").obj())
+        pod.spec.topology_spread_constraints.append(TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_HOSTNAME,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector.of({"foo": ""}),
+            node_taints_policy="Honor"))
+        statuses, _ = run_filter(PodTopologySpread(), pod, nodes)
+        assert statuses["node-a"].is_success()

@@ -136,3 +136,59 @@ class TestChurn:
         # cache and device state agree at the end
         sched.cache.update_snapshot(sched.snapshot)
         assert sched.state.reconcile(sched.snapshot) == []
+
+
+class TestAffinityParityRouting:
+    """Regression for the round-1 parity bug: existing cluster pods with
+    (anti-)affinity must disable the device path for ALL incoming pods —
+    InterPodAffinity is symmetric (filtering.go:204-228, scoring.go:81-124)."""
+
+    def test_existing_anti_affinity_blocks_incoming_plain_pod(self):
+        # one node in zone z0 hosting a pod with required anti-affinity on
+        # app=web; an incoming plain app=web pod must be UNSCHEDULABLE
+        api, sched = mk(n_nodes=1)
+        guard = (make_pod("guard").label("app", "other")
+                 .pod_affinity("topology.kubernetes.io/zone", {"app": "web"},
+                               anti=True)
+                 .req({"cpu": "100m"}).obj())
+        api.create_pod(guard)
+        assert sched.schedule_pending() == 1
+        incoming = make_pod("victim").label("app", "web").req({"cpu": "100m"}).obj()
+        api.create_pod(incoming)
+        assert sched.schedule_pending() == 0
+        assert not api.pods["default/victim"].spec.node_name
+        assert len(sched.queue.unschedulable_pods) == 1
+
+    def test_existing_affinity_pod_forces_host_path(self):
+        api, sched = mk(n_nodes=2)
+        guard = (make_pod("guard").label("app", "db")
+                 .preferred_pod_affinity("topology.kubernetes.io/zone",
+                                         {"app": "web"}, weight=100, anti=True)
+                 .req({"cpu": "100m"}).obj())
+        api.create_pod(guard)
+        sched.schedule_pending()
+        before = sched.host_scheduled
+        api.create_pod(make_pod("plain").label("app", "web").req({"cpu": "100m"}).obj())
+        assert sched.schedule_pending() == 1
+        # the plain pod must have gone through the host oracle, not the device
+        assert sched.host_scheduled == before + 1
+
+    def test_host_bound_affinity_pod_flips_rest_of_batch(self):
+        # within one drained batch: a fallback (anti-affinity) pod scheduled on
+        # host makes the remaining queued pods lose device eligibility
+        api, sched = mk(n_nodes=2)
+        api.create_pod(make_pod("a-guard").label("app", "other")
+                       .pod_affinity("topology.kubernetes.io/zone",
+                                     {"app": "web"}, anti=True)
+                       .req({"cpu": "100m"}).obj())
+        api.create_pod(make_pod("b-web").label("app", "web").req({"cpu": "100m"}).obj())
+        bound = sched.schedule_pending()
+        # guard binds; b-web must be blocked in every zone (both nodes share
+        # no zone split? n0=z0,n1=z1 — anti-affinity only blocks guard's zone)
+        assert bound >= 1
+        web = api.pods["default/b-web"]
+        guard_node = api.pods["default/a-guard"].spec.node_name
+        if web.spec.node_name:
+            # must have landed in the other zone, via the host path
+            zone_of = {"n0": "z0", "n1": "z1"}
+            assert zone_of[web.spec.node_name] != zone_of[guard_node]
