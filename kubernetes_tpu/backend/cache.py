@@ -302,10 +302,7 @@ class Cache:
         """Incremental: walk the generation list head-first, stop at the first
         item whose generation ≤ snapshot.generation; rebuild the flat lists
         only when membership changed."""
-        # dirty_nodes ACCUMULATES across update_snapshot calls: the tensorized
-        # state (ClusterState.apply_snapshot) is a second consumer that may
-        # apply less often than the host snapshot refreshes; it clears the set
-        # when it scatter-writes the rows.
+        snapshot.dirty_nodes = set()
         update_all = False
         item = self.head
         latest = item.info.generation if item else snapshot.generation

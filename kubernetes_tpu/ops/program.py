@@ -215,9 +215,15 @@ def balanced_allocation(cap, used_cols):
     return jnp.floor((1.0 - std) * MAX_SCORE + 1e-9).astype(jnp.int64)
 
 
-def default_normalize(scores, feasible, reverse: bool):
-    """plugins/helper DefaultNormalizeScore over the feasible set."""
+def default_normalize(scores, feasible, reverse: bool, axis: str | None = None):
+    """plugins/helper DefaultNormalizeScore over the feasible set.
+
+    `axis`: mesh axis name when the node dimension is sharded — the max must
+    be GLOBAL across shards or normalization denominators diverge per device
+    (parallel/sharding.py)."""
     maxc = jnp.max(jnp.where(feasible, scores, 0))
+    if axis is not None:
+        maxc = lax.pmax(maxc, axis)
     scaled = jnp.where(maxc > 0, scores * MAX_SCORE // jnp.maximum(maxc, 1),
                        jnp.where(reverse, MAX_SCORE, scores))
     if reverse:
@@ -286,8 +292,10 @@ def pod_rows_from_batch(batch) -> PodRow:
     )
 
 
-def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
-    """Feasibility + total score for one pod over all nodes → (mask, score)."""
+def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
+              axis: str | None = None):
+    """Feasibility + total score for one pod over all nodes → (mask, score).
+    `axis` names the mesh axis when `na`/`carry` hold one node shard."""
     cols = jnp.array(cfg.score_cols, jnp.int32)
 
     # ---- filters ----
@@ -311,8 +319,10 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
     used_bal = carry.used[:, cols] + pod.req[cols][None, :]
     s_bal = jnp.where(pod.skip_balanced, 0, balanced_allocation(cap_cols, used_bal))
 
-    s_taint = default_normalize(taint_prefer_count(na, pod), m, reverse=True)
-    s_na = default_normalize(preferred_affinity_score(na, pod), m, reverse=False)
+    s_taint = default_normalize(taint_prefer_count(na, pod), m,
+                                reverse=True, axis=axis)
+    s_na = default_normalize(preferred_affinity_score(na, pod), m,
+                             reverse=False, axis=axis)
 
     total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
              + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)

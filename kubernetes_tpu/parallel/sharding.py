@@ -1,0 +1,99 @@
+"""Node-axis sharding: the scheduler's long axis distributed over a device mesh.
+
+The reference scales its node axis with adaptive sampling + √n-chunked
+parallel iteration (SURVEY §2.6); the TPU design shards the node axis of the
+tensorized cluster state over a `jax.sharding.Mesh` instead. Every filter and
+score kernel in ops/program.py is row-independent over nodes, so the per-pod
+evaluation runs unchanged on each shard; only the argmax and the carry update
+need cross-device communication:
+
+  local masked-score → local argmax → `lax.pmax` of the best score →
+  `lax.pmin` of the global index among shards holding that score (this
+  reproduces the single-device "first max index" tie-break exactly) →
+  each shard applies the placement only if the winning row is local.
+
+Two scalar collectives per pod step, riding ICI. The assignments stream is
+replicated; the carry stays sharded. `run_batch_sharded` therefore returns
+bit-identical assignments to `ops.program.run_batch` (asserted in
+tests/test_sharding.py) while holding 1/D of the node state per device —
+the "long-context" scaling story of SURVEY §5.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.program import (Carry, PodRow, ScoreConfig, _apply_assignment,
+                           _eval_pod)
+from ..state.tensorize import NodeArrays
+
+NODE_AXIS = "nodes"
+
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the node axis."""
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    import numpy as np
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def _sharded_step(cfg: ScoreConfig, axis: str, na_l: NodeArrays,
+                  offset: jnp.ndarray, c: Carry, pod: PodRow):
+    """One pod placement on a node shard. Collectives: pmax + pmin."""
+    n_local = na_l.cap.shape[0]
+    mask, score = _eval_pod(cfg, na_l, c, pod, axis=axis)
+    masked = jnp.where(mask, score, -1)
+    lbest = jnp.argmax(masked).astype(jnp.int32)
+    lscore = masked[lbest]
+    gscore = lax.pmax(lscore, axis)
+    # global "first max index" tie-break == single-device argmax semantics
+    cand = jnp.where(lscore == gscore, offset + lbest, _INT_MAX)
+    gbest = lax.pmin(cand, axis)
+    assigned = (gscore >= 0) & pod.valid
+    lidx = gbest - offset
+    in_shard = (lidx >= 0) & (lidx < n_local)
+    lidx_safe = jnp.clip(lidx, 0, n_local - 1).astype(jnp.int32)
+    c2 = _apply_assignment(c, pod, lidx_safe, assigned & in_shard)
+    return c2, jnp.where(assigned, gbest, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
+                      carry: Carry, pods: PodRow):
+    """`ops.program.run_batch` with the node axis sharded over `mesh`.
+
+    N (the padded node count) must be divisible by the mesh size; the
+    pow-of-two padding of ClusterState guarantees this for pow-of-two
+    meshes. Returns (final sharded carry, replicated assignments[B]).
+    """
+    node_sharded_na = NodeArrays(*(P(NODE_AXIS) for _ in na))
+    node_sharded_carry = Carry(*(P(NODE_AXIS) for _ in carry))
+    replicated_pods = PodRow(*(P() for _ in pods))
+
+    def local(na_l: NodeArrays, carry_l: Carry, pods_r: PodRow):
+        n_local = na_l.cap.shape[0]
+        offset = (lax.axis_index(NODE_AXIS) * n_local).astype(jnp.int32)
+        step = functools.partial(_sharded_step, cfg, NODE_AXIS, na_l, offset)
+        return lax.scan(step, carry_l, pods_r)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(node_sharded_na, node_sharded_carry, replicated_pods),
+        out_specs=(node_sharded_carry, P()),
+        check_vma=False)
+    return fn(na, carry, pods)
+
+
+def shard_node_arrays(mesh: Mesh, na: NodeArrays) -> NodeArrays:
+    """Place the staging arrays onto the mesh, node axis split."""
+    spec = NamedSharding(mesh, P(NODE_AXIS))
+    return NodeArrays(*(jax.device_put(jnp.asarray(x), spec) for x in na))

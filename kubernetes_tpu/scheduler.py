@@ -126,6 +126,8 @@ class Scheduler:
         self.error_count = 0
         self.device_batches = 0
         self.host_scheduled = 0
+        # per-pod consecutive bind-error count → escalating error backoff
+        self._bind_errors: dict[str, int] = {}
 
     # -- wiring ---------------------------------------------------------------
 
@@ -165,6 +167,7 @@ class Scheduler:
                 self.cache.update_pod(old, new)
             else:
                 # became bound (possibly our own bind echo): confirm
+                self._bind_errors.pop(new.uid, None)
                 self.cache.add_pod(new)
                 self.queue.delete(new)
                 self.queue.move_all_to_active_or_backoff_queue(
@@ -175,6 +178,7 @@ class Scheduler:
                 EVENT_POD_UPDATE, old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        self._bind_errors.pop(pod.uid, None)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
             self.queue.move_all_to_active_or_backoff_queue(
@@ -221,9 +225,10 @@ class Scheduler:
         while i < len(qpis):
             if fallback[i]:
                 pod = qpis[i].pod
-                bound += 1 if self._schedule_one_host(qpis[i]) else 0
+                ok = self._schedule_one_host(qpis[i])
+                bound += 1 if ok else 0
                 aff = pod.spec.affinity
-                if aff and (aff.pod_affinity or aff.pod_anti_affinity):
+                if ok and aff and (aff.pod_affinity or aff.pod_anti_affinity):
                     # the bind just introduced (anti-)affinity pods into the
                     # cluster; later pods in this batch lose device eligibility
                     fallback[i + 1:] = True
@@ -240,7 +245,13 @@ class Scheduler:
         profile = next(iter(self.profiles.values()))
         self.cache.update_snapshot(self.snapshot)
         self.state.apply_snapshot(self.snapshot)
-        segment_batch = self.builder.build([q.pod for q in qpis])
+        segment_batch = self.builder.build([q.pod for q in qpis],
+                                           snapshot=self.snapshot)
+        if segment_batch.host_fallback.any():
+            # state moved between routing and segment build (e.g. a node
+            # update surfaced images, or a host bind introduced affinity
+            # pods): honor queue order and let the oracle take the segment
+            return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         na = self.state.device_arrays()
         carry, assignments = run_batch(profile.score_config, na,
                                        initial_carry(na),
@@ -248,16 +259,20 @@ class Scheduler:
         assignments = np.asarray(assignments)[:len(qpis)]
         self.device_batches += 1
         bound = 0
+        touched: dict[str, int] = {}
         for qpi, a in zip(qpis, assignments):
             self.schedule_attempts += 1
             if a >= 0:
                 node_name = self.state.node_names[int(a)]
                 self._assume_and_bind(qpi, node_name)
+                item = self.cache.nodes.get(node_name)
+                if item is not None:
+                    touched[node_name] = item.info.generation
                 bound += 1
             else:
                 self._handle_failure(qpi, self._device_fit_error(qpi))
         self.state.adopt_carry(carry.used, carry.nonzero_used,
-                               carry.npods, carry.ports)
+                               carry.npods, carry.ports, touched=touched)
         return bound
 
     def _device_fit_error(self, qpi: QueuedPodInfo) -> FitError:
@@ -369,9 +384,11 @@ class Scheduler:
             pass
         fresh = pod.clone()
         fresh.spec.node_name = ""
+        errors = self._bind_errors.get(pod.uid, 0) + 1
+        self._bind_errors[pod.uid] = errors
         qpi = QueuedPodInfo(pod_info=PodInfo.of(fresh),
                             timestamp=self.clock(),
-                            consecutive_errors_count=1)
+                            consecutive_errors_count=errors)
         self.queue.add_unschedulable_if_not_present(qpi)
         self.queue.move_all_to_active_or_backoff_queue(
             EVENT_ASSIGNED_POD_DELETE, pod, None)

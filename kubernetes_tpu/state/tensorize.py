@@ -105,6 +105,7 @@ class ClusterState:
     dims: Dims = field(default_factory=Dims)
     node_index: dict[str, int] = field(default_factory=dict)
     node_names: list[str] = field(default_factory=list)
+    row_gen: dict[str, int] = field(default_factory=dict)
     _free: list[int] = field(default_factory=list)
     arrays: Optional[NodeArrays] = None  # numpy staging
     _device: Optional[NodeArrays] = None  # jax device copy (lazy)
@@ -145,32 +146,33 @@ class ClusterState:
         return self.arrays
 
     def apply_snapshot(self, snapshot: Snapshot, full: bool = False) -> None:
-        """Scatter-update rows for snapshot.dirty_nodes (or everything)."""
+        """Scatter-update rows whose NodeInfo generation moved since the last
+        apply (pull-based incremental consumption: this consumer owns its own
+        progress in `row_gen`, so it never depends on how often the host
+        refreshed the snapshot in between)."""
         self.ensure_arrays()
         list_order = {n.name: i for i, n in enumerate(snapshot.node_info_list)}
-        if full:
-            names = set(snapshot.node_infos)
-            # also clear anything we track that's gone
-            names |= set(self.node_index)
-        else:
-            names = set(snapshot.dirty_nodes)
-        # write in snapshot-list order so freshly-assigned row indices track
-        # the host iteration order (argmax tie-breaks then usually agree)
-        names = sorted(names, key=lambda n: list_order.get(n, 1 << 30))
         schedulable_names = set(list_order)
-        for name in names:
-            ni = snapshot.node_infos.get(name)
-            if ni is None or name not in schedulable_names:
-                # removed or non-schedulable node → invalidate row
+        # removed or non-schedulable nodes → invalidate rows
+        for name in list(self.node_index):
+            if name not in schedulable_names:
                 idx = self.node_index.pop(name, None)
+                self.row_gen.pop(name, None)
                 if idx is not None:
                     self.arrays.valid[idx] = False
                     self.node_names[idx] = ""
                     self._free.append(idx)
+        # write in snapshot-list order so freshly-assigned row indices track
+        # the host iteration order (argmax tie-breaks then usually agree)
+        dirty_writes = False
+        for ni in snapshot.node_info_list:
+            if not full and self.row_gen.get(ni.name) == ni.generation:
                 continue
-            self._write_row(self._slot(name), ni)
-        snapshot.dirty_nodes.clear()
-        self._device_dirty = True
+            self._write_row(self._slot(ni.name), ni)
+            self.row_gen[ni.name] = ni.generation
+            dirty_writes = True
+        if dirty_writes or full:
+            self._device_dirty = True
 
     def _write_row(self, idx: int, ni: NodeInfo) -> None:
         a = self.arrays
@@ -251,15 +253,23 @@ class ClusterState:
             self._device_dirty = False
         return self._device
 
-    def adopt_carry(self, used, nonzero_used, npods, ports) -> None:
+    def adopt_carry(self, used, nonzero_used, npods, ports,
+                    touched: Optional[dict[str, int]] = None) -> None:
         """After a batch, the scan's carry IS the new truth for the mutable
         arrays — pull it back into staging without a full rebuild. (The host
-        cache is updated in parallel via assume; `reconcile` cross-checks.)"""
+        cache is updated in parallel via assume; `reconcile` cross-checks.)
+
+        `touched` maps node name → the cache generation reached by the
+        parallel assume bookkeeping; recording it marks those rows current,
+        which is what lets `reconcile` compare scan-carry content against
+        cache content instead of writing the rows off as lagging."""
         a = self.ensure_arrays()
         np.copyto(a.used, np.asarray(used))
         np.copyto(a.nonzero_used, np.asarray(nonzero_used))
         np.copyto(a.npods, np.asarray(npods))
         np.copyto(a.ports, np.asarray(ports))
+        if touched:
+            self.row_gen.update(touched)
         if self._device is not None:
             self._device = self._device._replace(
                 used=used, nonzero_used=nonzero_used, npods=npods, ports=ports)
@@ -268,7 +278,9 @@ class ClusterState:
 
     def reconcile(self, snapshot: Snapshot) -> list[str]:
         """Compare staging arrays vs snapshot; returns divergent node names
-        (backend/cache/debugger comparer analog)."""
+        (backend/cache/debugger comparer analog). Rows whose generation is
+        behind the snapshot are LAG, not divergence — the next apply_snapshot
+        refreshes them; only rows claiming to be current are compared."""
         out = []
         a = self.ensure_arrays()
         for name, idx in self.node_index.items():
@@ -276,9 +288,15 @@ class ClusterState:
             if ni is None:
                 out.append(name)
                 continue
+            if self.row_gen.get(name) != ni.generation:
+                continue
             used_row = self.rtable.vector(ni.requested)
+            port_ids = sorted({self.interner.port_id(p, pt)
+                               for (p, pt, _ip) in ni.used_ports.ports})
+            row_ports = sorted(int(x) for x in a.ports[idx] if x != 0)
             if (list(a.used[idx, :len(used_row)]) != used_row
-                    or a.npods[idx] != len(ni.pods)):
+                    or a.npods[idx] != len(ni.pods)
+                    or row_ports != port_ids):
                 out.append(name)
         return out
 

@@ -90,12 +90,8 @@ class TestSnapshot:
         assert snap.dirty_nodes == {"n1", "n2"}
         assert len(snap.node_info_list) == 2
 
-        # dirty_nodes accumulates until the tensorized-state consumer clears
-        # it (ClusterState.apply_snapshot); a refresh with no changes must
-        # not wipe pending dirt.
         c.update_snapshot(snap)
-        assert snap.dirty_nodes == {"n1", "n2"}
-        snap.dirty_nodes.clear()  # simulate apply_snapshot consuming
+        assert snap.dirty_nodes == set()  # nothing changed
 
         c.add_pod(bound_pod("p1", "n1"))
         c.update_snapshot(snap)

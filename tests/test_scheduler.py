@@ -183,12 +183,12 @@ class TestAffinityParityRouting:
                        .req({"cpu": "100m"}).obj())
         api.create_pod(make_pod("b-web").label("app", "web").req({"cpu": "100m"}).obj())
         bound = sched.schedule_pending()
-        # guard binds; b-web must be blocked in every zone (both nodes share
-        # no zone split? n0=z0,n1=z1 — anti-affinity only blocks guard's zone)
-        assert bound >= 1
+        # guard binds; b-web must land in the OTHER zone (n0=z0, n1=z1),
+        # which only the host oracle knows — the device path would have
+        # happily placed it next to the guard
+        assert bound == 2
         web = api.pods["default/b-web"]
         guard_node = api.pods["default/a-guard"].spec.node_name
-        if web.spec.node_name:
-            # must have landed in the other zone, via the host path
-            zone_of = {"n0": "z0", "n1": "z1"}
-            assert zone_of[web.spec.node_name] != zone_of[guard_node]
+        assert web.spec.node_name, "b-web must bind (one zone is free)"
+        zone_of = {"n0": "z0", "n1": "z1"}
+        assert zone_of[web.spec.node_name] != zone_of[guard_node]
