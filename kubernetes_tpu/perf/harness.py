@@ -1,0 +1,244 @@
+"""scheduler_perf harness: YAML-driven workloads + throughput collection.
+
+Ports the reference benchmark contract
+(test/integration/scheduler_perf/scheduler_perf.go):
+- testCases loaded from YAML (`:1217` RunBenchmarkPerfScheduling): each has a
+  `workloadTemplate` of ops and parameterized `workloads` with an optional
+  `threshold` (minimum average pods/s, the failure gate, `:375-430`).
+- op registry (`:518-552`): createNodes, createPods (collectMetrics),
+  churn, barrier, sleep.
+- throughputCollector (util.go:457-660): average scheduled-pods/s over the
+  measured phase, plus percentile summaries of per-batch scheduling rates.
+
+Differences by design (TPU architecture): scheduling is driven synchronously
+(`schedule_pending` drains the queue in device batches) instead of sampling a
+free-running goroutine, so the collector measures wall-clock around the
+measured createPods+drain phase and derives percentiles from per-batch
+timings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import yaml
+
+from ..backend.apiserver import APIServer
+from ..scheduler import Scheduler
+from ..testing.wrappers import make_node, make_pod
+
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+@dataclass
+class Workload:
+    name: str
+    params: dict
+    labels: list[str] = field(default_factory=list)
+    threshold: float = 0.0
+
+
+@dataclass
+class TestCase:
+    name: str
+    workload_template: list[dict]
+    workloads: list[Workload]
+    default_pod_template: Optional[dict] = None
+
+
+def load_test_cases(path: str) -> list[TestCase]:
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    cases = []
+    for tc in raw:
+        workloads = [Workload(name=w["name"], params=w.get("params", {}),
+                              labels=w.get("labels", []),
+                              threshold=w.get("threshold", 0.0))
+                     for w in tc.get("workloads", [])]
+        cases.append(TestCase(name=tc["name"],
+                              workload_template=tc["workloadTemplate"],
+                              workloads=workloads,
+                              default_pod_template=tc.get("defaultPodTemplate")))
+    return cases
+
+
+def _resolve(op: dict, key: str, params: dict, default=None):
+    """countParam: $foo indirection (scheduler_perf.go op params)."""
+    pkey = op.get(key + "Param")
+    if pkey is not None:
+        return params[pkey.lstrip("$")]
+    return op.get(key, default)
+
+
+@dataclass
+class DataItem:
+    """One measured phase (util.go DataItem)."""
+
+    name: str
+    average: float          # pods/s over the measured phase
+    perc50: float = 0.0     # per-batch rate percentiles
+    perc95: float = 0.0
+    perc99: float = 0.0
+    pods: int = 0
+    duration_s: float = 0.0
+
+
+class ThroughputCollector:
+    """Collects per-batch scheduling rates during a measured phase."""
+
+    def __init__(self) -> None:
+        self.batch_rates: list[float] = []
+        self.pods = 0
+        self.start = 0.0
+        self.elapsed = 0.0
+
+    def begin(self) -> None:
+        self.start = time.perf_counter()
+
+    def batch(self, pods: int, seconds: float) -> None:
+        if seconds > 0 and pods > 0:
+            self.batch_rates.append(pods / seconds)
+        self.pods += pods
+
+    def end(self) -> None:
+        self.elapsed = time.perf_counter() - self.start
+
+    def item(self, name: str) -> DataItem:
+        rates = sorted(self.batch_rates)
+
+        def perc(p: float) -> float:
+            if not rates:
+                return 0.0
+            return rates[min(len(rates) - 1, int(p * len(rates)))]
+
+        avg = self.pods / self.elapsed if self.elapsed > 0 else 0.0
+        return DataItem(name=name, average=avg, perc50=perc(0.50),
+                        perc95=perc(0.95), perc99=perc(0.99),
+                        pods=self.pods, duration_s=self.elapsed)
+
+
+def _make_nodes(api: APIServer, count: int, start: int, params: dict) -> None:
+    cpu = params.get("nodeCpu", 32)
+    mem = params.get("nodeMemoryGi", 64)
+    zones = params.get("zones", 16)
+    for i in range(start, start + count):
+        api.create_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": cpu, "memory": f"{mem}Gi", "pods": 110})
+            .zone(f"zone-{i % zones}")
+            .label(LABEL_HOSTNAME, f"node-{i}")
+            .obj())
+
+
+def _pod_from_template(name: str, template: Optional[dict], seq: int = 0,
+                       zones: int = 16):
+    w = make_pod(name)
+    t = template or {}
+    w = w.req({"cpu": t.get("cpu", "900m"), "memory": t.get("memory", "1Gi")})
+    for k, v in t.get("labels", {}).items():
+        w = w.label(k, v)
+    if t.get("nodeSelectorZone"):
+        w = w.node_selector({LABEL_ZONE: f"zone-{seq % zones}"})
+    if "spreadZone" in t:
+        w = w.spread_constraint(t.get("maxSkew", 1), LABEL_ZONE,
+                                t.get("whenUnsatisfiable", "DoNotSchedule"),
+                                t["spreadZone"])
+    if "podAntiAffinity" in t:
+        w = w.pod_affinity(t.get("topologyKey", LABEL_ZONE),
+                           t["podAntiAffinity"], anti=True)
+    if "podAffinity" in t:
+        w = w.pod_affinity(t.get("topologyKey", LABEL_ZONE), t["podAffinity"])
+    return w.obj()
+
+
+class WorkloadRunner:
+    """Executes one workload's op list against a fresh Scheduler."""
+
+    def __init__(self, scheduler_factory: Optional[Callable[[APIServer], Scheduler]] = None,
+                 batch_size: int = 512):
+        self.factory = scheduler_factory or (
+            lambda api: Scheduler(api, batch_size=batch_size))
+
+    def run(self, tc: TestCase, wl: Workload, verbose: bool = False) -> list[DataItem]:
+        api = APIServer()
+        sched = self.factory(api)
+        params = wl.params
+        items: list[DataItem] = []
+        node_seq = 0
+        pod_seq = 0
+        for op in tc.workload_template:
+            code = op["opcode"]
+            if code == "createNodes":
+                count = int(_resolve(op, "count", params))
+                _make_nodes(api, count, node_seq, params)
+                node_seq += count
+            elif code == "createPods":
+                count = int(_resolve(op, "count", params))
+                template = op.get("podTemplate", tc.default_pod_template)
+                collect = op.get("collectMetrics", False)
+                col = ThroughputCollector() if collect else None
+                if col:
+                    col.begin()
+                created = 0
+                create_batch = int(op.get("createBatch", 2000))
+                while created < count:
+                    n = min(create_batch, count - created)
+                    for i in range(n):
+                        seq = pod_seq + created + i
+                        api.create_pod(_pod_from_template(
+                            f"pod-{seq}", template, seq=seq,
+                            zones=params.get("zones", 16)))
+                    created += n
+                    t0 = time.perf_counter()
+                    bound = sched.schedule_pending()
+                    dt = time.perf_counter() - t0
+                    if col:
+                        col.batch(bound, dt)
+                    if verbose:
+                        print(f"  createPods: {created}/{count} bound={bound} "
+                              f"({bound/dt:.0f} pods/s)")
+                pod_seq += count
+                if col:
+                    col.end()
+                    items.append(col.item(f"{tc.name}/{wl.name}"))
+            elif code == "barrier":
+                deadline = time.time() + float(op.get("timeoutSeconds", 60))
+                while len(sched.queue) and time.time() < deadline:
+                    sched.flush_queues()
+                    sched.schedule_pending()
+            elif code == "churn":
+                # churn mode "recreate" (scheduler_perf.go:870): create and
+                # delete pods/nodes repeatedly to exercise event handling
+                number = int(_resolve(op, "number", params, 100))
+                for i in range(number):
+                    name = f"churn-{i}"
+                    api.create_pod(_pod_from_template(name, tc.default_pod_template))
+                    sched.schedule_pending()
+                    api.delete_pod(f"default/{name}")
+            elif code == "sleep":
+                time.sleep(float(op.get("duration", op.get("seconds", 0.1))))
+            else:
+                raise ValueError(f"unknown opcode {code}")
+        return items
+
+
+def run_config(path: str, case_filter: str = "", workload_filter: str = "",
+               verbose: bool = False,
+               scheduler_factory=None) -> list[tuple[DataItem, float]]:
+    """Run matching (case, workload) pairs; returns [(item, threshold)]."""
+    out = []
+    for tc in load_test_cases(path):
+        if case_filter and case_filter != tc.name:
+            continue
+        for wl in tc.workloads:
+            if workload_filter and workload_filter != wl.name:
+                continue
+            runner = WorkloadRunner(scheduler_factory=scheduler_factory)
+            for item in runner.run(tc, wl, verbose=verbose):
+                out.append((item, wl.threshold))
+    return out

@@ -218,7 +218,11 @@ class Scheduler:
     def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
         pods = [q.pod for q in qpis]
         self.cache.update_snapshot(self.snapshot)
-        batch = self.builder.build(pods, snapshot=self.snapshot)
+        batch = self.builder.build(pods, snapshot=self.snapshot,
+                                   pad_to=self.batch_size)
+        if not batch.host_fallback.any():
+            # common case: whole drain is device-eligible; reuse this build
+            return self._schedule_device_segment(qpis, prebuilt=batch)
         fallback = batch.host_fallback
         bound = 0
         i = 0
@@ -241,17 +245,23 @@ class Scheduler:
             i = j
         return bound
 
-    def _schedule_device_segment(self, qpis: list[QueuedPodInfo]) -> int:
+    def _schedule_device_segment(self, qpis: list[QueuedPodInfo],
+                                 prebuilt=None) -> int:
         profile = next(iter(self.profiles.values()))
         self.cache.update_snapshot(self.snapshot)
         self.state.apply_snapshot(self.snapshot)
-        segment_batch = self.builder.build([q.pod for q in qpis],
-                                           snapshot=self.snapshot)
-        if segment_batch.host_fallback.any():
-            # state moved between routing and segment build (e.g. a node
-            # update surfaced images, or a host bind introduced affinity
-            # pods): honor queue order and let the oracle take the segment
-            return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
+        if (prebuilt is not None
+                and prebuilt.req.shape[1] == self.state.dims.resources):
+            segment_batch = prebuilt
+        else:
+            segment_batch = self.builder.build([q.pod for q in qpis],
+                                               snapshot=self.snapshot,
+                                               pad_to=self.batch_size)
+            if segment_batch.host_fallback.any():
+                # state moved between routing and segment build (e.g. a node
+                # update surfaced images, or a host bind introduced affinity
+                # pods): honor queue order and let the oracle take the segment
+                return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         na = self.state.device_arrays()
         carry, assignments = run_batch(profile.score_config, na,
                                        initial_carry(na),

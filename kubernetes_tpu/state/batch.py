@@ -100,9 +100,13 @@ class BatchBuilder:
         self._cluster_has_images = False
         self._cluster_has_affinity_pods = False
 
-    def build(self, pods: list[Pod], snapshot=None) -> PodBatch:
+    def build(self, pods: list[Pod], snapshot=None,
+              pad_to: int = 0) -> PodBatch:
         d = self.dims
-        B = pow2_at_least(len(pods))
+        # pad to the caller's standing batch size when given: residual drains
+        # then reuse the same compiled program instead of minting a new
+        # (smaller) shape bucket
+        B = pow2_at_least(max(len(pods), pad_to))
         R = self.state.dims.resources
         arrays = self.state.arrays
         self._cluster_has_images = bool(
