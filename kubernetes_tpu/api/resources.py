@@ -13,6 +13,7 @@ bytes, pods and extended resources → unit count. All int64.
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 from dataclasses import dataclass, field
@@ -47,17 +48,8 @@ def _ceil(x: float) -> int:
     return math.ceil(x - 1e-9)
 
 
-def parse_quantity(value: str | int | float, resource: str = "") -> int:
-    """Parse a k8s quantity string into canonical int64 units.
-
-    "100m" cpu → 100; "2" cpu → 2000; "1Gi" → 2**30; "500M" → 5e8.
-    ints/floats: cpu means cores (→ milli), others pass through.
-    Fractional values round UP like Quantity.Value()/MilliValue().
-    """
-    if isinstance(value, int):
-        return value * 1000 if resource == CPU else value
-    if isinstance(value, float):
-        return _ceil(value * 1000) if resource == CPU else _ceil(value)
+@functools.lru_cache(maxsize=4096)
+def _parse_quantity_str(value: str, resource: str) -> int:
     m = _QTY_RE.match(value.strip())
     if not m:
         raise ValueError(f"unparseable quantity {value!r}")
@@ -70,6 +62,20 @@ def parse_quantity(value: str | int | float, resource: str = "") -> int:
     if resource == CPU:
         return _ceil(scaled * 1000)
     return _ceil(scaled)
+
+
+def parse_quantity(value: str | int | float, resource: str = "") -> int:
+    """Parse a k8s quantity string into canonical int64 units.
+
+    "100m" cpu → 100; "2" cpu → 2000; "1Gi" → 2**30; "500M" → 5e8.
+    ints/floats: cpu means cores (→ milli), others pass through.
+    Fractional values round UP like Quantity.Value()/MilliValue().
+    """
+    if isinstance(value, int):
+        return value * 1000 if resource == CPU else value
+    if isinstance(value, float):
+        return _ceil(value * 1000) if resource == CPU else _ceil(value)
+    return _parse_quantity_str(value, resource)
 
 
 def parse_resource_dict(d: dict[str, str | int | float]) -> dict[str, int]:
@@ -131,14 +137,26 @@ def pod_requests(pod) -> dict[str, int]:
     Reference: k8s.io/component-helpers resource.PodRequests as used by
     noderesources computePodResourceRequest (fit.go:305): sum of container
     requests, element-wise max with init containers, plus overhead.
+
+    Memoized on the PodSpec (clones share it): computed once per pod no
+    matter how many times the queue/builder/cache ask. Treat the returned
+    dict as read-only.
     """
+    spec = pod.spec
+    cached = getattr(spec, "_requests_cache", None)
+    if cached is not None:
+        return cached
     total: dict[str, int] = {}
-    for c in pod.spec.containers:
+    for c in spec.containers:
         total = add_resource_list(total, c.requests)
-    for ic in pod.spec.init_containers:
+    for ic in spec.init_containers:
         total = max_resource_list(total, ic.requests)
-    if pod.spec.overhead:
-        total = add_resource_list(total, pod.spec.overhead)
+    if spec.overhead:
+        total = add_resource_list(total, spec.overhead)
+    try:
+        spec._requests_cache = total
+    except AttributeError:
+        pass
     return total
 
 
@@ -170,6 +188,16 @@ def pod_requests_nonmissing(pod) -> dict[str, int]:
 
 
 def pod_requests_nonzero(pod) -> tuple[int, int]:
-    """(milli_cpu, memory) contribution to NodeInfo.NonZeroRequested."""
+    """(milli_cpu, memory) contribution to NodeInfo.NonZeroRequested.
+    Memoized on the PodSpec like pod_requests."""
+    spec = pod.spec
+    cached = getattr(spec, "_nonzero_cache", None)
+    if cached is not None:
+        return cached
     req = pod_requests_nonmissing(pod)
-    return req.get(CPU, 0), req.get(MEMORY, 0)
+    out = (req.get(CPU, 0), req.get(MEMORY, 0))
+    try:
+        spec._nonzero_cache = out
+    except AttributeError:
+        pass
+    return out

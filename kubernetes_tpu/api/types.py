@@ -10,8 +10,16 @@ reference's scheduler only ever sees decoded informer objects.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import enum
+
+
+def _shallow(obj):
+    """Fast shallow copy for plain (non-slots) dataclass instances."""
+    new = object.__new__(type(obj))
+    new.__dict__.update(obj.__dict__)
+    return new
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -329,16 +337,16 @@ class Pod:
         return self.metadata.namespace
 
     def clone(self) -> "Pod":
-        return dataclasses.replace(
-            self,
-            metadata=dataclasses.replace(
-                self.metadata,
-                labels=dict(self.metadata.labels),
-                annotations=dict(self.metadata.annotations),
-            ),
-            spec=dataclasses.replace(self.spec),
-            status=dataclasses.replace(self.status),
-        )
+        # hot path (2 clones per scheduled pod): raw __dict__ copies — both
+        # copy.copy (reduce protocol) and dataclasses.replace (re-runs
+        # __init__) are several times slower
+        p = _shallow(self)
+        p.metadata = _shallow(self.metadata)
+        p.metadata.labels = dict(self.metadata.labels)
+        p.metadata.annotations = dict(self.metadata.annotations)
+        p.spec = _shallow(self.spec)
+        p.status = _shallow(self.status)
+        return p
 
 
 # ---------------------------------------------------------------------------

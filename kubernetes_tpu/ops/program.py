@@ -58,11 +58,28 @@ class ScoreConfig(NamedTuple):
     strategy: str = "LeastAllocated"            # or MostAllocated
 
 
+class SigCache(NamedTuple):
+    """Per-signature cached evaluation (KEP-5598 opportunistic batching,
+    reference runtime/batch.go:33-240, generalized): consecutive pods with an
+    identical device row reuse the carry-independent kernels — only the fit
+    mask and fit-derived scores of the single node touched by the previous
+    placement are recomputed. sig 0 never matches."""
+
+    sig: jnp.ndarray          # i32 scalar — signature these vectors belong to
+    static_mask: jnp.ndarray  # bool [N] — nodename/unsched/taints/selector/ports
+    taint_raw: jnp.ndarray    # i64 [N] — PreferNoSchedule counts (pre-normalize)
+    na_raw: jnp.ndarray       # i64 [N] — preferred-affinity weights (pre-normalize)
+    fit_ok: jnp.ndarray       # bool [N]
+    s_fit: jnp.ndarray        # i64 [N]
+    s_bal: jnp.ndarray        # i64 [N]
+
+
 class Carry(NamedTuple):
     used: jnp.ndarray          # i64 [N, R]
     nonzero_used: jnp.ndarray  # i64 [N, 2]
     npods: jnp.ndarray         # i32 [N]
     ports: jnp.ndarray         # i32 [N, P]
+    cache: SigCache
 
 
 # ---------------------------------------------------------------------------
@@ -235,10 +252,9 @@ def default_normalize(scores, feasible, reverse: bool, axis: str | None = None):
 # the scan
 
 
-class PodRow(NamedTuple):
-    """One pod's slice of the PodBatch tensors (scan xs)."""
+class PodTableDev(NamedTuple):
+    """Device copy of state.batch.PodTable ([U, ...], U = distinct sigs)."""
 
-    valid: jnp.ndarray
     req: jnp.ndarray
     nonzero_req: jnp.ndarray
     node_name_id: jnp.ndarray
@@ -263,51 +279,61 @@ class PodRow(NamedTuple):
     skip_balanced: jnp.ndarray
 
 
-def pod_rows_from_batch(batch) -> PodRow:
-    """PodBatch (B-leading arrays) → PodRow pytree for scan xs."""
-    return PodRow(
-        valid=jnp.asarray(batch.valid),
-        req=jnp.asarray(batch.req),
-        nonzero_req=jnp.asarray(batch.nonzero_req),
-        node_name_id=jnp.asarray(batch.node_name_id),
-        tol_key=jnp.asarray(batch.tol_key),
-        tol_val=jnp.asarray(batch.tol_val),
-        tol_eff=jnp.asarray(batch.tol_eff),
-        tol_op=jnp.asarray(batch.tol_op),
-        tolerates_unsched=jnp.asarray(batch.tolerates_unsched),
-        ns_sel_val=jnp.asarray(batch.ns_sel_val),
-        aff_has=jnp.asarray(batch.aff_has),
-        aff_term_valid=jnp.asarray(batch.aff_term_valid),
-        aff_key=jnp.asarray(batch.aff_key),
-        aff_op=jnp.asarray(batch.aff_op),
-        aff_num=jnp.asarray(batch.aff_num),
-        aff_val=jnp.asarray(batch.aff_val),
-        pref_weight=jnp.asarray(batch.pref_weight),
-        pref_key=jnp.asarray(batch.pref_key),
-        pref_op=jnp.asarray(batch.pref_op),
-        pref_num=jnp.asarray(batch.pref_num),
-        pref_val=jnp.asarray(batch.pref_val),
-        port_ids=jnp.asarray(batch.port_ids),
-        skip_balanced=jnp.asarray(batch.skip_balanced),
-    )
+class PodXs(NamedTuple):
+    """Per-pod scan xs: the only O(B) upload per batch."""
+
+    valid: jnp.ndarray   # bool [B]
+    sig: jnp.ndarray     # i32 [B]
+    tidx: jnp.ndarray    # i32 [B] — row into PodTableDev
 
 
-def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
-              axis: str | None = None):
-    """Feasibility + total score for one pod over all nodes → (mask, score).
-    `axis` names the mesh axis when `na`/`carry` hold one node shard."""
+class PodRow(NamedTuple):
+    """One pod's view inside the scan step: table row + per-pod scalars."""
+
+    valid: jnp.ndarray
+    sig: jnp.ndarray
+    req: jnp.ndarray
+    nonzero_req: jnp.ndarray
+    node_name_id: jnp.ndarray
+    tol_key: jnp.ndarray
+    tol_val: jnp.ndarray
+    tol_eff: jnp.ndarray
+    tol_op: jnp.ndarray
+    tolerates_unsched: jnp.ndarray
+    ns_sel_val: jnp.ndarray
+    aff_has: jnp.ndarray
+    aff_term_valid: jnp.ndarray
+    aff_key: jnp.ndarray
+    aff_op: jnp.ndarray
+    aff_num: jnp.ndarray
+    aff_val: jnp.ndarray
+    pref_weight: jnp.ndarray
+    pref_key: jnp.ndarray
+    pref_op: jnp.ndarray
+    pref_num: jnp.ndarray
+    pref_val: jnp.ndarray
+    port_ids: jnp.ndarray
+    skip_balanced: jnp.ndarray
+
+
+def _gather_row(table: PodTableDev, x) -> PodRow:
+    fields = {name: getattr(table, name)[x.tidx]
+              for name in PodTableDev._fields}
+    return PodRow(valid=x.valid, sig=x.sig, **fields)
+
+
+def pod_rows_from_batch(batch) -> tuple[PodXs, PodTableDev]:
+    """PodBatch → (per-pod xs, device signature table)."""
+    xs = PodXs(valid=jnp.asarray(batch.valid), sig=jnp.asarray(batch.sig),
+               tidx=jnp.asarray(batch.tidx))
+    table = PodTableDev(*(jnp.asarray(getattr(batch.table, f))
+                          for f in PodTableDev._fields))
+    return xs, table
+
+
+def _fit_scores(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
+    """LeastAllocated + BalancedAllocation over all nodes → ([N], [N])."""
     cols = jnp.array(cfg.score_cols, jnp.int32)
-
-    # ---- filters ----
-    m = na.valid
-    m &= fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods, pod.req)
-    m &= (pod.node_name_id == 0) | (na.name_id == pod.node_name_id)
-    m &= ~na.unschedulable | pod.tolerates_unsched
-    m &= taint_filter_mask(na, pod)
-    m &= selector_mask(na, pod)
-    m &= ports_mask(carry.ports, pod.port_ids)
-
-    # ---- scores ----
     cap_cols = na.cap[:, cols]                        # [N, C]
     nz = jnp.array(cfg.col_nonzero)
     slots = jnp.array(cfg.nonzero_slot, jnp.int32)
@@ -315,18 +341,84 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     used_plain = carry.used[:, cols] + pod.req[cols][None, :]
     used_cols = jnp.where(nz[None, :], used_nonzero, used_plain)
     s_fit = least_allocated(cfg, cap_cols, used_cols)
-
     used_bal = carry.used[:, cols] + pod.req[cols][None, :]
     s_bal = jnp.where(pod.skip_balanced, 0, balanced_allocation(cap_cols, used_bal))
+    return s_fit, s_bal
 
-    s_taint = default_normalize(taint_prefer_count(na, pod), m,
-                                reverse=True, axis=axis)
-    s_na = default_normalize(preferred_affinity_score(na, pod), m,
-                             reverse=False, axis=axis)
 
+def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
+    """The full kernel set: everything SigCache caches, freshly computed.
+    ports_mask folds into static_mask — pods eligible for the fast path
+    carry no host ports (BatchBuilder gives them sig 0 otherwise), so the
+    cached value is vacuously true whenever it can be reused."""
+    m = na.valid
+    m &= (pod.node_name_id == 0) | (na.name_id == pod.node_name_id)
+    m &= ~na.unschedulable | pod.tolerates_unsched
+    m &= taint_filter_mask(na, pod)
+    m &= selector_mask(na, pod)
+    m &= ports_mask(carry.ports, pod.port_ids)
+    taint_raw = taint_prefer_count(na, pod)
+    na_raw = preferred_affinity_score(na, pod)
+    fit_ok = fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods, pod.req)
+    s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
+    return m, taint_raw, na_raw, fit_ok, s_fit, s_bal
+
+
+def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
+                 best: jnp.ndarray, gate: jnp.ndarray, cache: SigCache
+                 ) -> SigCache:
+    """Recompute fit_ok/s_fit/s_bal for the single row the placement touched
+    (everything else in the cache is carry-independent)."""
+    cols = jnp.array(cfg.score_cols, jnp.int32)
+    nz = jnp.array(cfg.col_nonzero)
+    slots = jnp.array(cfg.nonzero_slot, jnp.int32)
+    cap_row = na.cap[best]
+    used_row = c2.used[best]
+    fit_ok_b = ((c2.npods[best] + 1 <= na.allowed_pods[best])
+                & jnp.all((pod.req == 0) | (used_row + pod.req <= cap_row)))
+    cap_r = cap_row[cols][None, :]
+    used_nz_r = c2.nonzero_used[best][slots] + pod.nonzero_req[slots]
+    used_pl_r = used_row[cols] + pod.req[cols]
+    used_cols_r = jnp.where(nz, used_nz_r, used_pl_r)[None, :]
+    s_fit_b = least_allocated(cfg, cap_r, used_cols_r)[0]
+    s_bal_b = jnp.where(pod.skip_balanced, 0,
+                        balanced_allocation(cap_r, used_pl_r[None, :])[0])
+    return SigCache(
+        sig=pod.sig,
+        static_mask=cache.static_mask,
+        taint_raw=cache.taint_raw,
+        na_raw=cache.na_raw,
+        fit_ok=cache.fit_ok.at[best].set(
+            jnp.where(gate, fit_ok_b, cache.fit_ok[best])),
+        s_fit=cache.s_fit.at[best].set(
+            jnp.where(gate, s_fit_b, cache.s_fit[best])),
+        s_bal=cache.s_bal.at[best].set(
+            jnp.where(gate, s_bal_b, cache.s_bal[best])),
+    )
+
+
+def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
+              axis: str | None = None):
+    """Feasibility + total score for one pod over all nodes → (mask, score,
+    parts). Consults the signature cache: a pod whose sig matches the carry's
+    reuses every carry-independent kernel (the expensive ones).
+    `axis` names the mesh axis when `na`/`carry` hold one node shard."""
+    cache = carry.cache
+    use_fast = (pod.sig != 0) & (pod.sig == cache.sig)
+    m, taint_raw, na_raw, fit_ok, s_fit, s_bal = lax.cond(
+        use_fast,
+        lambda: (cache.static_mask, cache.taint_raw, cache.na_raw,
+                 cache.fit_ok, cache.s_fit, cache.s_bal),
+        lambda: _slow_parts(cfg, na, carry, pod))
+
+    feasible = m & fit_ok
+    s_taint = default_normalize(taint_raw, feasible, reverse=True, axis=axis)
+    s_na = default_normalize(na_raw, feasible, reverse=False, axis=axis)
     total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
              + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)
-    return m, total
+    parts = SigCache(sig=pod.sig, static_mask=m, taint_raw=taint_raw,
+                     na_raw=na_raw, fit_ok=fit_ok, s_fit=s_fit, s_bal=s_bal)
+    return feasible, total, parts
 
 
 def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
@@ -348,19 +440,24 @@ def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
     ports = jnp.where(
         (onehot[:, None]) & (jnp.any(pod_ports != 0)),
         jnp.broadcast_to(new_row, carry.ports.shape), carry.ports)
-    return Carry(used=used, nonzero_used=nonzero, npods=npods, ports=ports)
+    return carry._replace(used=used, nonzero_used=nonzero, npods=npods,
+                          ports=ports)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodRow):
+def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
+              table: PodTableDev):
     """Scan the batch; returns (final carry, assignments int32[B] (-1 = none))."""
 
-    def step(c: Carry, pod: PodRow):
-        mask, score = _eval_pod(cfg, na, c, pod)
+    def step(c: Carry, x: PodXs):
+        pod = _gather_row(table, x)
+        mask, score, parts = _eval_pod(cfg, na, c, pod)
         masked = jnp.where(mask, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)
         assigned = (masked[best] >= 0) & pod.valid
         c2 = _apply_assignment(c, pod, best, assigned)
+        c2 = c2._replace(cache=_row_refresh(cfg, na, c2, pod, best,
+                                            assigned, parts))
         return c2, jnp.where(assigned, best, -1)
 
     final, assignments = lax.scan(step, carry, pods)
@@ -368,5 +465,15 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodRow):
 
 
 def initial_carry(na: NodeArrays) -> Carry:
+    n = na.npods.shape[0]
+    zero_cache = SigCache(
+        sig=jnp.int32(0),
+        static_mask=jnp.zeros((n,), bool),
+        taint_raw=jnp.zeros((n,), jnp.int64),
+        na_raw=jnp.zeros((n,), jnp.int64),
+        fit_ok=jnp.zeros((n,), bool),
+        s_fit=jnp.zeros((n,), jnp.int64),
+        s_bal=jnp.zeros((n,), jnp.int64),
+    )
     return Carry(used=na.used, nonzero_used=na.nonzero_used,
-                 npods=na.npods, ports=na.ports)
+                 npods=na.npods, ports=na.ports, cache=zero_cache)

@@ -29,13 +29,23 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..ops.program import (Carry, PodRow, ScoreConfig, _apply_assignment,
-                           _eval_pod)
+from ..ops.program import (Carry, PodTableDev, PodXs, ScoreConfig, SigCache,
+                           _apply_assignment, _eval_pod, _gather_row,
+                           _row_refresh)
 from ..state.tensorize import NodeArrays
 
 NODE_AXIS = "nodes"
 
 _INT_MAX = jnp.iinfo(jnp.int32).max
+
+# the signature-cache sig is a replicated scalar; every other carry leaf is
+# sharded along the node axis
+_CARRY_SPEC = Carry(
+    used=P(NODE_AXIS), nonzero_used=P(NODE_AXIS), npods=P(NODE_AXIS),
+    ports=P(NODE_AXIS),
+    cache=SigCache(sig=P(), static_mask=P(NODE_AXIS), taint_raw=P(NODE_AXIS),
+                   na_raw=P(NODE_AXIS), fit_ok=P(NODE_AXIS),
+                   s_fit=P(NODE_AXIS), s_bal=P(NODE_AXIS)))
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -47,10 +57,13 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 
 def _sharded_step(cfg: ScoreConfig, axis: str, na_l: NodeArrays,
-                  offset: jnp.ndarray, c: Carry, pod: PodRow):
-    """One pod placement on a node shard. Collectives: pmax + pmin."""
+                  table: PodTableDev, offset: jnp.ndarray, c: Carry,
+                  x: PodXs):
+    """One pod placement on a node shard. Collectives: pmax + pmin (plus the
+    global normalization maxes inside _eval_pod)."""
     n_local = na_l.cap.shape[0]
-    mask, score = _eval_pod(cfg, na_l, c, pod, axis=axis)
+    pod = _gather_row(table, x)
+    mask, score, parts = _eval_pod(cfg, na_l, c, pod, axis=axis)
     masked = jnp.where(mask, score, -1)
     lbest = jnp.argmax(masked).astype(jnp.int32)
     lscore = masked[lbest]
@@ -62,13 +75,16 @@ def _sharded_step(cfg: ScoreConfig, axis: str, na_l: NodeArrays,
     lidx = gbest - offset
     in_shard = (lidx >= 0) & (lidx < n_local)
     lidx_safe = jnp.clip(lidx, 0, n_local - 1).astype(jnp.int32)
-    c2 = _apply_assignment(c, pod, lidx_safe, assigned & in_shard)
+    gate = assigned & in_shard
+    c2 = _apply_assignment(c, pod, lidx_safe, gate)
+    c2 = c2._replace(cache=_row_refresh(cfg, na_l, c2, pod, lidx_safe,
+                                        gate, parts))
     return c2, jnp.where(assigned, gbest, -1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
 def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
-                      carry: Carry, pods: PodRow):
+                      carry: Carry, pods: PodXs, table: PodTableDev):
     """`ops.program.run_batch` with the node axis sharded over `mesh`.
 
     N (the padded node count) must be divisible by the mesh size; the
@@ -76,21 +92,25 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
     meshes. Returns (final sharded carry, replicated assignments[B]).
     """
     node_sharded_na = NodeArrays(*(P(NODE_AXIS) for _ in na))
-    node_sharded_carry = Carry(*(P(NODE_AXIS) for _ in carry))
-    replicated_pods = PodRow(*(P() for _ in pods))
+    node_sharded_carry = _CARRY_SPEC
+    replicated_pods = PodXs(*(P() for _ in pods))
+    replicated_table = PodTableDev(*(P() for _ in table))
 
-    def local(na_l: NodeArrays, carry_l: Carry, pods_r: PodRow):
+    def local(na_l: NodeArrays, carry_l: Carry, pods_r: PodXs,
+              table_r: PodTableDev):
         n_local = na_l.cap.shape[0]
         offset = (lax.axis_index(NODE_AXIS) * n_local).astype(jnp.int32)
-        step = functools.partial(_sharded_step, cfg, NODE_AXIS, na_l, offset)
+        step = functools.partial(_sharded_step, cfg, NODE_AXIS, na_l,
+                                 table_r, offset)
         return lax.scan(step, carry_l, pods_r)
 
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(node_sharded_na, node_sharded_carry, replicated_pods),
+        in_specs=(node_sharded_na, node_sharded_carry, replicated_pods,
+                  replicated_table),
         out_specs=(node_sharded_carry, P()),
         check_vma=False)
-    return fn(na, carry, pods)
+    return fn(na, carry, pods, table)
 
 
 def shard_node_arrays(mesh: Mesh, na: NodeArrays) -> NodeArrays:

@@ -160,7 +160,11 @@ class WorkloadRunner:
     """Executes one workload's op list against a fresh Scheduler."""
 
     def __init__(self, scheduler_factory: Optional[Callable[[APIServer], Scheduler]] = None,
-                 batch_size: int = 512):
+                 batch_size: int = 4096):
+        # Big batches amortize the per-device-call synchronization latency
+        # (the assignment readback); the scan itself is sub-microsecond per
+        # pod, so batch size is bounded by queue depth, not device time.
+        self.batch_size = batch_size
         self.factory = scheduler_factory or (
             lambda api: Scheduler(api, batch_size=batch_size))
 
@@ -185,7 +189,7 @@ class WorkloadRunner:
                 if col:
                     col.begin()
                 created = 0
-                create_batch = int(op.get("createBatch", 2000))
+                create_batch = int(op.get("createBatch", self.batch_size))
                 while created < count:
                     n = min(create_batch, count - created)
                     for i in range(n):

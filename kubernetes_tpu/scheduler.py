@@ -128,6 +128,13 @@ class Scheduler:
         self.host_scheduled = 0
         # per-pod consecutive bind-error count → escalating error backoff
         self._bind_errors: dict[str, int] = {}
+        # Device-resident scan carry, reused across batches while no event
+        # outside the device's own placements touches node state. This is
+        # what keeps steady-state scheduling free of host→device uploads and
+        # device→host carry readbacks (SURVEY §7 hard-part 4: the round-trip
+        # budget). Any external mutation invalidates it; the next device
+        # segment reseeds from the host snapshot.
+        self._device_carry = None
 
     # -- wiring ---------------------------------------------------------------
 
@@ -153,9 +160,13 @@ class Scheduler:
 
     # -- event handlers (eventhandlers.go) ------------------------------------
 
+    def _invalidate_device_state(self) -> None:
+        self._device_carry = None
+
     def _on_pod_add(self, pod: Pod) -> None:
         if pod.spec.node_name:
             self.cache.add_pod(pod)
+            self._invalidate_device_state()
             self.queue.move_all_to_active_or_backoff_queue(
                 EVENT_ASSIGNED_POD_ADD, None, pod)
         elif self._responsible(pod):
@@ -165,8 +176,13 @@ class Scheduler:
         if new.spec.node_name:
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
+                self._invalidate_device_state()
             else:
-                # became bound (possibly our own bind echo): confirm
+                # became bound. Our own bind echo confirms a pod the device
+                # carry already accounts for (it was assumed before the bind
+                # was dispatched); anything else is an external mutation.
+                if not self.cache.is_assumed_pod(new):
+                    self._invalidate_device_state()
                 self._bind_errors.pop(new.uid, None)
                 self.cache.add_pod(new)
                 self.queue.delete(new)
@@ -181,6 +197,7 @@ class Scheduler:
         self._bind_errors.pop(pod.uid, None)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
+            self._invalidate_device_state()
             self.queue.move_all_to_active_or_backoff_queue(
                 EVENT_ASSIGNED_POD_DELETE, pod, None)
         else:
@@ -188,14 +205,17 @@ class Scheduler:
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
+        self._invalidate_device_state()
         self.queue.move_all_to_active_or_backoff_queue(EVENT_NODE_ADD, None, node)
 
     def _on_node_update(self, old: Node, new: Node) -> None:
         self.cache.update_node(old, new)
+        self._invalidate_device_state()
         self.queue.move_all_to_active_or_backoff_queue(EVENT_NODE_UPDATE, old, new)
 
     def _on_node_delete(self, node: Node) -> None:
         self.cache.remove_node(node)
+        self._invalidate_device_state()
 
     # -- scheduling: batch path ----------------------------------------------
 
@@ -248,10 +268,14 @@ class Scheduler:
     def _schedule_device_segment(self, qpis: list[QueuedPodInfo],
                                  prebuilt=None) -> int:
         profile = next(iter(self.profiles.values()))
-        self.cache.update_snapshot(self.snapshot)
-        self.state.apply_snapshot(self.snapshot)
+        carry = self._device_carry
+        if carry is None:
+            # reseed device state from the host snapshot (first batch, or an
+            # external event invalidated the resident carry)
+            self.cache.update_snapshot(self.snapshot)
+            self.state.apply_snapshot(self.snapshot)
         if (prebuilt is not None
-                and prebuilt.req.shape[1] == self.state.dims.resources):
+                and prebuilt.table.req.shape[1] == self.state.dims.resources):
             segment_batch = prebuilt
         else:
             segment_batch = self.builder.build([q.pod for q in qpis],
@@ -263,27 +287,39 @@ class Scheduler:
                 # pods): honor queue order and let the oracle take the segment
                 return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         na = self.state.device_arrays()
-        carry, assignments = run_batch(profile.score_config, na,
-                                       initial_carry(na),
-                                       pod_rows_from_batch(segment_batch))
+        if carry is None or carry.used.shape != na.used.shape:
+            carry = initial_carry(na)
+        xs, table = pod_rows_from_batch(segment_batch)
+        carry, assignments = run_batch(profile.score_config, na, carry,
+                                       xs, table)
+        # the carry stays device-resident: the only readback per batch is the
+        # assignment vector
+        self._device_carry = carry
         assignments = np.asarray(assignments)[:len(qpis)]
         self.device_batches += 1
         bound = 0
-        touched: dict[str, int] = {}
         for qpi, a in zip(qpis, assignments):
             self.schedule_attempts += 1
             if a >= 0:
                 node_name = self.state.node_names[int(a)]
                 self._assume_and_bind(qpi, node_name)
-                item = self.cache.nodes.get(node_name)
-                if item is not None:
-                    touched[node_name] = item.info.generation
                 bound += 1
             else:
                 self._handle_failure(qpi, self._device_fit_error(qpi))
-        self.state.adopt_carry(carry.used, carry.nonzero_used,
-                               carry.npods, carry.ports, touched=touched)
         return bound
+
+    def reconcile(self) -> list:
+        """Debug/divergence check (cache debugger analog): pull the resident
+        device carry into staging and compare against the host cache truth.
+        Returns divergent node names; [] when scan bookkeeping matches."""
+        self.cache.update_snapshot(self.snapshot)
+        if self._device_carry is not None:
+            c = self._device_carry
+            gens = {ni.name: ni.generation
+                    for ni in self.snapshot.node_info_list}
+            self.state.adopt_carry(c.used, c.nonzero_used, c.npods, c.ports,
+                                   touched=gens)
+        return self.state.reconcile(self.snapshot)
 
     def _device_fit_error(self, qpi: QueuedPodInfo) -> FitError:
         """Device reports only infeasibility; attribute to the plugins whose
@@ -336,6 +372,8 @@ class Scheduler:
             return False
         self.host_scheduled += 1
         self._assume_and_bind(qpi, result.suggested_host, state)
+        # a host-path assume mutates node state outside the device carry
+        self._invalidate_device_state()
         return True
 
     def _skip_pod_schedule(self, pod: Pod) -> bool:
@@ -363,12 +401,14 @@ class Scheduler:
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
             self.cache.forget_pod(assumed)
+            self._invalidate_device_state()
             self._handle_failure(qpi, FitError(pod, 0))
             return
         status = fwk.run_permit_plugins(cs, assumed, node_name)
         if status.is_rejected():
             fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
             self.cache.forget_pod(assumed)
+            self._invalidate_device_state()
             self._handle_failure(qpi, FitError(pod, 0))
             return
         # Wait status (gang quorum) parks the pod; WaitOnPermit resolves at
@@ -392,6 +432,7 @@ class Scheduler:
             self.cache.forget_pod(pod)
         except (KeyError, ValueError):
             pass
+        self._invalidate_device_state()
         fresh = pod.clone()
         fresh.spec.node_name = ""
         errors = self._bind_errors.get(pod.uid, 0) + 1

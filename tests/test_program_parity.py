@@ -9,6 +9,8 @@ randomized clusters exercising every v1 kernel.
 
 import random
 
+import jax.numpy as jnp
+
 import numpy as np
 import pytest
 
@@ -55,7 +57,8 @@ def assert_device_matches_oracle(nodes, pods, cfg=ScoreConfig()):
     assert not batch.host_fallback.any(), "test pods must be tensorizable"
 
     na = state.device_arrays()
-    carry, assignments = run_batch(cfg, na, initial_carry(na), pod_rows_from_batch(batch))
+    xs, table = pod_rows_from_batch(batch)
+    carry, assignments = run_batch(cfg, na, initial_carry(na), xs, table)
     assignments = np.asarray(assignments)[:len(pods)]  # drop padding rows
 
     fwk = default_framework()
@@ -230,3 +233,59 @@ class TestRandomizedParity:
                 w = w.host_port(rng.choice([80, 443, 8080]))
             pods.append(w.obj())
         assert_device_matches_oracle(nodes, pods)
+
+
+class TestSignatureFastPath:
+    """The cached fast step must be decision-identical to the full kernels:
+    run the same batch with signatures enabled and with signatures zeroed
+    (cache disabled) and compare assignments and final carry."""
+
+    def test_identical_pods_fast_equals_slow(self):
+        import dataclasses
+        nodes = [make_node(f"n{i}").capacity(
+            {"cpu": 4 + i % 3, "memory": f"{8 + i % 5}Gi", "pods": 110})
+            .zone(f"z{i % 2}").obj() for i in range(12)]
+        pods = [make_pod(f"p{i}").req({"cpu": "500m", "memory": "512Mi"}).obj()
+                for i in range(24)]
+        _assert_fast_equals_slow(nodes, pods)
+
+    def test_mixed_signature_runs(self):
+        nodes = [make_node(f"n{i}").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110})
+            .taint("soft", "x", "PreferNoSchedule" if i % 3 == 0 else "NoSchedule")
+            .obj() for i in range(8)]
+        for n in nodes[:4]:
+            n.spec.taints.clear()
+        pods = []
+        for i in range(16):
+            w = make_pod(f"p{i}").req({"cpu": "250m"})
+            if i % 4 < 2:  # two alternating signature groups in runs of 2
+                w = w.toleration(key="soft", operator="Equal", value="x")
+            pods.append(w.obj())
+        _assert_fast_equals_slow(nodes, pods)
+
+
+def _assert_fast_equals_slow(nodes, pods):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    batch = BatchBuilder(state).build(pods)
+    assert not batch.host_fallback.any()
+    xs, table = pod_rows_from_batch(batch)
+    na = state.device_arrays()
+    cfg = ScoreConfig()
+    # sanity: the batch really contains repeated signatures
+    sigs = np.asarray(batch.sig)[:len(pods)]
+    assert (np.diff(sigs) == 0).any(), "test should exercise the fast path"
+    carry_f, assign_f = run_batch(cfg, na, initial_carry(na), xs, table)
+    xs_slow = xs._replace(sig=jnp.zeros_like(xs.sig))
+    carry_s, assign_s = run_batch(cfg, na, initial_carry(na), xs_slow, table)
+    np.testing.assert_array_equal(np.asarray(assign_f), np.asarray(assign_s))
+    for name in ("used", "nonzero_used", "npods", "ports"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(carry_f, name)),
+            np.asarray(getattr(carry_s, name)), err_msg=name)

@@ -133,9 +133,10 @@ class TestChurn:
                     {"cpu": "100m", "memory": "128Mi"}).obj())
             assert sched.schedule_pending() == 64
         assert api.binding_count == 192
-        # cache and device state agree at the end
-        sched.cache.update_snapshot(sched.snapshot)
-        assert sched.state.reconcile(sched.snapshot) == []
+        # cache and device state agree at the end; the carry stayed
+        # device-resident across all batches
+        assert sched._device_carry is not None
+        assert sched.reconcile() == []
 
 
 class TestAffinityParityRouting:

@@ -71,17 +71,17 @@ def test_sharded_matches_single_device(n_devices):
     builder = BatchBuilder(state)
     batch = builder.build(build_pods(16))
     assert not batch.host_fallback.any()
-    pods = pod_rows_from_batch(batch)
+    xs, table = pod_rows_from_batch(batch)
     cfg = ScoreConfig()
 
     na = state.device_arrays()
     carry0 = initial_carry(na)
-    single_carry, single_assign = run_batch(cfg, na, carry0, pods)
+    single_carry, single_assign = run_batch(cfg, na, carry0, xs, table)
 
     mesh = make_mesh(n_devices)
     na_sh = shard_node_arrays(mesh, na)
     sh_carry, sh_assign = run_batch_sharded(cfg, mesh, na_sh,
-                                            initial_carry(na_sh), pods)
+                                            initial_carry(na_sh), xs, table)
 
     np.testing.assert_array_equal(np.asarray(single_assign),
                                   np.asarray(sh_assign))
@@ -96,9 +96,9 @@ def test_sharded_respects_infeasibility():
     builder = BatchBuilder(state)
     pods = [make_pod("huge").req({"cpu": "512"}).obj()]
     batch = builder.build(pods)
-    rows = pod_rows_from_batch(batch)
+    xs, table = pod_rows_from_batch(batch)
     mesh = make_mesh(4)
     na = shard_node_arrays(mesh, state.device_arrays())
     _, assign = run_batch_sharded(ScoreConfig(), mesh, na,
-                                  initial_carry(na), rows)
+                                  initial_carry(na), xs, table)
     assert int(np.asarray(assign)[0]) == -1
