@@ -1,0 +1,804 @@
+"""Group kernels: PodTopologySpread + InterPodAffinity on device.
+
+The reference evaluates these plugins per pod with topologyPair→count hash
+maps rebuilt every cycle (podtopologyspread/filtering.go:237-312,
+interpodaffinity/filtering.go:204-273). The TPU form replaces each map with a
+per-NODE count vector shared across nodes with equal topology value: for a
+map keyed (topologyKey, value), `cnt[n] = map[(key, tv(n))]` — the device
+never materializes the map, only its gather along the node axis. Counts ride
+the scan carry and are updated after every placement with one vectorized
+"same-topology-value" broadcast, which reproduces the reference's
+AddPod/RemovePod incremental semantics (filtering.go:157-178, :322-341)
+without any host round-trip.
+
+Three layers:
+
+- `GroupsDev` — static per-(signature, node) tensors: interned topology
+  values per constraint/term, count-eligibility masks (node inclusion
+  policies, common.go:43-57), and the pairwise signature match matrices that
+  say whether a pod of signature u contributes to the counts of signature v.
+  Recomputed host-side when the node set or the signature table changes.
+- `GroupCarry` — the dynamic counts (spread match counts per DoNotSchedule /
+  ScheduleAnyway constraint, the three inter-pod affinity maps of
+  filtering.go:45-57, and the symmetric preferred-affinity score surface of
+  scoring.go:81-124). Seeded host-side from the live snapshot by REUSING the
+  host plugins' PreFilter/PreScore (guaranteeing seed parity), then carried
+  forward on device.
+- eval/update kernels called from the scan step in ops/program.py.
+
+`GroupManager` (host) owns signature-row parsing, the match matrices, and
+seeding. Pods whose constraints exceed the padded dims fall back to the host
+oracle individually — never the whole batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+
+MAX_NODE_SCORE = 100
+
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+# ---------------------------------------------------------------------------
+# dims
+
+
+@dataclass
+class GroupDims:
+    spread_constraints: int = 2   # SC — per action (DoNotSchedule / ScheduleAnyway)
+    ipa_req_terms: int = 2        # TA — required affinity terms
+    ipa_anti_terms: int = 2       # TAA — required anti-affinity terms
+    ipa_cons_terms: int = 4       # CT — consumer-side preferred (score) terms
+    ipa_plcd_terms: int = 6       # PT — placed-side score terms (req_a + preferred)
+
+
+# ---------------------------------------------------------------------------
+# device structures
+
+
+class GroupsDev(NamedTuple):
+    """Static per-table tensors ([U] = signature rows, [N] = node axis)."""
+
+    # spread DoNotSchedule constraints (filtering.go)
+    spr_f_active: object      # bool [U, SC]
+    spr_f_max_skew: object    # i32 [U, SC]
+    spr_f_self: object        # i32 [U, SC] — selfMatchNum (filtering.go:338)
+    spr_f_tv: object          # i32 [U, SC, N] — node's interned topo value (0 = absent)
+    spr_f_elig: object        # bool [U, SC, N] — counted node (keys + inclusion)
+    # spread ScheduleAnyway constraints (scoring.go)
+    spr_s_active: object      # bool [U, SC]
+    spr_s_max_skew: object    # i32 [U, SC]
+    spr_s_is_host: object     # bool [U, SC] — hostname key: per-node counts
+    spr_s_tv: object          # i32 [U, SC, N]
+    spr_s_elig: object        # bool [U, SC, N]
+    spr_s_keys_ok: object     # bool [U, N] — all score topo keys present
+    spr_s_dom: object         # i32 [U, SC, N] — dense domain id (first node idx w/ tv)
+    # inter-pod affinity required terms (filtering.go)
+    ipa_ra_active: object     # bool [U, TA]
+    ipa_ra_tv: object         # i32 [U, TA, N]
+    ipa_raa_active: object    # bool [U, TAA]
+    ipa_raa_tv: object        # i32 [U, TAA, N]
+    ipa_self_all: object      # bool [U] — pod matches all own affinity terms
+    # inter-pod affinity score terms (scoring.go)
+    ipa_stc_tv: object        # i32 [U, CT, N] — consumer (incoming) pref terms
+    ipa_stp_tv: object        # i32 [U, PT, N] — placed (existing) side terms
+    # pairwise signature match matrices [placed-row, consumer-row, ...]
+    m_spr_f: object           # bool [U, U, SC]
+    m_spr_s: object           # bool [U, U, SC]
+    m_ipa_a: object           # bool [U, U] — placed matches ALL consumer req terms
+    m_ipa_aa: object          # bool [U, U, TAA] — per consumer anti term
+    m_ipa_exist: object       # bool [U, U, TAA] — placed's anti term matches consumer
+    w_stc: object             # i64 [U, U, CT] — signed weight (0 = no match)
+    w_stp: object             # i64 [U, U, PT]
+
+
+class GroupCarry(NamedTuple):
+    """Dynamic counts riding the scan carry."""
+
+    spr_f_cnt: object         # i32 [U, SC, N]
+    spr_f_min_zero: object    # bool [U, SC] — eligible domains < minDomains
+    spr_s_cnt: object         # i32 [U, SC, N]
+    ipa_veto: object          # i32 [U, N] — existingAntiAffinityCounts per node
+    ipa_a_cnt: object         # i32 [U, TA, N]
+    ipa_a_total: object       # i64 [U] — affinityCounts map emptiness tracker
+    ipa_aa_cnt: object        # i32 [U, TAA, N]
+    ipa_score: object         # i64 [U, N] — symmetric topology score surface
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+
+
+def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None):
+    """Feasibility over the node axis for the pod signature `tidx`:
+    spread skew check (filtering.go:314-360) AND the three inter-pod
+    affinity checks (filtering.go:405-432)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    # -- spread skew (DoNotSchedule)
+    act = gd.spr_f_active[tidx]                     # [SC]
+    cnt = gc.spr_f_cnt[tidx]                        # [SC, N]
+    elig = gd.spr_f_elig[tidx]
+    tv = gd.spr_f_tv[tidx]
+    minv = jnp.min(jnp.where(elig, cnt, INT32_MAX), axis=-1)   # [SC]
+    if axis is not None:
+        minv = lax.pmin(minv, axis)
+    # fewer eligible domains than minDomains (incl. zero domains) ⇒ min = 0
+    # (filtering.go:66-77)
+    minv = jnp.where(gc.spr_f_min_zero[tidx], 0, minv)
+    ok = (cnt + gd.spr_f_self[tidx][:, None] - minv[:, None]
+          <= gd.spr_f_max_skew[tidx][:, None])
+    # node missing the topology key ⇒ UnschedulableAndUnresolvable
+    spread_ok = jnp.all(~act[:, None] | ((tv != 0) & ok), axis=0)
+
+    # -- existing pods' required anti-affinity (filtering.go:204-228)
+    veto_ok = gc.ipa_veto[tidx] == 0
+
+    # -- incoming required anti-affinity
+    raa_act = gd.ipa_raa_active[tidx]               # [TAA]
+    raa_tv = gd.ipa_raa_tv[tidx]                    # [TAA, N]
+    aa_bad = jnp.any(raa_act[:, None] & (raa_tv != 0)
+                     & (gc.ipa_aa_cnt[tidx] > 0), axis=0)
+
+    # -- incoming required affinity (incl. the first-pod-in-series escape
+    # hatch, filtering.go:381-397)
+    ra_act = gd.ipa_ra_active[tidx]                 # [TA]
+    ra_tv = gd.ipa_ra_tv[tidx]                      # [TA, N]
+    tv_all = jnp.all(~ra_act[:, None] | (ra_tv != 0), axis=0)
+    pods_exist = jnp.all(~ra_act[:, None] | (gc.ipa_a_cnt[tidx] > 0), axis=0)
+    escape = (gc.ipa_a_total[tidx] == 0) & gd.ipa_self_all[tidx]
+    aff_ok = jnp.where(jnp.any(ra_act), tv_all & (pods_exist | escape), True)
+
+    return spread_ok & veto_ok & ~aa_bad & aff_ok
+
+
+def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
+                 tidx, feasible, axis: Optional[str] = None,
+                 n_global: Optional[int] = None):
+    """Weighted PodTopologySpread + InterPodAffinity score over the node
+    axis, already normalized per the host plugins' Normalize formulas.
+    `feasible` is the FULL filtered set (all plugins), matching the host
+    runtime's normalize-over-filtered-list semantics. `n_global` is the
+    unsharded node-axis length (defaults to the local length)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    N = feasible.shape[0]
+    if n_global is None:
+        n_global = N
+
+    def _gmin(x):
+        return lax.pmin(x, axis) if axis is not None else x
+
+    def _gmax(x):
+        return lax.pmax(x, axis) if axis is not None else x
+
+    def _gsum(x):
+        return lax.psum(x, axis) if axis is not None else x
+
+    # ---- PodTopologySpread (scoring.go:199-271) ----
+    s_act = gd.spr_s_active[tidx]                   # [SC]
+    has_s = jnp.any(s_act)
+    keys_ok = gd.spr_s_keys_ok[tidx]                # [N]
+    scored = feasible & keys_ok
+    npart = _gsum(jnp.sum(scored))
+    # per-constraint domain count among scored nodes (topologyNormalizingWeight)
+    dom = gd.spr_s_dom[tidx]                        # [SC, N]
+    flags = jnp.zeros((dom.shape[0], n_global), jnp.int32)
+    flags = flags.at[jnp.arange(dom.shape[0])[:, None], dom].max(
+        jnp.broadcast_to(scored.astype(jnp.int32), dom.shape))
+    if axis is not None:
+        flags = lax.psum(flags, axis)
+    distinct = jnp.sum(flags > 0, axis=1)           # [SC]
+    size = jnp.where(gd.spr_s_is_host[tidx], npart, distinct)
+    weight = jnp.log(size.astype(jnp.float64) + 2.0)  # [SC]
+    cnt_s = gc.spr_s_cnt[tidx]                      # [SC, N]
+    tv_s = gd.spr_s_tv[tidx]
+    contrib = jnp.where(
+        s_act[:, None] & (tv_s != 0),
+        cnt_s.astype(jnp.float64) * weight[:, None]
+        + (gd.spr_s_max_skew[tidx][:, None] - 1).astype(jnp.float64),
+        0.0)
+    raw = jnp.round(jnp.sum(contrib, axis=0)).astype(jnp.int64)  # [N]
+    # normalize (host plugin normalize_scores: MAX·(max+min−s)//max)
+    minv = _gmin(jnp.min(jnp.where(scored, raw, INT32_MAX)))
+    maxv = _gmax(jnp.max(jnp.where(scored, raw, 0)))
+    norm = jnp.where(maxv == 0, MAX_NODE_SCORE,
+                     MAX_NODE_SCORE * (maxv + minv - raw) // jnp.maximum(maxv, 1))
+    spread_score = jnp.where(has_s & scored, norm, 0)
+    # ignored (missing-keys) nodes score 0; infeasible rows are masked later
+
+    # ---- InterPodAffinity (scoring.go:263-293) ----
+    s = gc.ipa_score[tidx]                          # [N] i64
+    big = jnp.iinfo(jnp.int64).max
+    minv2 = _gmin(jnp.min(jnp.where(feasible, s, big)))
+    maxv2 = _gmax(jnp.max(jnp.where(feasible, s, -big)))
+    diff = maxv2 - minv2
+    ipa_norm = jnp.where(
+        diff > 0,
+        (MAX_NODE_SCORE * (s - minv2).astype(jnp.float64)
+         / jnp.maximum(diff, 1).astype(jnp.float64)),
+        0.0).astype(jnp.int64)
+
+    return w_spread * spread_score + w_ipa * ipa_norm
+
+
+def group_update(gd: GroupsDev, gc: GroupCarry, tidx, pick, is_chosen, gate):
+    """Carry update after placing a pod of signature `tidx`.
+
+    `pick(arr)` extracts `arr[..., b]` for the chosen node b (the sharded
+    path substitutes a cross-shard broadcast); `is_chosen` is bool[N_local]
+    marking the chosen node's row (all-false on non-owning shards); `gate` is
+    the placement-happened scalar. Mirrors a fresh recount after the
+    placement: counts are additive over pods and node labels are static, so
+    the incremental broadcast equals the reference's per-cycle rebuild."""
+    import jax.numpy as jnp
+
+    u = tidx
+    gate_i = gate.astype(jnp.int32)
+
+    # spread filter counts: +1 at every node sharing the chosen node's
+    # topology value, per consumer constraint the placed pod matches, iff the
+    # chosen node is count-eligible for that constraint
+    tvb_f = pick(gd.spr_f_tv)                       # [U, SC]
+    eligb_f = pick(gd.spr_f_elig)                   # [U, SC]
+    inc_f = ((gd.m_spr_f[u] & eligb_f)[:, :, None]
+             & (gd.spr_f_tv == tvb_f[:, :, None]) & (tvb_f[:, :, None] != 0))
+    spr_f_cnt = gc.spr_f_cnt + gate_i * inc_f.astype(jnp.int32)
+
+    # spread score counts: hostname constraints count the node's own pods
+    # (scoring.go score()); other keys share by topology value
+    tvb_s = pick(gd.spr_s_tv)
+    eligb_s = pick(gd.spr_s_elig)
+    is_b = is_chosen[None, None, :]                 # [1, 1, N]
+    share_s = jnp.where(gd.spr_s_is_host[:, :, None], is_b,
+                        (gd.spr_s_tv == tvb_s[:, :, None])
+                        & (tvb_s[:, :, None] != 0))
+    gate_c = jnp.where(gd.spr_s_is_host, gd.m_spr_s[u],
+                       gd.m_spr_s[u] & eligb_s)
+    spr_s_cnt = gc.spr_s_cnt + gate_i * (gate_c[:, :, None] & share_s).astype(jnp.int32)
+
+    # existing-anti veto: the placed pod's own required anti terms add a
+    # (term.key, tv(b)) pair for every consumer signature they match
+    tvb_p_anti = pick(gd.ipa_raa_tv)[u]             # [TAA]
+    share_anti = ((gd.ipa_raa_tv[u] == tvb_p_anti[:, None])
+                  & (tvb_p_anti[:, None] != 0))     # [TAA, N]
+    delta_veto = jnp.sum(gd.m_ipa_exist[u][:, :, None] & share_anti[None],
+                         axis=1).astype(jnp.int32)  # [U, N]
+    ipa_veto = gc.ipa_veto + gate_i * delta_veto
+
+    # incoming-affinity counts: placed pod matching ALL of a consumer's
+    # required terms bumps each term's (key, tv(b)) pair
+    tvb_a = pick(gd.ipa_ra_tv)                      # [U, TA]
+    share_a = (gd.ipa_ra_tv == tvb_a[:, :, None]) & (tvb_a[:, :, None] != 0)
+    inc_a = (gd.m_ipa_a[u][:, None] & gd.ipa_ra_active)[:, :, None] & share_a
+    ipa_a_cnt = gc.ipa_a_cnt + gate_i * inc_a.astype(jnp.int32)
+    ipa_a_total = gc.ipa_a_total + (
+        gate_i * gd.m_ipa_a[u]
+        * jnp.sum(gd.ipa_ra_active & (tvb_a != 0), axis=1)).astype(jnp.int64)
+
+    # incoming-anti counts (per consumer term)
+    tvb_aa = pick(gd.ipa_raa_tv)                    # [U, TAA]
+    share_aa = (gd.ipa_raa_tv == tvb_aa[:, :, None]) & (tvb_aa[:, :, None] != 0)
+    inc_aa = gd.m_ipa_aa[u][:, :, None] & share_aa
+    ipa_aa_cnt = gc.ipa_aa_cnt + gate_i * inc_aa.astype(jnp.int32)
+
+    # symmetric score surface: consumer-side preferred terms matching the
+    # placed pod, plus placed-side (req×hardWeight + preferred) terms
+    # matching the consumer (scoring.go:81-124)
+    tvb_c = pick(gd.ipa_stc_tv)                     # [U, CT]
+    share_c = (gd.ipa_stc_tv == tvb_c[:, :, None]) & (tvb_c[:, :, None] != 0)
+    d_cons = jnp.sum(gd.w_stc[u][:, :, None] * share_c, axis=1)   # [U, N]
+    tvb_p = pick(gd.ipa_stp_tv)[u]                  # [PT]
+    share_p = (gd.ipa_stp_tv[u] == tvb_p[:, None]) & (tvb_p[:, None] != 0)
+    d_plcd = jnp.sum(gd.w_stp[u][:, :, None] * share_p[None], axis=1)
+    ipa_score = gc.ipa_score + gate.astype(jnp.int64) * (d_cons + d_plcd)
+
+    return GroupCarry(spr_f_cnt=spr_f_cnt, spr_f_min_zero=gc.spr_f_min_zero,
+                      spr_s_cnt=spr_s_cnt, ipa_veto=ipa_veto,
+                      ipa_a_cnt=ipa_a_cnt, ipa_a_total=ipa_a_total,
+                      ipa_aa_cnt=ipa_aa_cnt, ipa_score=ipa_score)
+
+
+# ---------------------------------------------------------------------------
+# host side: row parsing, match matrices, node data, seeding
+
+
+@dataclass
+class GroupRowInfo:
+    """Host-parsed group constraints for one signature row."""
+
+    pod: object                    # representative pod (signature-identical)
+    f_constraints: list            # spread _Constraint, DoNotSchedule
+    s_constraints: list            # spread _Constraint, ScheduleAnyway
+    req_a: list                    # merged-ns ParsedTerm (incoming affinity)
+    req_aa: list                   # merged-ns ParsedTerm (incoming anti)
+    req_aa_raw: list               # raw ParsedTerm (existing-pod side)
+    stc_terms: list                # [(ParsedTerm, ±weight)] consumer score terms
+    stp_terms: list                # [(ParsedTerm, ±weight)] placed score terms
+    self_all: bool
+
+    @property
+    def has_groups(self) -> bool:
+        return bool(self.f_constraints or self.s_constraints or self.req_a
+                    or self.req_aa or self.stc_terms or self.stp_terms)
+
+
+class GroupManager:
+    """Owns per-signature-row group data + pairwise match matrices.
+
+    Parsing and matching REUSE the host plugins' code paths
+    (podtopologyspread._parse_constraints / _count_pods_match_selector,
+    interpodaffinity.parse_pod_affinity_terms / ParsedTerm.matches), so the
+    device program's inputs are by construction the same quantities the host
+    oracle computes."""
+
+    def __init__(self, state, spread_plugin=None, ipa_plugin=None,
+                 dims: Optional[GroupDims] = None, table_rows: int = 16):
+        from ..plugins.interpodaffinity import InterPodAffinity
+        from ..plugins.podtopologyspread import PodTopologySpread
+
+        self.state = state
+        self.pts = spread_plugin or PodTopologySpread()
+        self.ipa = ipa_plugin or InterPodAffinity()
+        self.dims = dims or GroupDims()
+        self.rows: list[Optional[GroupRowInfo]] = []
+        self._alloc(table_rows)
+        self.group_row_count = 0   # rows with any group constraints
+
+    # -- storage --------------------------------------------------------------
+
+    def _alloc(self, U: int) -> None:
+        d = self.dims
+        self.U = U
+        self.spr_f_active = np.zeros((U, d.spread_constraints), bool)
+        self.spr_f_max_skew = np.zeros((U, d.spread_constraints), np.int32)
+        self.spr_f_self = np.zeros((U, d.spread_constraints), np.int32)
+        self.spr_s_active = np.zeros((U, d.spread_constraints), bool)
+        self.spr_s_max_skew = np.zeros((U, d.spread_constraints), np.int32)
+        self.spr_s_is_host = np.zeros((U, d.spread_constraints), bool)
+        self.ipa_ra_active = np.zeros((U, d.ipa_req_terms), bool)
+        self.ipa_raa_active = np.zeros((U, d.ipa_anti_terms), bool)
+        self.ipa_self_all = np.zeros((U,), bool)
+        self.m_spr_f = np.zeros((U, U, d.spread_constraints), bool)
+        self.m_spr_s = np.zeros((U, U, d.spread_constraints), bool)
+        self.m_ipa_a = np.zeros((U, U), bool)
+        self.m_ipa_aa = np.zeros((U, U, d.ipa_anti_terms), bool)
+        self.m_ipa_exist = np.zeros((U, U, d.ipa_anti_terms), bool)
+        self.w_stc = np.zeros((U, U, d.ipa_cons_terms), np.int64)
+        self.w_stp = np.zeros((U, U, d.ipa_plcd_terms), np.int64)
+
+    def grow(self, U: int) -> None:
+        old = (self.spr_f_active, self.spr_f_max_skew, self.spr_f_self,
+               self.spr_s_active, self.spr_s_max_skew, self.spr_s_is_host,
+               self.ipa_ra_active, self.ipa_raa_active, self.ipa_self_all,
+               self.m_spr_f, self.m_spr_s, self.m_ipa_a, self.m_ipa_aa,
+               self.m_ipa_exist, self.w_stc, self.w_stp)
+        u0 = len(self.rows)
+        self._alloc(U)
+        names = ("spr_f_active", "spr_f_max_skew", "spr_f_self",
+                 "spr_s_active", "spr_s_max_skew", "spr_s_is_host",
+                 "ipa_ra_active", "ipa_raa_active", "ipa_self_all",
+                 "m_spr_f", "m_spr_s", "m_ipa_a", "m_ipa_aa",
+                 "m_ipa_exist", "w_stc", "w_stp")
+        for name, arr in zip(names, old):
+            new = getattr(self, name)
+            if arr.ndim >= 2 and arr.shape[1] == arr.shape[0]:  # [U, U, ...]
+                new[:u0, :u0] = arr[:u0, :u0]
+            else:
+                new[:u0] = arr[:u0]
+
+    def reset(self) -> None:
+        self.rows.clear()
+        self._alloc(self.U)
+        self.group_row_count = 0
+
+    # -- row addition ---------------------------------------------------------
+
+    def add_row(self, u: int, pod) -> None:
+        """Parse + store row u; raises BatchCapacityError when the pod's
+        constraints exceed the padded dims (that pod goes to the host
+        oracle individually)."""
+        from ..api.types import UnsatisfiableConstraintAction as UCA
+        from ..plugins.interpodaffinity import (
+            WeightedTerm, _pod_matches_all_affinity_terms,
+            parse_pod_affinity_terms)
+        from ..state.batch import BatchCapacityError
+
+        d = self.dims
+        f_cons = self.pts._get_constraints(pod, UCA.DO_NOT_SCHEDULE.value)
+        s_cons = self.pts._get_constraints(pod, UCA.SCHEDULE_ANYWAY.value)
+        if (self.pts.system_defaulted
+                and not pod.spec.topology_spread_constraints
+                and (f_cons or s_cons)):
+            # relaxed require_all semantics of system defaulting have no
+            # tensor form (scoring.go requireAllTopologies=false)
+            raise BatchCapacityError("system-defaulted spread: host path")
+        if len(f_cons) > d.spread_constraints or len(s_cons) > d.spread_constraints:
+            raise BatchCapacityError("too many spread constraints")
+
+        req_a, req_aa_raw, pref_a, pref_aa = parse_pod_affinity_terms(pod)
+        if self.ipa.args.ignore_preferred_terms_of_existing_pods and (
+                req_a or req_aa_raw or pref_a or pref_aa):
+            raise BatchCapacityError("ignorePreferredTermsOfExistingPods: host path")
+        req_a_m = [self.ipa._merge_term_namespaces(t) for t in req_a]
+        req_aa_m = [self.ipa._merge_term_namespaces(t) for t in req_aa_raw]
+        if len(req_a_m) > d.ipa_req_terms or len(req_aa_m) > d.ipa_anti_terms:
+            raise BatchCapacityError("too many inter-pod affinity terms")
+        # consumer-side score terms: incoming pod's MERGED preferred terms
+        stc = ([(WeightedTerm(self.ipa._merge_term_namespaces(w.term), w.weight).term,
+                 w.weight) for w in pref_a]
+               + [(self.ipa._merge_term_namespaces(w.term), -w.weight)
+                  for w in pref_aa])
+        # placed-side score terms: RAW required (× hard weight) + preferred
+        hw = self.ipa.args.hard_pod_affinity_weight
+        stp = ([(t, hw) for t in req_a] if hw > 0 else [])
+        stp += [(w.term, w.weight) for w in pref_a]
+        stp += [(w.term, -w.weight) for w in pref_aa]
+        if len(stc) > d.ipa_cons_terms or len(stp) > d.ipa_plcd_terms:
+            raise BatchCapacityError("too many preferred affinity terms")
+
+        info = GroupRowInfo(
+            pod=pod, f_constraints=f_cons, s_constraints=s_cons,
+            req_a=req_a_m, req_aa=req_aa_m, req_aa_raw=req_aa_raw,
+            stc_terms=stc, stp_terms=stp,
+            self_all=_pod_matches_all_affinity_terms(req_a_m, pod))
+        while len(self.rows) <= u:
+            self.rows.append(None)
+        self.rows[u] = info
+        if info.has_groups:
+            self.group_row_count += 1
+
+        # per-row scalars
+        for j, c in enumerate(f_cons):
+            self.spr_f_active[u, j] = True
+            self.spr_f_max_skew[u, j] = c.max_skew
+            self.spr_f_self[u, j] = 1 if c.selector.matches(pod.metadata.labels) else 0
+        for j, c in enumerate(s_cons):
+            self.spr_s_active[u, j] = True
+            self.spr_s_max_skew[u, j] = c.max_skew
+            self.spr_s_is_host[u, j] = c.topology_key == LABEL_HOSTNAME
+        for t in range(len(req_a_m)):
+            self.ipa_ra_active[u, t] = True
+        for t in range(len(req_aa_m)):
+            self.ipa_raa_active[u, t] = True
+        self.ipa_self_all[u] = info.self_all
+
+        # pairwise match matrices vs every existing row (both directions)
+        for v, other in enumerate(self.rows):
+            if other is None:
+                continue
+            self._fill_pair(u, info, v, other)
+            if v != u:
+                self._fill_pair(v, other, u, info)
+
+    def _fill_pair(self, pu: int, placed: GroupRowInfo,
+                   cu: int, cons: GroupRowInfo) -> None:
+        """[placed → consumer] match entries."""
+        from ..plugins.interpodaffinity import _pod_matches_all_affinity_terms
+        from ..plugins.podtopologyspread import (_count_pods_match_selector,
+                                                 _selector_empty)
+
+        ppod, cpod = placed.pod, cons.pod
+        same_ns = ppod.namespace == cpod.namespace
+        for j, c in enumerate(cons.f_constraints):
+            self.m_spr_f[pu, cu, j] = (same_ns and not _selector_empty(c.selector)
+                                       and c.selector.matches(ppod.metadata.labels))
+        for j, c in enumerate(cons.s_constraints):
+            self.m_spr_s[pu, cu, j] = (same_ns and not _selector_empty(c.selector)
+                                       and c.selector.matches(ppod.metadata.labels))
+        self.m_ipa_a[pu, cu] = _pod_matches_all_affinity_terms(cons.req_a, ppod)
+        for t, term in enumerate(cons.req_aa):
+            self.m_ipa_aa[pu, cu, t] = term.matches(ppod, None)
+        ns_labels = self.ipa.ns_lister.labels_of(cpod.namespace)
+        for t, term in enumerate(placed.req_aa_raw):
+            self.m_ipa_exist[pu, cu, t] = term.matches(cpod, ns_labels)
+        for t, (term, w) in enumerate(cons.stc_terms):
+            self.w_stc[pu, cu, t] = w if term.matches(ppod, None) else 0
+        for t, (term, w) in enumerate(placed.stp_terms):
+            self.w_stp[pu, cu, t] = w if term.matches(cpod, ns_labels) else 0
+
+    def any_groups(self) -> bool:
+        return self.group_row_count > 0
+
+    # -- node-dependent statics ----------------------------------------------
+
+    def node_data(self, snapshot, rows: range):
+        """tv / eligibility / domain arrays for the given row slice against
+        the CURRENT node set, laid out in ClusterState row order. Returns a
+        dict of numpy arrays shaped like the matching GroupsDev fields but
+        with a leading axis of len(rows)."""
+        from ..plugins.node_basics import find_matching_untolerated_taint
+        from ..plugins.nodeaffinity import required_node_affinity_matches
+        from ..plugins.podtopologyspread import HONOR
+
+        d = self.dims
+        st = self.state
+        N = st.dims.nodes
+        SC, TA, TAA = d.spread_constraints, d.ipa_req_terms, d.ipa_anti_terms
+        CT, PT = d.ipa_cons_terms, d.ipa_plcd_terms
+        R = len(rows)
+        out = dict(
+            spr_f_tv=np.zeros((R, SC, N), np.int32),
+            spr_f_elig=np.zeros((R, SC, N), bool),
+            spr_s_tv=np.zeros((R, SC, N), np.int32),
+            spr_s_elig=np.zeros((R, SC, N), bool),
+            spr_s_keys_ok=np.zeros((R, N), bool),
+            spr_s_dom=np.zeros((R, SC, N), np.int32),
+            ipa_ra_tv=np.zeros((R, TA, N), np.int32),
+            ipa_raa_tv=np.zeros((R, TAA, N), np.int32),
+            ipa_stc_tv=np.zeros((R, CT, N), np.int32),
+            ipa_stp_tv=np.zeros((R, PT, N), np.int32),
+        )
+        nis = [(st.node_index.get(ni.name), ni)
+               for ni in snapshot.node_info_list]
+        nis = [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+
+        def tv_fill(arr_row, key):
+            kid = {}
+            for idx, ni in nis:
+                v = ni.node.metadata.labels.get(key)
+                if v is not None:
+                    t = kid.get(v)
+                    if t is None:
+                        t = kid[v] = st.interner.label_kv(key, v)
+                    arr_row[idx] = t
+
+        for r, u in enumerate(rows):
+            info = self.rows[u] if u < len(self.rows) else None
+            if info is None:
+                continue
+            pod = info.pod
+            # spread filter
+            if info.f_constraints:
+                keys = [c.topology_key for c in info.f_constraints]
+                for j, c in enumerate(info.f_constraints):
+                    tv_fill(out["spr_f_tv"][r, j], c.topology_key)
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    if not all(k in labels for k in keys):
+                        continue
+                    for j, c in enumerate(info.f_constraints):
+                        ok = True
+                        if c.node_affinity_policy == HONOR:
+                            ok = required_node_affinity_matches(
+                                pod, labels, ni.name)
+                        if ok and c.node_taints_policy == HONOR:
+                            ok = find_matching_untolerated_taint(
+                                ni.node.spec.taints, pod.spec.tolerations,
+                                ("NoSchedule", "NoExecute")) is None
+                        out["spr_f_elig"][r, j, idx] = ok
+            # spread score
+            if info.s_constraints:
+                keys = [c.topology_key for c in info.s_constraints]
+                for j, c in enumerate(info.s_constraints):
+                    tv_fill(out["spr_s_tv"][r, j], c.topology_key)
+                first_idx: list[dict] = [dict() for _ in info.s_constraints]
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    keys_ok = all(k in labels for k in keys)
+                    out["spr_s_keys_ok"][r, idx] = keys_ok
+                    for j, c in enumerate(info.s_constraints):
+                        tv = out["spr_s_tv"][r, j, idx]
+                        dom = first_idx[j].setdefault(int(tv), idx)
+                        out["spr_s_dom"][r, j, idx] = dom
+                        if not keys_ok:
+                            continue
+                        ok = True
+                        if c.node_affinity_policy == HONOR:
+                            ok = required_node_affinity_matches(
+                                pod, labels, ni.name)
+                        if ok and c.node_taints_policy == HONOR:
+                            ok = find_matching_untolerated_taint(
+                                ni.node.spec.taints, pod.spec.tolerations,
+                                ("NoSchedule", "NoExecute")) is None
+                        out["spr_s_elig"][r, j, idx] = ok
+            # inter-pod affinity term topology values
+            for t, term in enumerate(info.req_a):
+                tv_fill(out["ipa_ra_tv"][r, t], term.topology_key)
+            for t, term in enumerate(info.req_aa):
+                tv_fill(out["ipa_raa_tv"][r, t], term.topology_key)
+            for t, (term, _w) in enumerate(info.stc_terms):
+                tv_fill(out["ipa_stc_tv"][r, t], term.topology_key)
+            for t, (term, _w) in enumerate(info.stp_terms):
+                tv_fill(out["ipa_stp_tv"][r, t], term.topology_key)
+        return out
+
+    # -- count seeding --------------------------------------------------------
+
+    def seed_counts(self, snapshot, rows: range):
+        """Count arrays for the given rows from the LIVE snapshot, computed
+        by running the host plugins' PreFilter/PreScore on the representative
+        pod — the device then carries these forward incrementally."""
+        from ..framework.interface import CycleState
+        from ..plugins import interpodaffinity as ipa_mod
+        from ..plugins import podtopologyspread as pts_mod
+
+        d = self.dims
+        st = self.state
+        N = st.dims.nodes
+        SC, TA, TAA = d.spread_constraints, d.ipa_req_terms, d.ipa_anti_terms
+        R = len(rows)
+        out = dict(
+            spr_f_cnt=np.zeros((R, SC, N), np.int32),
+            spr_f_min_zero=np.zeros((R, SC), bool),
+            spr_s_cnt=np.zeros((R, SC, N), np.int32),
+            ipa_veto=np.zeros((R, N), np.int32),
+            ipa_a_cnt=np.zeros((R, TA, N), np.int32),
+            ipa_a_total=np.zeros((R,), np.int64),
+            ipa_aa_cnt=np.zeros((R, TAA, N), np.int32),
+            ipa_score=np.zeros((R, N), np.int64),
+        )
+        node_list = snapshot.node_info_list
+        nis = [(st.node_index.get(ni.name), ni) for ni in node_list]
+        nis = [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+
+        for r, u in enumerate(rows):
+            info = self.rows[u] if u < len(self.rows) else None
+            if info is None:
+                continue
+            pod = info.pod
+            # spread DoNotSchedule counts via the plugin's own PreFilter
+            if info.f_constraints:
+                cs = CycleState()
+                self.pts.pre_filter(cs, pod, node_list)
+                s = cs.read_or_none(pts_mod._PRE_FILTER_KEY)
+                if s is not None:
+                    for j, c in enumerate(s.constraints):
+                        cnts = s.tp_value_to_match_num[j]
+                        out["spr_f_min_zero"][r, j] = len(cnts) < c.min_domains
+                        for idx, ni in nis:
+                            v = ni.node.metadata.labels.get(c.topology_key)
+                            if v is not None:
+                                out["spr_f_cnt"][r, j, idx] = cnts.get(v, 0)
+            # spread ScheduleAnyway counts: hostname keys per node, others
+            # accumulated per topology value over count-eligible nodes
+            for j, c in enumerate(info.s_constraints):
+                if c.topology_key == LABEL_HOSTNAME:
+                    for idx, ni in nis:
+                        out["spr_s_cnt"][r, j, idx] = \
+                            pts_mod._count_pods_match_selector(
+                                ni.pods, c.selector, pod.namespace)
+                    continue
+                keys = [cc.topology_key for cc in info.s_constraints]
+                by_tv: dict[str, int] = {}
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    if not all(k in labels for k in keys):
+                        continue
+                    if not pts_mod._match_node_inclusion_policies(c, pod, ni):
+                        continue
+                    v = labels[c.topology_key]
+                    by_tv[v] = by_tv.get(v, 0) + \
+                        pts_mod._count_pods_match_selector(
+                            ni.pods, c.selector, pod.namespace)
+                for idx, ni in nis:
+                    v = ni.node.metadata.labels.get(c.topology_key)
+                    if v is not None:
+                        out["spr_s_cnt"][r, j, idx] = by_tv.get(v, 0)
+            # inter-pod affinity maps via the plugin's PreFilter
+            cs = CycleState()
+            self.ipa.pre_filter(cs, pod, node_list)
+            s = cs.read_or_none(ipa_mod._PRE_FILTER_KEY)
+            if s is not None:
+                out["ipa_a_total"][r] = sum(s.affinity_counts.values())
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    veto = 0
+                    for kv in labels.items():
+                        veto += s.existing_anti_affinity_counts.get(kv, 0)
+                    out["ipa_veto"][r, idx] = veto
+                    for t, term in enumerate(info.req_a):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            out["ipa_a_cnt"][r, t, idx] = \
+                                s.affinity_counts.get((term.topology_key, v), 0)
+                    for t, term in enumerate(info.req_aa):
+                        v = labels.get(term.topology_key)
+                        if v is not None:
+                            out["ipa_aa_cnt"][r, t, idx] = \
+                                s.anti_affinity_counts.get((term.topology_key, v), 0)
+            # symmetric score surface via the plugin's PreScore
+            cs = CycleState()
+            self.ipa.pre_score(cs, pod, node_list, all_nodes=node_list)
+            ps = cs.read_or_none(ipa_mod._PRE_SCORE_KEY)
+            if ps is not None:
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    total = 0
+                    for tk, tv_scores in ps.topology_score.items():
+                        v = labels.get(tk)
+                        if v is not None:
+                            total += tv_scores.get(v, 0)
+                    out["ipa_score"][r, idx] = total
+        return out
+
+    # -- assembly -------------------------------------------------------------
+
+    def build_dev(self, snapshot) -> "tuple[GroupsDev, GroupCarry]":
+        """Full (GroupsDev, GroupCarry) numpy build for all rows."""
+        rows = range(len(self.rows))
+        nd = self.node_data(snapshot, rows)
+        seeds = self.seed_counts(snapshot, rows)
+        U, N = self.U, self.state.dims.nodes
+        d = self.dims
+
+        def full(name, shape, dtype):
+            arr = np.zeros(shape, dtype)
+            src = nd.get(name) if name in nd else seeds.get(name)
+            arr[:src.shape[0]] = src
+            return arr
+
+        gd = GroupsDev(
+            spr_f_active=self.spr_f_active.copy(),
+            spr_f_max_skew=self.spr_f_max_skew.copy(),
+            spr_f_self=self.spr_f_self.copy(),
+            spr_f_tv=full("spr_f_tv", (U, d.spread_constraints, N), np.int32),
+            spr_f_elig=full("spr_f_elig", (U, d.spread_constraints, N), bool),
+            spr_s_active=self.spr_s_active.copy(),
+            spr_s_max_skew=self.spr_s_max_skew.copy(),
+            spr_s_is_host=self.spr_s_is_host.copy(),
+            spr_s_tv=full("spr_s_tv", (U, d.spread_constraints, N), np.int32),
+            spr_s_elig=full("spr_s_elig", (U, d.spread_constraints, N), bool),
+            spr_s_keys_ok=full("spr_s_keys_ok", (U, N), bool),
+            spr_s_dom=full("spr_s_dom", (U, d.spread_constraints, N), np.int32),
+            ipa_ra_active=self.ipa_ra_active.copy(),
+            ipa_ra_tv=full("ipa_ra_tv", (U, d.ipa_req_terms, N), np.int32),
+            ipa_raa_active=self.ipa_raa_active.copy(),
+            ipa_raa_tv=full("ipa_raa_tv", (U, d.ipa_anti_terms, N), np.int32),
+            ipa_self_all=self.ipa_self_all.copy(),
+            ipa_stc_tv=full("ipa_stc_tv", (U, d.ipa_cons_terms, N), np.int32),
+            ipa_stp_tv=full("ipa_stp_tv", (U, d.ipa_plcd_terms, N), np.int32),
+            m_spr_f=self.m_spr_f.copy(), m_spr_s=self.m_spr_s.copy(),
+            m_ipa_a=self.m_ipa_a.copy(), m_ipa_aa=self.m_ipa_aa.copy(),
+            m_ipa_exist=self.m_ipa_exist.copy(),
+            w_stc=self.w_stc.copy(), w_stp=self.w_stp.copy(),
+        )
+        gc = GroupCarry(
+            spr_f_cnt=full("spr_f_cnt", (U, d.spread_constraints, N), np.int32),
+            spr_f_min_zero=full("spr_f_min_zero", (U, d.spread_constraints), bool),
+            spr_s_cnt=full("spr_s_cnt", (U, d.spread_constraints, N), np.int32),
+            ipa_veto=full("ipa_veto", (U, N), np.int32),
+            ipa_a_cnt=full("ipa_a_cnt", (U, d.ipa_req_terms, N), np.int32),
+            ipa_a_total=full("ipa_a_total", (U,), np.int64),
+            ipa_aa_cnt=full("ipa_aa_cnt", (U, d.ipa_anti_terms, N), np.int32),
+            ipa_score=full("ipa_score", (U, N), np.int64),
+        )
+        return gd, gc
+
+
+def to_device(tree):
+    """numpy → jnp leaves of a GroupsDev / GroupCarry."""
+    import jax.numpy as jnp
+    return type(tree)(*(jnp.asarray(x) for x in tree))
+
+
+def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
+                     mgr: GroupManager, snapshot, lo: int, hi: int):
+    """Seed rows [lo, hi) into resident device group state: node-dependent
+    tensors and counts scatter into the row slice; the small per-row scalars
+    and pairwise matrices (which gained entries against OLD rows too) are
+    re-uploaded whole."""
+    import jax.numpy as jnp
+
+    rows = range(lo, hi)
+    nd = mgr.node_data(snapshot, rows)
+    seeds = mgr.seed_counts(snapshot, rows)
+    gd_kw = {name: getattr(gd_dev, name).at[lo:hi].set(jnp.asarray(nd[name]))
+             for name in nd}
+    for name in ("spr_f_active", "spr_f_max_skew", "spr_f_self",
+                 "spr_s_active", "spr_s_max_skew", "spr_s_is_host",
+                 "ipa_ra_active", "ipa_raa_active", "ipa_self_all",
+                 "m_spr_f", "m_spr_s", "m_ipa_a", "m_ipa_aa", "m_ipa_exist",
+                 "w_stc", "w_stp"):
+        gd_kw[name] = jnp.asarray(getattr(mgr, name))
+    gc_kw = {name: getattr(gc_dev, name).at[lo:hi].set(jnp.asarray(seeds[name]))
+             for name in seeds}
+    return gd_dev._replace(**gd_kw), gc_dev._replace(**gc_kw)

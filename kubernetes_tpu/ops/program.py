@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.groups import (GroupCarry, GroupsDev, group_mask, group_scores,
+                          group_update)
 from ..state.batch import (OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN,
                            OP_LT, OP_NOT_IN, TOL_EQUAL, TOL_EXISTS)
 from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
@@ -55,6 +57,8 @@ class ScoreConfig(NamedTuple):
     w_balanced: int = 1
     w_taint: int = 3
     w_node_affinity: int = 2
+    w_spread: int = 2                           # PodTopologySpread weight
+    w_ipa: int = 2                              # InterPodAffinity weight
     strategy: str = "LeastAllocated"            # or MostAllocated
 
 
@@ -80,6 +84,10 @@ class Carry(NamedTuple):
     npods: jnp.ndarray         # i32 [N]
     ports: jnp.ndarray         # i32 [N, P]
     cache: SigCache
+    # PodTopologySpread / InterPodAffinity counts (None when the batch and
+    # cluster carry no group constraints — the lean program compiles without
+    # any group compute)
+    groups: GroupCarry | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -398,11 +406,15 @@ def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
 
 
 def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
-              axis: str | None = None):
+              axis: str | None = None, groups: GroupsDev | None = None,
+              tidx=None, n_global: int | None = None):
     """Feasibility + total score for one pod over all nodes → (mask, score,
     parts). Consults the signature cache: a pod whose sig matches the carry's
-    reuses every carry-independent kernel (the expensive ones).
-    `axis` names the mesh axis when `na`/`carry` hold one node shard."""
+    reuses every carry-independent kernel (the expensive ones). Group kernels
+    (spread/inter-pod affinity) are carry-COUPLED — every placement can move
+    their counts for every signature — so they always evaluate live and are
+    never cached. `axis` names the mesh axis when `na`/`carry` hold one node
+    shard."""
     cache = carry.cache
     use_fast = (pod.sig != 0) & (pod.sig == cache.sig)
     m, taint_raw, na_raw, fit_ok, s_fit, s_bal = lax.cond(
@@ -412,10 +424,19 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
         lambda: _slow_parts(cfg, na, carry, pod))
 
     feasible = m & fit_ok
+    if groups is not None:
+        # fold in BEFORE normalization: the host runtime normalizes over the
+        # fully-filtered node list, so a group-filtered node must not set the
+        # normalization max (runtime/framework.go:1286-1390 semantics)
+        feasible &= group_mask(groups, carry.groups, tidx, axis=axis)
     s_taint = default_normalize(taint_raw, feasible, reverse=True, axis=axis)
     s_na = default_normalize(na_raw, feasible, reverse=False, axis=axis)
     total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
              + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)
+    if groups is not None:
+        total = total + group_scores(cfg.w_spread, cfg.w_ipa, groups,
+                                     carry.groups, tidx, feasible,
+                                     axis=axis, n_global=n_global)
     parts = SigCache(sig=pod.sig, static_mask=m, taint_raw=taint_raw,
                      na_raw=na_raw, fit_ok=fit_ok, s_fit=s_fit, s_bal=s_bal)
     return feasible, total, parts
@@ -446,25 +467,38 @@ def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
-              table: PodTableDev):
-    """Scan the batch; returns (final carry, assignments int32[B] (-1 = none))."""
+              table: PodTableDev, groups: GroupsDev | None = None):
+    """Scan the batch; returns (final carry, assignments int32[B] (-1 = none)).
+
+    `groups` (with `carry.groups`) enables the PodTopologySpread /
+    InterPodAffinity kernels; pass None (and carry.groups None) for the lean
+    program — the two compile to distinct executables."""
+
+    n = na.npods.shape[0]
 
     def step(c: Carry, x: PodXs):
         pod = _gather_row(table, x)
-        mask, score, parts = _eval_pod(cfg, na, c, pod)
+        mask, score, parts = _eval_pod(cfg, na, c, pod, groups=groups,
+                                       tidx=x.tidx)
         masked = jnp.where(mask, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)
         assigned = (masked[best] >= 0) & pod.valid
         c2 = _apply_assignment(c, pod, best, assigned)
         c2 = c2._replace(cache=_row_refresh(cfg, na, c2, pod, best,
                                             assigned, parts))
+        if groups is not None:
+            c2 = c2._replace(groups=group_update(
+                groups, c2.groups, x.tidx,
+                pick=lambda arr: arr[..., best],
+                is_chosen=jnp.arange(n, dtype=jnp.int32) == best,
+                gate=assigned))
         return c2, jnp.where(assigned, best, -1)
 
     final, assignments = lax.scan(step, carry, pods)
     return final, assignments
 
 
-def initial_carry(na: NodeArrays) -> Carry:
+def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:
     n = na.npods.shape[0]
     zero_cache = SigCache(
         sig=jnp.int32(0),
@@ -476,4 +510,5 @@ def initial_carry(na: NodeArrays) -> Carry:
         s_bal=jnp.zeros((n,), jnp.int64),
     )
     return Carry(used=na.used, nonzero_used=na.nonzero_used,
-                 npods=na.npods, ports=na.ports, cache=zero_cache)
+                 npods=na.npods, ports=na.ports, cache=zero_cache,
+                 groups=groups)

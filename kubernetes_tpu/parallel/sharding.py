@@ -12,11 +12,19 @@ need cross-device communication:
   reproduces the single-device "first max index" tie-break exactly) →
   each shard applies the placement only if the winning row is local.
 
-Two scalar collectives per pod step, riding ICI. The assignments stream is
-replicated; the carry stays sharded. `run_batch_sharded` therefore returns
-bit-identical assignments to `ops.program.run_batch` (asserted in
-tests/test_sharding.py) while holding 1/D of the node state per device —
-the "long-context" scaling story of SURVEY §5.
+Two scalar collectives per pod step, riding ICI — plus, when group kernels
+(PodTopologySpread / InterPodAffinity, ops/groups.py) are active:
+  - `pmin` for the global minimum match count across domains,
+  - a psum'd domain-flag vector for the global distinct-domain count,
+  - pmax/pmin scalars for the score normalizations, and
+  - a psum broadcast of the chosen node's topology values so every shard can
+    apply the same-topology-value count update to its local slice.
+
+The assignments stream is replicated; the carry stays sharded.
+`run_batch_sharded` therefore returns bit-identical assignments to
+`ops.program.run_batch` (asserted in tests/test_sharding.py) while holding
+1/D of the node state per device — the "long-context" scaling story of
+SURVEY §5.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..ops.groups import GroupCarry, GroupsDev, group_update
 from ..ops.program import (Carry, PodTableDev, PodXs, ScoreConfig, SigCache,
                            _apply_assignment, _eval_pod, _gather_row,
                            _row_refresh)
@@ -40,12 +49,39 @@ _INT_MAX = jnp.iinfo(jnp.int32).max
 
 # the signature-cache sig is a replicated scalar; every other carry leaf is
 # sharded along the node axis
-_CARRY_SPEC = Carry(
-    used=P(NODE_AXIS), nonzero_used=P(NODE_AXIS), npods=P(NODE_AXIS),
-    ports=P(NODE_AXIS),
-    cache=SigCache(sig=P(), static_mask=P(NODE_AXIS), taint_raw=P(NODE_AXIS),
-                   na_raw=P(NODE_AXIS), fit_ok=P(NODE_AXIS),
-                   s_fit=P(NODE_AXIS), s_bal=P(NODE_AXIS)))
+_CACHE_SPEC = SigCache(sig=P(), static_mask=P(NODE_AXIS), taint_raw=P(NODE_AXIS),
+                       na_raw=P(NODE_AXIS), fit_ok=P(NODE_AXIS),
+                       s_fit=P(NODE_AXIS), s_bal=P(NODE_AXIS))
+
+# group tensors: node axis is the LAST dim of the node-indexed arrays; the
+# per-row scalars and pairwise match matrices are replicated
+_GD_NODE_FIELDS = ("spr_f_tv", "spr_f_elig", "spr_s_tv", "spr_s_elig",
+                   "spr_s_keys_ok", "spr_s_dom", "ipa_ra_tv", "ipa_raa_tv",
+                   "ipa_stc_tv", "ipa_stp_tv")
+_GC_NODE_FIELDS = ("spr_f_cnt", "spr_s_cnt", "ipa_veto", "ipa_a_cnt",
+                   "ipa_aa_cnt", "ipa_score")
+
+
+def _last_axis_spec(tree, node_fields):
+    def spec(name, arr):
+        if name in node_fields:
+            return P(*([None] * (np_ndim(arr) - 1) + [NODE_AXIS]))
+        return P()
+    return type(tree)(**{name: spec(name, getattr(tree, name))
+                         for name in tree._fields})
+
+
+def np_ndim(x) -> int:
+    return getattr(x, "ndim", 0)
+
+
+def _carry_spec(carry: Carry) -> Carry:
+    groups_spec = None
+    if carry.groups is not None:
+        groups_spec = _last_axis_spec(carry.groups, _GC_NODE_FIELDS)
+    return Carry(used=P(NODE_AXIS), nonzero_used=P(NODE_AXIS),
+                 npods=P(NODE_AXIS), ports=P(NODE_AXIS), cache=_CACHE_SPEC,
+                 groups=groups_spec)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -56,14 +92,18 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (NODE_AXIS,))
 
 
-def _sharded_step(cfg: ScoreConfig, axis: str, na_l: NodeArrays,
-                  table: PodTableDev, offset: jnp.ndarray, c: Carry,
+def _sharded_step(cfg: ScoreConfig, axis: str, n_global: int,
+                  na_l: NodeArrays, table: PodTableDev,
+                  groups: GroupsDev | None, offset: jnp.ndarray, c: Carry,
                   x: PodXs):
     """One pod placement on a node shard. Collectives: pmax + pmin (plus the
-    global normalization maxes inside _eval_pod)."""
+    global normalization maxes inside _eval_pod and the group-kernel
+    collectives described in the module docstring)."""
     n_local = na_l.cap.shape[0]
     pod = _gather_row(table, x)
-    mask, score, parts = _eval_pod(cfg, na_l, c, pod, axis=axis)
+    mask, score, parts = _eval_pod(cfg, na_l, c, pod, axis=axis,
+                                   groups=groups, tidx=x.tidx,
+                                   n_global=n_global)
     masked = jnp.where(mask, score, -1)
     lbest = jnp.argmax(masked).astype(jnp.int32)
     lscore = masked[lbest]
@@ -79,41 +119,83 @@ def _sharded_step(cfg: ScoreConfig, axis: str, na_l: NodeArrays,
     c2 = _apply_assignment(c, pod, lidx_safe, gate)
     c2 = c2._replace(cache=_row_refresh(cfg, na_l, c2, pod, lidx_safe,
                                         gate, parts))
+    if groups is not None:
+        def pick(arr):
+            # chosen node's value, broadcast from the owning shard
+            local = arr[..., lidx_safe]
+            return lax.psum(jnp.where(in_shard, local,
+                                      jnp.zeros_like(local)), axis)
+
+        is_chosen = in_shard & (jnp.arange(n_local, dtype=jnp.int32)
+                                == lidx_safe)
+        # gate here is GLOBAL placement (counts update on every shard's
+        # local slice via topology-value sharing)
+        c2 = c2._replace(groups=group_update(groups, c2.groups, x.tidx,
+                                             pick, is_chosen, assigned))
     return c2, jnp.where(assigned, gbest, -1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
 def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
-                      carry: Carry, pods: PodXs, table: PodTableDev):
+                      carry: Carry, pods: PodXs, table: PodTableDev,
+                      groups: GroupsDev | None = None):
     """`ops.program.run_batch` with the node axis sharded over `mesh`.
 
     N (the padded node count) must be divisible by the mesh size; the
     pow-of-two padding of ClusterState guarantees this for pow-of-two
     meshes. Returns (final sharded carry, replicated assignments[B]).
     """
+    n_global = na.cap.shape[0]
     node_sharded_na = NodeArrays(*(P(NODE_AXIS) for _ in na))
-    node_sharded_carry = _CARRY_SPEC
+    node_sharded_carry = _carry_spec(carry)
     replicated_pods = PodXs(*(P() for _ in pods))
     replicated_table = PodTableDev(*(P() for _ in table))
+    groups_spec = (_last_axis_spec(groups, _GD_NODE_FIELDS)
+                   if groups is not None else None)
 
     def local(na_l: NodeArrays, carry_l: Carry, pods_r: PodXs,
-              table_r: PodTableDev):
+              table_r: PodTableDev, groups_l):
         n_local = na_l.cap.shape[0]
         offset = (lax.axis_index(NODE_AXIS) * n_local).astype(jnp.int32)
-        step = functools.partial(_sharded_step, cfg, NODE_AXIS, na_l,
-                                 table_r, offset)
+        step = functools.partial(_sharded_step, cfg, NODE_AXIS, n_global,
+                                 na_l, table_r, groups_l, offset)
         return lax.scan(step, carry_l, pods_r)
 
     fn = jax.shard_map(
         local, mesh=mesh,
         in_specs=(node_sharded_na, node_sharded_carry, replicated_pods,
-                  replicated_table),
+                  replicated_table, groups_spec),
         out_specs=(node_sharded_carry, P()),
         check_vma=False)
-    return fn(na, carry, pods, table)
+    return fn(na, carry, pods, table, groups)
 
 
 def shard_node_arrays(mesh: Mesh, na: NodeArrays) -> NodeArrays:
     """Place the staging arrays onto the mesh, node axis split."""
     spec = NamedSharding(mesh, P(NODE_AXIS))
     return NodeArrays(*(jax.device_put(jnp.asarray(x), spec) for x in na))
+
+
+def shard_groups(mesh: Mesh, gd: GroupsDev) -> GroupsDev:
+    """Place group static tensors: node-indexed arrays split, rest replicated."""
+    out = {}
+    for name in gd._fields:
+        arr = jnp.asarray(getattr(gd, name))
+        if name in _GD_NODE_FIELDS:
+            spec = NamedSharding(mesh, P(*([None] * (arr.ndim - 1) + [NODE_AXIS])))
+        else:
+            spec = NamedSharding(mesh, P())
+        out[name] = jax.device_put(arr, spec)
+    return GroupsDev(**out)
+
+
+def shard_group_carry(mesh: Mesh, gc: GroupCarry) -> GroupCarry:
+    out = {}
+    for name in gc._fields:
+        arr = jnp.asarray(getattr(gc, name))
+        if name in _GC_NODE_FIELDS:
+            spec = NamedSharding(mesh, P(*([None] * (arr.ndim - 1) + [NODE_AXIS])))
+        else:
+            spec = NamedSharding(mesh, P())
+        out[name] = jax.device_put(arr, spec)
+    return GroupCarry(**out)

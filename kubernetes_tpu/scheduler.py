@@ -108,7 +108,13 @@ class Scheduler:
         self.cache = Cache(clock=clock)
         self.snapshot = Snapshot()
         self.state = ClusterState()
-        self.builder = BatchBuilder(self.state, batch_dims)
+        default_plugins_list = next(iter(self.profiles.values())).framework.plugins
+        spread_p = next((p for p in default_plugins_list
+                         if p.name() == "PodTopologySpread"), None)
+        ipa_p = next((p for p in default_plugins_list
+                      if p.name() == "InterPodAffinity"), None)
+        self.builder = BatchBuilder(self.state, batch_dims,
+                                    spread_plugin=spread_p, ipa_plugin=ipa_p)
         self.dispatcher = APIDispatcher(
             client=client, on_bind_error=self._on_bind_error)
 
@@ -135,6 +141,10 @@ class Scheduler:
         # budget). Any external mutation invalidates it; the next device
         # segment reseeds from the host snapshot.
         self._device_carry = None
+        # group (spread / inter-pod affinity) device state lifecycle
+        self._gd_dev = None          # GroupsDev (jnp) for the current carry
+        self._gd_capacity = None     # (table_rows, node_bucket) it was built for
+        self._seeded_rows = 0        # signature rows whose counts are seeded
 
     # -- wiring ---------------------------------------------------------------
 
@@ -248,14 +258,8 @@ class Scheduler:
         i = 0
         while i < len(qpis):
             if fallback[i]:
-                pod = qpis[i].pod
                 ok = self._schedule_one_host(qpis[i])
                 bound += 1 if ok else 0
-                aff = pod.spec.affinity
-                if ok and aff and (aff.pod_affinity or aff.pod_anti_affinity):
-                    # the bind just introduced (anti-)affinity pods into the
-                    # cluster; later pods in this batch lose device eligibility
-                    fallback[i + 1:] = True
                 i += 1
                 continue
             j = i + 1
@@ -267,6 +271,8 @@ class Scheduler:
 
     def _schedule_device_segment(self, qpis: list[QueuedPodInfo],
                                  prebuilt=None) -> int:
+        from .ops.groups import scatter_new_rows, to_device
+
         profile = next(iter(self.profiles.values()))
         carry = self._device_carry
         if carry is None:
@@ -283,15 +289,50 @@ class Scheduler:
                                                pad_to=self.batch_size)
             if segment_batch.host_fallback.any():
                 # state moved between routing and segment build (e.g. a node
-                # update surfaced images, or a host bind introduced affinity
-                # pods): honor queue order and let the oracle take the segment
+                # update surfaced images): honor queue order and let the
+                # oracle take the segment
                 return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         na = self.state.device_arrays()
-        if carry is None or carry.used.shape != na.used.shape:
-            carry = initial_carry(na)
+        # group kernels are needed when any signature row carries spread or
+        # inter-pod affinity constraints, or when existing cluster pods do
+        # (affinity is symmetric: they veto/score ANY incoming pod)
+        groups_needed = (
+            self.builder.groups.any_groups()
+            or bool(self.snapshot.have_pods_with_affinity_list)
+            or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
+        capacity = (self.builder.dims.table_rows, na.used.shape[0])
+        if carry is not None and (
+                carry.used.shape != na.used.shape
+                or groups_needed != (carry.groups is not None)
+                or (groups_needed and capacity != self._gd_capacity)):
+            # structural change: reseed from the host snapshot
+            carry = None
+            self.cache.update_snapshot(self.snapshot)
+            self.state.apply_snapshot(self.snapshot)
+            na = self.state.device_arrays()
+        if carry is None:
+            gcarry = None
+            if groups_needed:
+                gd_np, gc_np = self.builder.groups.build_dev(self.snapshot)
+                self._gd_dev = to_device(gd_np)
+                gcarry = to_device(gc_np)
+            else:
+                self._gd_dev = None
+            self._gd_capacity = capacity
+            self._seeded_rows = self.builder.table_used
+            carry = initial_carry(na, gcarry)
+        elif groups_needed and self.builder.table_used > self._seeded_rows:
+            # new signature rows while the carry is resident: seed just those
+            # rows from the live snapshot (assumes included) and scatter in
+            self.cache.update_snapshot(self.snapshot)
+            self._gd_dev, gcarry = scatter_new_rows(
+                self._gd_dev, carry.groups, self.builder.groups,
+                self.snapshot, self._seeded_rows, self.builder.table_used)
+            carry = carry._replace(groups=gcarry)
+            self._seeded_rows = self.builder.table_used
         xs, table = pod_rows_from_batch(segment_batch)
         carry, assignments = run_batch(profile.score_config, na, carry,
-                                       xs, table)
+                                       xs, table, groups=self._gd_dev)
         # the carry stays device-resident: the only readback per batch is the
         # assignment vector
         self._device_carry = carry
@@ -333,6 +374,14 @@ class Scheduler:
             plugins.add("NodeName")
         if any(p.host_port > 0 for c in spec.containers for p in c.ports):
             plugins.add("NodePorts")
+        if spec.topology_spread_constraints:
+            plugins.add("PodTopologySpread")
+        if spec.affinity and (spec.affinity.pod_affinity
+                              or spec.affinity.pod_anti_affinity):
+            plugins.add("InterPodAffinity")
+        elif self.snapshot.have_pods_with_required_anti_affinity_list:
+            # existing pods' anti-affinity can veto any pod
+            plugins.add("InterPodAffinity")
         err.diagnosis.unschedulable_plugins = plugins
         return err
 

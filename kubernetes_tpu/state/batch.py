@@ -110,7 +110,9 @@ class BatchCapacityError(ValueError):
 
 
 class BatchBuilder:
-    def __init__(self, state: ClusterState, dims: Optional[BatchDims] = None):
+    def __init__(self, state: ClusterState, dims: Optional[BatchDims] = None,
+                 spread_plugin=None, ipa_plugin=None, group_dims=None):
+        from ..ops.groups import GroupManager
         self.state = state
         self.dims = dims or BatchDims()
         self._cluster_has_images = False
@@ -121,6 +123,9 @@ class BatchBuilder:
                                  state.dims.resources, self.dims)
         self.table_used = 0
         self.table_version = 0
+        self.groups = GroupManager(state, spread_plugin=spread_plugin,
+                                   ipa_plugin=ipa_plugin, dims=group_dims,
+                                   table_rows=self.dims.table_rows)
 
     # -- table lifecycle ------------------------------------------------------
 
@@ -130,6 +135,7 @@ class BatchBuilder:
                                  self.state.dims.resources, self.dims)
         self.table_used = 0
         self.table_version += 1
+        self.groups.reset()
 
     def _grow_table(self) -> None:
         self.dims.table_rows *= 2
@@ -140,6 +146,7 @@ class BatchBuilder:
             getattr(self.table, name)[: self.table_used] = getattr(old, name)[
                 : self.table_used]
         self.table_version += 1
+        self.groups.grow(self.dims.table_rows)
 
     # -- build ---------------------------------------------------------------
 
@@ -154,16 +161,6 @@ class BatchBuilder:
         arrays = self.state.arrays
         self._cluster_has_images = bool(
             arrays is not None and arrays.image_id.any())
-        # InterPodAffinity is symmetric: existing pods carrying required
-        # anti-affinity can veto ANY incoming pod (filtering.go:204-228), and
-        # existing pods with (anti-)affinity terms feed the score of ANY
-        # incoming pod (scoring.go:81-124). Until those count tensors ride the
-        # scan carry (ops/groups.py), the whole batch must take the host path
-        # whenever such pods exist anywhere in the cluster.
-        cluster_affinity = bool(
-            snapshot is not None
-            and (snapshot.have_pods_with_affinity_list
-                 or snapshot.have_pods_with_required_anti_affinity_list))
 
         valid = np.zeros((B,), bool)
         fallback = np.zeros((B,), bool)
@@ -171,9 +168,6 @@ class BatchBuilder:
         tidx = np.zeros((B,), np.int32)
         last = -1
         for i, pod in enumerate(pods):
-            if cluster_affinity:
-                fallback[i] = True
-                continue
             if self._cluster_has_images and any(
                     c.image for c in pod.spec.containers
                     + pod.spec.init_containers):
@@ -209,6 +203,7 @@ class BatchBuilder:
         u = self.table_used
         try:
             self._fill_row(self.table, u, pod)
+            self.groups.add_row(u, pod)
         except BatchCapacityError as e:
             for name in PodTable._fields:
                 getattr(self.table, name)[u] = 0
@@ -230,10 +225,16 @@ class BatchBuilder:
 
     @staticmethod
     def _sig_key(pod: Pod) -> tuple:
+        """Canonical content key. Namespace + labels are part of it because
+        spread/affinity matching is SYMMETRIC: a pod's labels determine how
+        it feeds other pods' selectors (signers.go includes labels for the
+        same reason)."""
         spec = pod.spec
         aff = spec.affinity
         na = aff.node_affinity if aff else None
         return (
+            pod.namespace,
+            tuple(sorted(pod.metadata.labels.items())),
             tuple(sorted(res.pod_requests(pod).items())),
             res.pod_requests_nonzero(pod),
             spec.node_name,
@@ -244,8 +245,8 @@ class BatchBuilder:
             tuple(sorted((p.protocol or "TCP", p.host_port, p.host_ip)
                          for c in spec.containers for p in c.ports
                          if p.host_port > 0)),
-            bool(spec.topology_spread_constraints),
-            bool(aff and (aff.pod_affinity or aff.pod_anti_affinity)),
+            tuple(spec.topology_spread_constraints),
+            (aff.pod_affinity, aff.pod_anti_affinity) if aff else None,
         )
 
     # -- row compilation ------------------------------------------------------
@@ -253,13 +254,7 @@ class BatchBuilder:
     def _fill_row(self, b: PodTable, i: int, pod: Pod) -> None:
         d = self.dims
         intr = self.state.interner
-        # constraints the device program doesn't cover yet → host oracle
-        # (group tensors for spread/interpod land in ops/groups.py)
         aff = pod.spec.affinity
-        if pod.spec.topology_spread_constraints:
-            raise BatchCapacityError("topology spread: host path")
-        if aff and (aff.pod_affinity or aff.pod_anti_affinity):
-            raise BatchCapacityError("inter-pod affinity: host path")
         # resources
         reqs = res.pod_requests(pod)
         row = self.state.rtable.vector(reqs)

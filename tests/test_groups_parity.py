@@ -1,0 +1,395 @@
+"""Device ↔ oracle parity for the group kernels (ops/groups.py):
+PodTopologySpread and InterPodAffinity, including clusters PRE-POPULATED
+with spread/affinity/anti-affinity pods — the adversarial setting where the
+symmetric semantics (existing pods vetoing/scoring incoming ones) bite.
+
+Every device assignment must land in the host oracle's argmax set on the
+same evolving cluster state (the oracle is the transliterated Go-semantics
+runtime; see test_program_parity.py for the lean-program counterpart).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.framework.runtime import Framework, schedule_pod
+from kubernetes_tpu.framework.types import FitError
+from kubernetes_tpu.ops.groups import to_device
+from kubernetes_tpu.ops.program import (ScoreConfig, initial_carry,
+                                        pod_rows_from_batch, run_batch)
+from kubernetes_tpu.plugins import noderesources as nr
+from kubernetes_tpu.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.plugins.node_basics import (NodeName, NodePorts,
+                                                NodeUnschedulable,
+                                                TaintToleration)
+from kubernetes_tpu.plugins.nodeaffinity import NodeAffinity
+from kubernetes_tpu.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+WEIGHTS = {"TaintToleration": 3, "NodeAffinity": 2, "PodTopologySpread": 2,
+           "InterPodAffinity": 2, "NodeResourcesFit": 1,
+           "NodeResourcesBalancedAllocation": 1}
+
+
+def full_framework():
+    return Framework("default-scheduler",
+                     [NodeUnschedulable(), NodeName(), TaintToleration(),
+                      NodeAffinity(), NodePorts(), nr.Fit(),
+                      nr.BalancedAllocation(), PodTopologySpread(),
+                      InterPodAffinity()],
+                     weights=WEIGHTS)
+
+
+def assert_group_parity(nodes, existing, batch_pods, cfg=ScoreConfig()):
+    """`existing`: [(pod, node_name)] pre-bound pods. Runs the device batch
+    with group kernels and checks every decision against the oracle."""
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for pod, node_name in existing:
+        pod.spec.node_name = node_name
+        cache.add_pod(pod)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    batch = builder.build(batch_pods)
+    assert not batch.host_fallback.any(), "test pods must be tensorizable"
+
+    gd_np, gc_np = builder.groups.build_dev(snap)
+    gd, gc = to_device(gd_np), to_device(gc_np)
+    na = state.device_arrays()
+    xs, table = pod_rows_from_batch(batch)
+    carry, assignments = run_batch(cfg, na, initial_carry(na, gc), xs, table,
+                                   groups=gd)
+    assignments = np.asarray(assignments)[:len(batch_pods)]
+
+    fwk = full_framework()
+    for i, pod in enumerate(batch_pods):
+        chosen = assignments[i]
+        node_name = state.node_names[chosen] if chosen >= 0 else None
+        try:
+            result = schedule_pod(fwk, CycleState(), pod, snap.node_info_list)
+        except FitError:
+            assert node_name is None, (
+                f"pod {pod.name}: device chose {node_name}, oracle found none")
+            continue
+        assert node_name is not None, (
+            f"pod {pod.name}: device found none, oracle chose "
+            f"{result.suggested_host} (argmax {sorted(result.argmax_set)})")
+        assert node_name in result.argmax_set, (
+            f"pod {pod.name}: device chose {node_name} "
+            f"(score {result.scores.get(node_name)}), oracle argmax "
+            f"{sorted(result.argmax_set)} scores {result.scores}")
+        pod.spec.node_name = node_name
+        cache.assume_pod(pod)
+        cache.update_snapshot(snap)
+    return assignments
+
+
+def zoned_nodes(n, zones=2):
+    return [make_node(f"n{i}").capacity({"cpu": "16", "memory": "32Gi",
+                                         "pods": 110})
+            .zone(f"z{i % zones}").label(HOSTNAME, f"n{i}").obj()
+            for i in range(n)]
+
+
+class TestSpreadFilterParity:
+    def test_zone_spread_balances(self):
+        nodes = zoned_nodes(4)
+        pods = [make_pod(f"p{i}").label("app", "web")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "web"})
+                .req({"cpu": "500m"}).obj() for i in range(8)]
+        a = assert_group_parity(nodes, [], pods)
+        assert (a >= 0).all()
+
+    def test_existing_pods_skew_counts(self):
+        nodes = zoned_nodes(4)
+        # z0 already holds 3 matching pods → first incoming must go z1
+        existing = [(make_pod(f"e{i}").label("app", "web")
+                     .req({"cpu": "100m"}).obj(), "n0") for i in range(3)]
+        pods = [make_pod(f"p{i}").label("app", "web")
+                .spread_constraint(2, ZONE, "DoNotSchedule", {"app": "web"})
+                .req({"cpu": "500m"}).obj() for i in range(4)]
+        a = assert_group_parity(nodes, existing, pods)
+        assert (a >= 0).all()
+
+    def test_dual_constraint_zone_and_hostname(self):
+        nodes = zoned_nodes(6, zones=3)
+        pods = [make_pod(f"p{i}").label("app", "api")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "api"})
+                .spread_constraint(2, HOSTNAME, "DoNotSchedule", {"app": "api"})
+                .req({"cpu": "250m"}).obj() for i in range(9)]
+        assert_group_parity(nodes, [], pods)
+
+    def test_skew_exhaustion_unschedulable(self):
+        # one zone only: maxSkew 1 with min over a single domain never blocks
+        # — use two zones where one is full by capacity to force skew failure
+        nodes = [make_node("a0").capacity({"cpu": "1", "pods": 110}).zone("z0")
+                 .label(HOSTNAME, "a0").obj(),
+                 make_node("b0").capacity({"cpu": "16", "pods": 110}).zone("z1")
+                 .label(HOSTNAME, "b0").obj()]
+        pods = [make_pod(f"p{i}").label("g", "x")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"g": "x"})
+                .req({"cpu": "900m"}).obj() for i in range(4)]
+        a = assert_group_parity(nodes, [], pods)
+        # z0 fits one pod; after z1 gets 2 (skew 1→2 vs z0's 1) the rest park
+        assert (a >= 0).sum() == 3
+
+    def test_min_domains(self):
+        # minDomains=3 with only 2 zones ⇒ global min treated as 0
+        nodes = zoned_nodes(4)
+        pods = [make_pod(f"p{i}").label("md", "y")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"md": "y"},
+                                   min_domains=3)
+                .req({"cpu": "100m"}).obj() for i in range(3)]
+        assert_group_parity(nodes, [], pods)
+
+
+class TestSpreadScoreParity:
+    def test_schedule_anyway_prefers_low_count(self):
+        nodes = zoned_nodes(4)
+        existing = [(make_pod(f"e{i}").label("app", "soft")
+                     .req({"cpu": "100m"}).obj(), "n0") for i in range(4)]
+        pods = [make_pod(f"p{i}").label("app", "soft")
+                .spread_constraint(1, ZONE, "ScheduleAnyway", {"app": "soft"})
+                .req({"cpu": "500m"}).obj() for i in range(6)]
+        assert_group_parity(nodes, existing, pods)
+
+    def test_mixed_filter_and_score_constraints(self):
+        nodes = zoned_nodes(6, zones=3)
+        pods = [make_pod(f"p{i}").label("app", "mix")
+                .spread_constraint(2, ZONE, "DoNotSchedule", {"app": "mix"})
+                .spread_constraint(1, HOSTNAME, "ScheduleAnyway", {"app": "mix"})
+                .req({"cpu": "250m"}).obj() for i in range(8)]
+        assert_group_parity(nodes, [], pods)
+
+
+class TestInterPodAffinityParity:
+    def test_required_affinity_colocates(self):
+        nodes = zoned_nodes(4)
+        existing = [(make_pod("anchor").label("app", "db")
+                     .req({"cpu": "100m"}).obj(), "n1")]
+        pods = [make_pod(f"p{i}").label("app", "web")
+                .pod_affinity(ZONE, {"app": "db"})
+                .req({"cpu": "500m"}).obj() for i in range(3)]
+        a = assert_group_parity(nodes, existing, pods)
+        # all must land in the anchor's zone (z1 = n1, n3)
+        assert all(int(x) in (1, 3) for x in a)
+
+    def test_self_affinity_escape_hatch(self):
+        # no matching pods anywhere; pod matches its own term → schedulable
+        nodes = zoned_nodes(2)
+        pods = [make_pod(f"p{i}").label("app", "solo")
+                .pod_affinity(ZONE, {"app": "solo"})
+                .req({"cpu": "100m"}).obj() for i in range(3)]
+        a = assert_group_parity(nodes, [], pods)
+        assert (a >= 0).all()
+        # followers must co-locate with the first pod's zone
+        zones = {0: "z0", 1: "z1"}
+        assert len({zones[int(x) % 2] for x in a}) == 1
+
+    def test_required_anti_affinity_excludes(self):
+        nodes = zoned_nodes(4)
+        pods = [make_pod(f"p{i}").label("app", "lonely")
+                .pod_affinity(ZONE, {"app": "lonely"}, anti=True)
+                .req({"cpu": "100m"}).obj() for i in range(3)]
+        a = assert_group_parity(nodes, [], pods)
+        # 2 zones → only 2 can bind, one per zone
+        assert (a >= 0).sum() == 2
+
+    def test_existing_anti_affinity_vetoes_plain_pod(self):
+        nodes = zoned_nodes(2)
+        existing = [(make_pod("guard").label("app", "g")
+                     .pod_affinity(ZONE, {"app": "web"}, anti=True)
+                     .req({"cpu": "100m"}).obj(), "n0")]
+        pods = [make_pod("victim").label("app", "web").req({"cpu": "100m"}).obj(),
+                make_pod("free").label("app", "other").req({"cpu": "100m"}).obj()]
+        a = assert_group_parity(nodes, existing, pods)
+        assert int(a[0]) == 1  # pushed out of the guard's zone
+        assert int(a[1]) >= 0
+
+    def test_preferred_affinity_scores(self):
+        nodes = zoned_nodes(4)
+        existing = [(make_pod("anchor").label("app", "cache")
+                     .req({"cpu": "100m"}).obj(), "n2")]
+        pods = [make_pod(f"p{i}").label("app", "fe")
+                .preferred_pod_affinity(ZONE, {"app": "cache"}, weight=50)
+                .req({"cpu": "250m"}).obj() for i in range(4)]
+        assert_group_parity(nodes, [], pods)
+        assert_group_parity(nodes, existing, pods)
+
+    def test_symmetric_preferred_scoring_of_plain_pods(self):
+        # existing pod carries preferred affinity toward app=web: an incoming
+        # PLAIN app=web pod is pulled toward it (scoring.go:81-124 symmetry)
+        nodes = zoned_nodes(4)
+        existing = [(make_pod("magnet").label("app", "m")
+                     .preferred_pod_affinity(ZONE, {"app": "web"}, weight=80)
+                     .req({"cpu": "100m"}).obj(), "n3")]
+        pods = [make_pod(f"p{i}").label("app", "web").req({"cpu": "250m"}).obj()
+                for i in range(3)]
+        assert_group_parity(nodes, existing, pods)
+
+    def test_hard_affinity_weight_symmetry(self):
+        # existing pod with REQUIRED affinity toward app=web contributes
+        # hardPodAffinityWeight symmetric score to incoming web pods
+        nodes = zoned_nodes(4)
+        existing = [(make_pod("req").label("app", "req")
+                     .pod_affinity(ZONE, {"app": "web"})
+                     .req({"cpu": "100m"}).obj(), "n1")]
+        pods = [make_pod(f"p{i}").label("app", "web").req({"cpu": "250m"}).obj()
+                for i in range(3)]
+        assert_group_parity(nodes, existing, pods)
+
+
+class TestMixedGroupFuzz:
+    """The adversarial fuzz VERDICT asked for: randomized clusters
+    pre-populated with spread/affinity/anti-affinity pods, randomized mixed
+    batches. Every decision checked against the oracle."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_fuzz(self, seed):
+        rng = random.Random(1000 + seed)
+        n_nodes = rng.randint(4, 10)
+        zones = rng.randint(2, 3)
+        nodes = []
+        for i in range(n_nodes):
+            w = (make_node(f"n{i}")
+                 .capacity({"cpu": str(rng.choice([4, 8, 16])),
+                            "memory": f"{rng.choice([8, 16, 32])}Gi",
+                            "pods": 110})
+                 .zone(f"z{i % zones}").label(HOSTNAME, f"n{i}"))
+            if rng.random() < 0.2:
+                w = w.label("disk", rng.choice(["ssd", "hdd"]))
+            nodes.append(w.obj())
+
+        apps = ["web", "db", "cache"]
+        existing = []
+        for i in range(rng.randint(0, 6)):
+            w = (make_pod(f"e{i}").label("app", rng.choice(apps))
+                 .req({"cpu": "100m"}))
+            r = rng.random()
+            if r < 0.25:
+                w = w.pod_affinity(ZONE, {"app": rng.choice(apps)}, anti=True)
+            elif r < 0.5:
+                w = w.preferred_pod_affinity(
+                    ZONE, {"app": rng.choice(apps)},
+                    weight=rng.randint(1, 100), anti=rng.random() < 0.5)
+            elif r < 0.7:
+                w = w.spread_constraint(rng.randint(1, 2), ZONE,
+                                        "DoNotSchedule",
+                                        {"app": w.pod.metadata.labels["app"]})
+            existing.append((w.obj(), f"n{rng.randrange(n_nodes)}"))
+
+        pods = []
+        for i in range(rng.randint(4, 16)):
+            app = rng.choice(apps)
+            w = make_pod(f"p{i}").label("app", app).req(
+                {"cpu": rng.choice(["100m", "500m", "1"]),
+                 "memory": rng.choice(["128Mi", "1Gi"])})
+            r = rng.random()
+            if r < 0.2:
+                w = w.spread_constraint(
+                    rng.randint(1, 2), ZONE,
+                    rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                    {"app": app})
+            elif r < 0.35:
+                w = w.pod_affinity(ZONE, {"app": rng.choice(apps)},
+                                   anti=rng.random() < 0.5)
+            elif r < 0.5:
+                w = w.preferred_pod_affinity(ZONE, {"app": rng.choice(apps)},
+                                             weight=rng.randint(1, 100),
+                                             anti=rng.random() < 0.5)
+            if rng.random() < 0.2:
+                w = w.node_selector({"disk": rng.choice(["ssd", "hdd"])})
+            pods.append(w.obj())
+        assert_group_parity(nodes, existing, pods)
+
+
+class TestGroupSigCacheInterplay:
+    """The signature fast path caches only carry-independent kernels; group
+    kernels are carry-coupled and must stay live. fast == slow with groups."""
+
+    def test_fast_equals_slow_with_spread(self):
+        import jax.numpy as jnp
+        nodes = zoned_nodes(6, zones=3)
+        pods = [make_pod(f"p{i}").label("app", "w")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "w"})
+                .req({"cpu": "250m"}).obj() for i in range(12)]
+        cache = Cache()
+        for n in nodes:
+            cache.add_node(n)
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        state = ClusterState()
+        state.apply_snapshot(snap, full=True)
+        builder = BatchBuilder(state)
+        batch = builder.build(pods)
+        assert not batch.host_fallback.any()
+        gd_np, gc_np = builder.groups.build_dev(snap)
+        gd, gc = to_device(gd_np), to_device(gc_np)
+        na = state.device_arrays()
+        xs, table = pod_rows_from_batch(batch)
+        cfg = ScoreConfig()
+        sigs = np.asarray(batch.sig)[:len(pods)]
+        assert (np.diff(sigs) == 0).any(), "should exercise the fast path"
+        _, fast = run_batch(cfg, na, initial_carry(na, gc), xs, table, groups=gd)
+        xs_slow = xs._replace(sig=jnp.zeros_like(xs.sig))
+        _, slow = run_batch(cfg, na, initial_carry(na, gc), xs_slow, table,
+                            groups=gd)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+class TestMidCarryRowSeeding:
+    """A NEW signature appearing while the device carry is resident must get
+    its group counts seeded from the live snapshot (scatter_new_rows), with
+    prior in-carry placements visible through the host cache."""
+
+    def test_new_spread_signature_mid_stream(self):
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        api = APIServer()
+        sched = Scheduler(api, batch_size=16)
+        for i in range(4):
+            api.create_node(make_node(f"n{i}")
+                            .capacity({"cpu": "16", "memory": "32Gi", "pods": 110})
+                            .zone(f"z{i % 2}").label(HOSTNAME, f"n{i}").obj())
+        # wave 1: establishes a resident carry with groups ON (affinity pod)
+        api.create_pod(make_pod("a0").label("app", "web")
+                       .pod_affinity(ZONE, {"app": "web"}, anti=True)
+                       .req({"cpu": "100m"}).obj())
+        for i in range(4):
+            api.create_pod(make_pod(f"w1-{i}").label("app", "plain")
+                           .req({"cpu": "100m"}).obj())
+        assert sched.schedule_pending() == 5
+        assert sched._device_carry is not None
+        seeded_before = sched._seeded_rows
+        # wave 2: a NEW spread signature arrives; the carry must stay
+        # resident and the new row gets seeded in place
+        for i in range(6):
+            api.create_pod(make_pod(f"w2-{i}").label("app", "spread")
+                           .spread_constraint(1, ZONE, "DoNotSchedule",
+                                              {"app": "spread"})
+                           .req({"cpu": "250m"}).obj())
+        assert sched.schedule_pending() == 6
+        assert sched._seeded_rows > seeded_before
+        # skew must hold across zones
+        zone_of = {f"n{i}": f"z{i % 2}" for i in range(4)}
+        counts = {}
+        for name, p in api.pods.items():
+            if name.startswith("default/w2-"):
+                z = zone_of[p.spec.node_name]
+                counts[z] = counts.get(z, 0) + 1
+        assert abs(counts.get("z0", 0) - counts.get("z1", 0)) <= 1
+        assert sched.host_scheduled == 0
+        assert sched.reconcile() == []

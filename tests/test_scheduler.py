@@ -56,9 +56,10 @@ class TestBatchPath:
         assert sched.schedule_pending() == 1
         assert api.pods["default/huge"].spec.node_name == "big"
 
-    def test_mixed_device_and_host_fallback(self):
+    def test_mixed_plain_and_spread_pods_stay_on_device(self):
         api, sched = mk(n_nodes=4)
-        # interleave plain pods (device) with spread-constraint pods (host)
+        # interleaved plain + spread-constraint pods all run the device path
+        # (ops/groups.py kernels); the skew constraint must hold
         for i in range(8):
             w = make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "web")
             if i % 2 == 0:
@@ -67,7 +68,7 @@ class TestBatchPath:
             api.create_pod(w.obj())
         bound = sched.schedule_pending()
         assert bound == 8
-        assert sched.host_scheduled == 4
+        assert sched.host_scheduled == 0
         zones = {}
         for p in api.pods.values():
             z = "z0" if p.spec.node_name in ("n0", "n2") else "z1"
@@ -139,10 +140,11 @@ class TestChurn:
         assert sched.reconcile() == []
 
 
-class TestAffinityParityRouting:
-    """Regression for the round-1 parity bug: existing cluster pods with
-    (anti-)affinity must disable the device path for ALL incoming pods —
-    InterPodAffinity is symmetric (filtering.go:204-228, scoring.go:81-124)."""
+class TestAffinitySymmetry:
+    """InterPodAffinity is symmetric (filtering.go:204-228,
+    scoring.go:81-124): existing cluster pods with (anti-)affinity veto and
+    score ANY incoming pod. Since round 3 this runs on DEVICE (ops/groups.py
+    ipa_veto / ipa_score carried counts) — no host routing involved."""
 
     def test_existing_anti_affinity_blocks_incoming_plain_pod(self):
         # one node in zone z0 hosting a pod with required anti-affinity on
@@ -160,7 +162,7 @@ class TestAffinityParityRouting:
         assert not api.pods["default/victim"].spec.node_name
         assert len(sched.queue.unschedulable_pods) == 1
 
-    def test_existing_affinity_pod_forces_host_path(self):
+    def test_existing_preferred_anti_affinity_scores_plain_pod(self):
         api, sched = mk(n_nodes=2)
         guard = (make_pod("guard").label("app", "db")
                  .preferred_pod_affinity("topology.kubernetes.io/zone",
@@ -168,15 +170,18 @@ class TestAffinityParityRouting:
                  .req({"cpu": "100m"}).obj())
         api.create_pod(guard)
         sched.schedule_pending()
-        before = sched.host_scheduled
         api.create_pod(make_pod("plain").label("app", "web").req({"cpu": "100m"}).obj())
         assert sched.schedule_pending() == 1
-        # the plain pod must have gone through the host oracle, not the device
-        assert sched.host_scheduled == before + 1
+        # the plain pod is steered AWAY from the guard's zone by the guard's
+        # preferred anti-affinity (symmetric scoring), on the device path
+        assert sched.host_scheduled == 0
+        zone_of = {"n0": "z0", "n1": "z1"}
+        assert (zone_of[api.pods["default/plain"].spec.node_name]
+                != zone_of[api.pods["default/guard"].spec.node_name])
 
-    def test_host_bound_affinity_pod_flips_rest_of_batch(self):
-        # within one drained batch: a fallback (anti-affinity) pod scheduled on
-        # host makes the remaining queued pods lose device eligibility
+    def test_in_batch_anti_affinity_coupling(self):
+        # within one drained batch: the guard's placement must steer the
+        # later pod to the OTHER zone — the scan's carried counts couple them
         api, sched = mk(n_nodes=2)
         api.create_pod(make_pod("a-guard").label("app", "other")
                        .pod_affinity("topology.kubernetes.io/zone",
@@ -184,9 +189,6 @@ class TestAffinityParityRouting:
                        .req({"cpu": "100m"}).obj())
         api.create_pod(make_pod("b-web").label("app", "web").req({"cpu": "100m"}).obj())
         bound = sched.schedule_pending()
-        # guard binds; b-web must land in the OTHER zone (n0=z0, n1=z1),
-        # which only the host oracle knows — the device path would have
-        # happily placed it next to the guard
         assert bound == 2
         web = api.pods["default/b-web"]
         guard_node = api.pods["default/a-guard"].spec.node_name

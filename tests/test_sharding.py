@@ -102,3 +102,71 @@ def test_sharded_respects_infeasibility():
     _, assign = run_batch_sharded(ScoreConfig(), mesh, na,
                                   initial_carry(na), xs, table)
     assert int(np.asarray(assign)[0]) == -1
+
+
+def build_group_pods(n_pods):
+    """Spread + inter-pod affinity pods: exercise the group-kernel
+    collectives (global domain min, distinct count, tv broadcast)."""
+    pods = []
+    for i in range(n_pods):
+        w = make_pod(f"g{i}").req({"cpu": "250m", "memory": "256Mi"})
+        if i % 3 == 0:
+            w = (w.label("app", "spread")
+                 .spread_constraint(1, "topology.kubernetes.io/zone",
+                                    "DoNotSchedule", {"app": "spread"}))
+        elif i % 3 == 1:
+            w = (w.label("app", "anti")
+                 .pod_affinity("topology.kubernetes.io/zone",
+                               {"app": "anti"}, anti=True))
+        else:
+            w = (w.label("app", "soft")
+                 .preferred_pod_affinity("topology.kubernetes.io/zone",
+                                         {"app": "spread"}, weight=40))
+        pods.append(w.obj())
+    return pods
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_sharded_group_kernels_match_single_device(n_devices):
+    from kubernetes_tpu.ops.groups import to_device
+    from kubernetes_tpu.parallel.sharding import (shard_group_carry,
+                                                  shard_groups)
+    if len(jax.devices()) < n_devices:
+        pytest.skip("not enough virtual devices")
+    cache = Cache()
+    for i in range(16):
+        cache.add_node(make_node(f"n{i}")
+                       .capacity({"cpu": 8, "memory": "16Gi", "pods": 110})
+                       .zone(f"z{i % 3}")
+                       .label("kubernetes.io/hostname", f"n{i}").obj())
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    batch = builder.build(build_group_pods(12))
+    assert not batch.host_fallback.any()
+    gd_np, gc_np = builder.groups.build_dev(snap)
+    xs, table = pod_rows_from_batch(batch)
+    cfg = ScoreConfig()
+
+    na = state.device_arrays()
+    gd, gc = to_device(gd_np), to_device(gc_np)
+    single_carry, single_assign = run_batch(
+        cfg, na, initial_carry(na, gc), xs, table, groups=gd)
+
+    mesh = make_mesh(n_devices)
+    na_sh = shard_node_arrays(mesh, na)
+    gd_sh = shard_groups(mesh, to_device(gd_np))
+    gc_sh = shard_group_carry(mesh, to_device(gc_np))
+    sh_carry, sh_assign = run_batch_sharded(
+        cfg, mesh, na_sh, initial_carry(na_sh, gc_sh), xs, table,
+        groups=gd_sh)
+
+    np.testing.assert_array_equal(np.asarray(single_assign),
+                                  np.asarray(sh_assign))
+    for name in ("spr_f_cnt", "spr_s_cnt", "ipa_veto", "ipa_a_cnt",
+                 "ipa_aa_cnt", "ipa_score"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(single_carry.groups, name)),
+            np.asarray(getattr(sh_carry.groups, name)), err_msg=name)
