@@ -122,10 +122,21 @@ class Cache:
 
     def assume_pod(self, pod: Pod) -> None:
         """cache.go:369 — pod must not be known yet."""
+        self.assume_pod_info(PodInfo.of(pod))
+
+    def assume_pod_info(self, pi: PodInfo) -> None:
+        """assume_pod with a caller-supplied PodInfo — the scheduler's hot
+        bind path reuses the queue entry's pre-parsed requests instead of
+        re-parsing resource quantities per assume."""
+        pod = pi.pod
         uid = pod.uid
         if uid in self.pod_states:
             raise KeyError(f"pod {uid} is in the cache, so can't be assumed")
-        self._add_pod_to_node(pod)
+        if not pod.spec.node_name:
+            raise ValueError(f"pod {uid} has no nodeName")
+        item = self._get_or_create(pod.spec.node_name)
+        item.info.add_pod(pi)
+        self._move_to_head(item)
         ps = _PodState(pod=pod, assumed=True)
         self.pod_states[uid] = ps
         self.assumed_pods.add(uid)

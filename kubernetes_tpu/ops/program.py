@@ -498,6 +498,174 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
     return final, assignments
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
+def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
+                table: PodTableDev, n_actual, L: int, K: int, J: int):
+    """Closed-form batch assignment for a run of SAME-SIGNATURE pods — the
+    top-k trick of reference runtime/batch.go:97 (sortedNodes.Pop) taken to
+    its TPU limit: the whole run becomes ONE top_k instead of L scan steps.
+
+    Why it is exact (and when): during a same-signature run, a placement
+    changes node state only on the chosen node, so every node's score is a
+    pure function of how many run-pods it already holds: entry (k, j) =
+    score of candidate node k after its (j+1)-th placement. The sequential
+    greedy (scan) then consumes entries of this matrix in key order
+    (score desc, node idx asc, j asc) — the standard k-way-merge argument,
+    valid when each node's entry sequence is non-increasing. Therefore the
+    exact greedy assignment = the top-L entries of the keyed matrix, and
+    pod i gets the node of the i-th entry. Candidates = top-K initial
+    scores suffice because the greedy's touched set is a prefix of that
+    ordering (a node is first touched only when it is the argmax at its
+    initial score).
+
+    The returned `ok` flag is False — caller must discard the result and
+    re-run (bigger J, or the scan) — when an exactness precondition fails
+    on the actual data:
+      * monotonicity: some candidate's masked score sequence increases in j
+        (possible for BalancedAllocation on an unbalanced node, or the
+        MostAllocated strategy);
+      * normalization constancy: TaintToleration / preferred-NodeAffinity
+        raw counts are nonzero over the feasible set, so their
+        DefaultNormalize denominators could shift as nodes saturate
+        mid-run (the scan recomputes them per pod; this path cannot);
+      * depth overflow: some candidate received all J of its matrix
+        entries (counts == J), meaning the greedy may have wanted even
+        more placements there — the truncated matrix diverted them. J is
+        a static depth chosen by the caller (≈ a few × L/nodes, TPU-tiled
+        tiny); the scheduler escalates J on this failure.
+
+    `x` carries ONE scalar entry (sig/tidx of the run's row); `n_actual` is
+    the true run length (≤ L, the padded static length). Requires sig != 0
+    (no host ports — the ports carry is untouched) and a lean carry
+    (groups is None)."""
+    pod = _gather_row(table, x)
+    feasible0, total0, parts = _eval_pod(cfg, na, carry, pod)
+    masked0 = jnp.where(feasible0, total0, jnp.int64(-1))
+    # scores are bounded by 100·Σweights — int32 keys keep TPU sorts cheap
+    _, cand = lax.top_k(masked0.astype(jnp.int32), K)  # ties → lowest index
+    cand = cand.astype(jnp.int32)
+
+    # static per-node score components (constant under the norm gate)
+    s_taint = default_normalize(parts.taint_raw, feasible0, reverse=True)
+    s_na = default_normalize(parts.na_raw, feasible0, reverse=False)
+    static_add = (cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)[cand]
+    static_m = parts.static_mask[cand]
+    norm_ok = (jnp.max(jnp.where(feasible0, parts.taint_raw, 0)) == 0) & (
+        jnp.max(jnp.where(feasible0, parts.na_raw, 0)) == 0)
+
+    # score matrix [K, J]: entry j = post-placement score of the (j+1)-th
+    # run-pod on the candidate. Built column-by-column (static unroll) so
+    # every device op is a 2-D [K, J] elementwise — no [K, J, C] tensors
+    # with a tiny minor dim that would waste the 8×128 vector tiles.
+    j1 = jnp.arange(1, J + 1, dtype=jnp.int64)[None, :]        # [1, J]
+    npods_kj = (carry.npods[cand][:, None]
+                + j1.astype(carry.npods.dtype))
+    fit_kj = npods_kj <= na.allowed_pods[cand][:, None]
+    R = na.cap.shape[1]
+    for r in range(R):
+        cap_r = na.cap[cand, r][:, None]
+        used_r = carry.used[cand, r][:, None] + j1 * pod.req[r]
+        fit_kj &= (pod.req[r] == 0) | (used_r <= cap_r)
+
+    # LeastAllocated / MostAllocated (least_allocated.go:30-60) unrolled
+    # over the score columns; BalancedAllocation via the 2-column closed
+    # form |f0−f1|/2 the reference special-cases (balanced_allocation.go
+    # :224-227) when C==2, generic otherwise.
+    w = cfg.col_weights
+    score_sum = jnp.zeros((K, J), jnp.int64)
+    w_sum = jnp.zeros((K, J), jnp.int64)
+    fracs = []
+    bal_cols_ok = []
+    for ci, col in enumerate(cfg.score_cols):
+        cap_c = na.cap[cand, col][:, None]                      # [K, 1]
+        used_pl = carry.used[cand, col][:, None] + j1 * pod.req[col]
+        if cfg.col_nonzero[ci]:
+            slot = cfg.nonzero_slot[ci]
+            used_c = (carry.nonzero_used[cand, slot][:, None]
+                      + j1 * pod.nonzero_req[slot])
+        else:
+            used_c = used_pl
+        col_ok = cap_c > 0
+        if cfg.strategy == "MostAllocated":
+            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
+                            used_c * MAX_SCORE // jnp.maximum(cap_c, 1))
+        else:
+            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
+                            (cap_c - used_c) * MAX_SCORE // jnp.maximum(cap_c, 1))
+        score_sum += jnp.where(col_ok, raw * w[ci], 0)
+        w_sum += jnp.where(col_ok, jnp.int64(w[ci]), 0)
+        fracs.append(jnp.where(
+            col_ok, jnp.minimum(used_pl / jnp.maximum(cap_c, 1), 1.0), 0.0))
+        bal_cols_ok.append(col_ok)
+    s_fit_kj = jnp.where(w_sum > 0, score_sum // jnp.maximum(w_sum, 1), 0)
+    # same float-op sequence as balanced_allocation() so results are
+    # bit-identical to the scan's (an |f0−f1|/2 shortcut could differ by an
+    # ulp at floor boundaries and break assignment parity)
+    cnt = sum(ok_.astype(jnp.int32) for ok_ in bal_cols_ok)
+    mean = sum(fracs) / jnp.maximum(cnt, 1)
+    var = sum(jnp.where(ok_, (f - mean) ** 2, 0.0)
+              for f, ok_ in zip(fracs, bal_cols_ok)) / jnp.maximum(cnt, 1)
+    std = jnp.sqrt(var)
+    s_bal_kj = jnp.where(
+        pod.skip_balanced, 0,
+        jnp.floor((1.0 - std) * MAX_SCORE + 1e-9).astype(jnp.int64))
+
+    score_kj = (cfg.w_fit * s_fit_kj + cfg.w_balanced * s_bal_kj
+                + static_add[:, None])
+    masked_kj = jnp.where(static_m[:, None] & fit_kj, score_kj,
+                          jnp.int64(-1))
+    mono_ok = jnp.all(masked_kj[:, 1:] <= masked_kj[:, :-1])
+
+    # key = (score desc, node idx asc, j asc); feasible keys ≥ -(M-1),
+    # infeasible ≤ -M — strictly separated. int32 when the range allows
+    # (score ≤ 100·Σweights): TPU sorts int32 ~2× faster than int64.
+    n_nodes = na.cap.shape[0]
+    score_max = MAX_SCORE * (cfg.w_fit + cfg.w_balanced + cfg.w_taint
+                             + cfg.w_node_affinity)
+    M = n_nodes * J
+    key_dt = jnp.int32 if (score_max + 2) * M < 2 ** 31 else jnp.int64
+    ent_id = (cand[:, None].astype(key_dt) * J
+              + jnp.arange(J, dtype=key_dt)[None, :])
+    flat_key = (masked_kj.astype(key_dt) * key_dt(M) - ent_id).reshape(K * J)
+    top_vals, flat_i = lax.top_k(flat_key, L)
+    krank = (flat_i // J).astype(jnp.int32)
+    node_of = cand[krank]
+    sel_ok = (top_vals > -key_dt(M)) & (jnp.arange(L) < n_actual)
+    assignments = jnp.where(sel_ok, node_of, -1).astype(jnp.int32)
+
+    counts = jnp.zeros((K,), jnp.int64).at[krank].add(sel_ok.astype(jnp.int64))
+    # a candidate that consumed its whole column is truncation-suspect: the
+    # exact greedy may have wanted more placements there
+    depth_ok = jnp.all(counts < J)
+    used = carry.used.at[cand].add(counts[:, None] * pod.req[None, :])
+    nonzero = carry.nonzero_used.at[cand].add(
+        counts[:, None] * pod.nonzero_req[None, :])
+    npods = carry.npods.at[cand].add(counts.astype(carry.npods.dtype))
+
+    # cache refresh: entry j=counts IS the next-pod evaluation for this sig
+    ar = jnp.arange(K)
+    cnt_i = jnp.minimum(counts, J - 1).astype(jnp.int32)
+    new_cache = SigCache(
+        sig=pod.sig,
+        static_mask=parts.static_mask, taint_raw=parts.taint_raw,
+        na_raw=parts.na_raw,
+        fit_ok=parts.fit_ok.at[cand].set(fit_kj[ar, cnt_i]),
+        s_fit=parts.s_fit.at[cand].set(s_fit_kj[ar, cnt_i]),
+        s_bal=parts.s_bal.at[cand].set(s_bal_kj[ar, cnt_i]))
+    new_carry = carry._replace(used=used, nonzero_used=nonzero, npods=npods,
+                               cache=new_cache)
+    # pack [assignments; exact; depth] into ONE i32[L+2]: the tunneled-TPU
+    # cost model is dominated by device→host round trips (~100ms each once
+    # the first readback forces synchronous mode), so a run must cost the
+    # caller exactly one readback — and with chained runs, none until the
+    # end of the drain. packed[L] = semantic preconditions held (scan
+    # otherwise); packed[L+1] = depth sufficed (escalate J otherwise).
+    packed = jnp.concatenate([
+        assignments,
+        jnp.stack([mono_ok & norm_ok, depth_ok]).astype(jnp.int32)])
+    return new_carry, packed
+
+
 def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:
     n = na.npods.shape[0]
     zero_cache = SigCache(

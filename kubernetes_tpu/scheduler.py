@@ -25,6 +25,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from .api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
@@ -36,8 +37,8 @@ from .framework.interface import CycleState, Status
 from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
-from .ops.program import (ScoreConfig, initial_carry, pod_rows_from_batch,
-                          run_batch)
+from .ops.program import (PodXs, ScoreConfig, initial_carry,
+                          pod_rows_from_batch, run_batch, run_uniform)
 from .plugins import noderesources as nr
 from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
                                   PrioritySort, SchedulingGates,
@@ -47,7 +48,8 @@ from .plugins.interpodaffinity import InterPodAffinity
 from .plugins.nodeaffinity import NodeAffinity
 from .plugins.podtopologyspread import PodTopologySpread
 from .state.batch import BatchBuilder, BatchDims
-from .state.tensorize import ClusterState
+from .state.tensorize import (EFFECT_PREFER_NO_SCHEDULE, ClusterState,
+                              pow2_at_least)
 
 EVENT_NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
 EVENT_NODE_UPDATE = ClusterEvent(EventResource.NODE, ActionType.UPDATE)
@@ -331,12 +333,12 @@ class Scheduler:
             carry = carry._replace(groups=gcarry)
             self._seeded_rows = self.builder.table_used
         xs, table = pod_rows_from_batch(segment_batch)
-        carry, assignments = run_batch(profile.score_config, na, carry,
-                                       xs, table, groups=self._gd_dev)
+        carry, assignments = self._run_device_program(
+            profile.score_config, na, carry, segment_batch, xs, table,
+            len(qpis), groups_needed)
         # the carry stays device-resident: the only readback per batch is the
         # assignment vector
         self._device_carry = carry
-        assignments = np.asarray(assignments)[:len(qpis)]
         self.device_batches += 1
         bound = 0
         for qpi, a in zip(qpis, assignments):
@@ -348,6 +350,164 @@ class Scheduler:
             else:
                 self._handle_failure(qpi, self._device_fit_error(qpi))
         return bound
+
+    # below this run length the scan's per-step cost beats the matrix setup
+    UNIFORM_RUN_MIN = 16
+
+    def _cluster_has_prefer_taints(self) -> bool:
+        # mask by valid: freed rows of removed nodes keep their taint
+        # columns until the slot is rewritten and must not disable the
+        # uniform fast path forever
+        a = self.state.arrays
+        return a is not None and bool(
+            ((a.taint_eff == EFFECT_PREFER_NO_SCHEDULE)
+             & a.valid[:, None]).any())
+
+    def _classify_runs(self, batch, n: int) -> list[tuple[int, int, bool]]:
+        """Split [0, n) into maximal same-signature runs; mark each uniform
+        (closed-form eligible) or not; merge adjacent non-uniform stretches
+        so they cost one scan dispatch instead of many."""
+        sig, tidx = batch.sig, batch.tidx
+        pref_w = self.builder.table.pref_weight
+        runs: list[tuple[int, int, bool]] = []
+        i = 0
+        while i < n:
+            j = i + 1
+            while j < n and sig[j] == sig[i]:
+                j += 1
+            uniform = (sig[i] != 0 and j - i >= self.UNIFORM_RUN_MIN
+                       and not pref_w[tidx[i]].any())
+            if runs and not uniform and not runs[-1][2]:
+                runs[-1] = (runs[-1][0], j, False)
+            else:
+                runs.append((i, j, uniform))
+            i = j
+        return runs
+
+    def _run_device_program(self, cfg: ScoreConfig, na, carry, batch, xs,
+                            table, n: int, groups_needed: bool):
+        """Route the drain through the fastest exact program — and through
+        the FEWEST device↔host round trips, which on a tunneled TPU
+        dominate everything else (~100ms per sync once the first readback
+        forces synchronous mode).
+
+        Maximal same-signature runs collapse to closed-form top-L
+        assignment (ops/program.py run_uniform — reference batch.go:97's
+        sortedNodes trick, one top_k per run instead of one scan step per
+        pod); anything else — short runs, host-port pods (sig 0), group
+        constraints, MostAllocated, PreferNoSchedule taints, preferred
+        affinity — keeps the sequential scan. All segments of the drain are
+        dispatched back-to-back with the carry chaining on device; ONE
+        packed readback validates every run's exactness flags. Only when a
+        flag fails (rare: BalancedAllocation non-monotonicity or a depth-J
+        overflow) does the host roll back to that segment's input carry and
+        replay with escalation. Returns (carry, assignments[:n])."""
+        fast_ok = (not groups_needed and cfg.strategy == "LeastAllocated"
+                   and not self._cluster_has_prefer_taints())
+        if not fast_ok:
+            carry, assignments = run_batch(cfg, na, carry, xs, table,
+                                           groups=self._gd_dev)
+            return carry, np.asarray(assignments)[:n]
+        runs = self._classify_runs(batch, n)
+        out = np.full((n,), -1, np.int32)
+        n_nodes = max(len(self.snapshot.node_info_list), 1)
+        worklist = list(runs)
+        while worklist:
+            # phase A: optimistic dispatch of every remaining segment, no
+            # host synchronization — the carry chains device-side
+            pend = []  # (kind, i, j, carry_before, result_dev, L, J)
+            cur = carry
+            for (i, j, uniform) in worklist:
+                if uniform:
+                    L = pow2_at_least(j - i)
+                    K = min(L, na.cap.shape[0])
+                    J = min(pow2_at_least(4 * (j - i) // n_nodes + 4), L + 1)
+                    c2, packed = run_uniform(
+                        cfg, na, cur, self._xone(batch, i), table,
+                        np.int32(j - i), L, K, J)
+                    pend.append(("uniform", i, j, cur, packed, L, J))
+                else:
+                    c2, assigns = self._scan_dispatch(cfg, na, cur, batch,
+                                                      i, j, table)
+                    pend.append(("scan", i, j, cur, assigns, 0, 0))
+                cur = c2
+            # phase B: one readback for the whole dispatch chain
+            if len(pend) == 1:
+                res = [np.asarray(pend[0][4])]
+            else:
+                flat = np.asarray(jnp.concatenate([p[4] for p in pend]))
+                res, off = [], 0
+                for p in pend:
+                    ln = p[4].shape[0]
+                    res.append(flat[off:off + ln])
+                    off += ln
+            # phase C: validate in order; first failure rolls back
+            carry = cur
+            worklist = []
+            for idx, (kind, i, j, cbef, _dev, L, J) in enumerate(pend):
+                r = res[idx]
+                if kind == "scan":
+                    out[i:j] = r[:j - i]
+                    continue
+                exact, depth = bool(r[L]), bool(r[L + 1])
+                if exact and depth:
+                    out[i:j] = r[:j - i]
+                    continue
+                # rollback: resolve THIS segment synchronously, then
+                # re-dispatch everything after it against the new carry
+                carry = cbef
+                if exact:
+                    carry = self._uniform_escalate(cfg, na, carry, batch,
+                                                   i, j, table, out, J)
+                else:
+                    carry, a = self._scan_dispatch(cfg, na, carry, batch,
+                                                   i, j, table)
+                    out[i:j] = np.asarray(a)[:j - i]
+                worklist = [(pi, pj, pu) for (pi, pj, pu) in runs if pi >= j]
+                break
+        return carry, out
+
+    def _xone(self, batch, i: int) -> PodXs:
+        return PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[i]),
+                     tidx=np.int32(batch.tidx[i]))
+
+    def _uniform_escalate(self, cfg: ScoreConfig, na, carry, batch,
+                          i: int, j: int, table, out, j_failed: int):
+        """Depth-J overflow recovery: retry the run with a deeper matrix
+        (synchronous — this path is rare), falling back to the scan if
+        even J=L+1 reports failure (can't happen semantically, but belt
+        and braces)."""
+        L = pow2_at_least(j - i)
+        K = min(L, na.cap.shape[0])
+        J = j_failed
+        while J < L + 1:
+            J = min(8 * J, L + 1)
+            c2, packed = run_uniform(cfg, na, carry, self._xone(batch, i),
+                                     table, np.int32(j - i), L, K, J)
+            r = np.asarray(packed)
+            if r[L] and r[L + 1]:
+                out[i:j] = r[:j - i]
+                return c2
+            if not r[L]:
+                break
+        carry, a = self._scan_dispatch(cfg, na, carry, batch, i, j, table)
+        out[i:j] = np.asarray(a)[:j - i]
+        return carry
+
+    def _scan_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
+                       j: int, table):
+        """Dispatch run_batch over pods [i:j) padded to a pow2 bucket;
+        returns (carry, device assignments) without synchronizing."""
+        bucket = pow2_at_least(j - i)
+        m = j - i
+        valid = np.zeros((bucket,), bool)
+        valid[:m] = batch.valid[i:j]
+        sig = np.full((bucket,), batch.sig[j - 1], np.int32)
+        sig[:m] = batch.sig[i:j]
+        tidx = np.full((bucket,), batch.tidx[j - 1], np.int32)
+        tidx[:m] = batch.tidx[i:j]
+        xs = PodXs(valid=valid, sig=sig, tidx=tidx)
+        return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev)
 
     def reconcile(self) -> list:
         """Debug/divergence check (cache debugger analog): pull the resident
@@ -437,29 +597,35 @@ class Scheduler:
         pod = qpi.pod
         assumed = pod.clone()
         assumed.spec.node_name = node_name
+        # reuse the queue entry's pre-parsed requests — no quantity
+        # re-parsing on the per-bind hot path
+        pi = PodInfo(pod=assumed, requests=qpi.pod_info.requests,
+                     cpu_nonzero=qpi.pod_info.cpu_nonzero,
+                     mem_nonzero=qpi.pod_info.mem_nonzero)
         try:
-            self.cache.assume_pod(assumed)
+            self.cache.assume_pod_info(pi)
         except KeyError:
             self.queue.done(pod.uid)
             return
         self.queue.nominator.delete(pod)
         profile = self.profiles.get(pod.spec.scheduler_name)
         fwk = profile.framework
-        cs = state or CycleState()
-        status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
-        if not status.is_success():
-            fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
-            self.cache.forget_pod(assumed)
-            self._invalidate_device_state()
-            self._handle_failure(qpi, FitError(pod, 0))
-            return
-        status = fwk.run_permit_plugins(cs, assumed, node_name)
-        if status.is_rejected():
-            fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
-            self.cache.forget_pod(assumed)
-            self._invalidate_device_state()
-            self._handle_failure(qpi, FitError(pod, 0))
-            return
+        if fwk.reserve_plugins or fwk.permit_plugins:
+            cs = state or CycleState()
+            status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
+            if not status.is_success():
+                fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
+                self.cache.forget_pod(assumed)
+                self._invalidate_device_state()
+                self._handle_failure(qpi, FitError(pod, 0))
+                return
+            status = fwk.run_permit_plugins(cs, assumed, node_name)
+            if status.is_rejected():
+                fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
+                self.cache.forget_pod(assumed)
+                self._invalidate_device_state()
+                self._handle_failure(qpi, FitError(pod, 0))
+                return
         # Wait status (gang quorum) parks the pod; WaitOnPermit resolves at
         # flush time via the workload manager (gang plugin allows all).
         self.queue.done(pod.uid)

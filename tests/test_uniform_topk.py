@@ -1,0 +1,367 @@
+"""run_uniform (closed-form top-L batch assignment) ↔ scan parity.
+
+The uniform-run program (ops/program.py run_uniform) claims BIT-EXACT
+equality with the sequential scan (run_batch) for same-signature runs
+whenever its `ok` flag is true — same assignments, same carry. These tests
+verify that claim across empty/preloaded/heterogeneous/saturating clusters
+and fuzzed states, verify the flag goes False when an exactness precondition
+fails (preferred affinity ⇒ shifting normalization), and verify the
+Scheduler-level routing (fast path + fallbacks) keeps oracle parity.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.ops.program import (PodXs, ScoreConfig, initial_carry,
+                                        pod_rows_from_batch, run_batch,
+                                        run_uniform)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState, pow2_at_least
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _device_state(nodes, pods):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    batch = builder.build(pods)
+    assert not batch.host_fallback.any()
+    na = state.device_arrays()
+    xs, table = pod_rows_from_batch(batch)
+    return state, batch, na, xs, table
+
+
+def _run_both(nodes, pods, cfg=ScoreConfig(), expect_ok=True):
+    """Run the scan and the closed form on identical state; compare."""
+    state, batch, na, xs, table = _device_state(nodes, pods)
+    carry0 = initial_carry(na)
+    scan_carry, scan_assign = run_batch(cfg, na, carry0, xs, table)
+    scan_assign = np.asarray(scan_assign)[:len(pods)]
+
+    L = pow2_at_least(len(pods))
+    K = min(L, na.cap.shape[0])
+    xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                 tidx=np.int32(batch.tidx[0]))
+    uni_carry, packed = run_uniform(
+        cfg, na, carry0, xone, table, np.int32(len(pods)), L, K, L + 1)
+    packed = np.asarray(packed)
+    uni_assign, ok = packed[:L], bool(packed[L] & packed[L + 1])
+    assert ok == expect_ok
+    if not expect_ok:
+        return None
+    np.testing.assert_array_equal(np.asarray(uni_assign)[:len(pods)],
+                                  scan_assign)
+    np.testing.assert_array_equal(np.asarray(uni_carry.used),
+                                  np.asarray(scan_carry.used))
+    np.testing.assert_array_equal(np.asarray(uni_carry.npods),
+                                  np.asarray(scan_carry.npods))
+    np.testing.assert_array_equal(np.asarray(uni_carry.nonzero_used),
+                                  np.asarray(scan_carry.nonzero_used))
+    # cache refresh parity: next-pod evaluation rows must agree so a
+    # subsequent batch starting from either carry behaves identically
+    np.testing.assert_array_equal(np.asarray(uni_carry.cache.fit_ok),
+                                  np.asarray(scan_carry.cache.fit_ok))
+    np.testing.assert_array_equal(np.asarray(uni_carry.cache.s_fit),
+                                  np.asarray(scan_carry.cache.s_fit))
+    np.testing.assert_array_equal(np.asarray(uni_carry.cache.s_bal),
+                                  np.asarray(scan_carry.cache.s_bal))
+    return scan_assign
+
+
+def _nodes(count, cpu=8, mem="16Gi"):
+    return [make_node(f"n{i}")
+            .capacity({"cpu": cpu, "memory": mem, "pods": 110}).obj()
+            for i in range(count)]
+
+
+def _pods(count, cpu="1", mem="2Gi"):
+    return [make_pod(f"p{i}").req({"cpu": cpu, "memory": mem}).obj()
+            for i in range(count)]
+
+
+class TestUniformScanParity:
+    def test_round_robin_empty_cluster(self):
+        # identical nodes: greedy round-robins; closed form must reproduce
+        # the exact first-index tie-break sequence
+        a = _run_both(_nodes(12), _pods(24))
+        assert len(set(a)) == 12  # spread over all nodes
+
+    def test_more_pods_than_capacity(self):
+        # 4 nodes × 8 cpu, 2-cpu pods → 16 fit, the rest get -1
+        a = _run_both(_nodes(4), _pods(20, cpu="2", mem="1Gi"))
+        assert (a >= 0).sum() == 16 and (a[16:] == -1).all()
+
+    def test_heterogeneous_capacities(self):
+        nodes = [make_node(f"n{i}")
+                 .capacity({"cpu": 2 + 3 * i, "memory": "64Gi", "pods": 110})
+                 .obj() for i in range(5)]
+        _run_both(nodes, _pods(30, cpu="1", mem="1Gi"))
+
+    def test_preloaded_cluster(self):
+        # nodes with existing (bound) pods: carry starts non-empty
+        nodes = _nodes(6)
+        cache = Cache()
+        for n in nodes:
+            cache.add_node(n)
+        api_pods = [make_pod(f"pre{i}").req({"cpu": str(1 + i % 3),
+                                             "memory": "1Gi"})
+                    .node(f"n{i % 6}").obj() for i in range(9)]
+        for p in api_pods:
+            cache.add_pod(p)
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        state = ClusterState()
+        state.apply_snapshot(snap, full=True)
+        builder = BatchBuilder(state)
+        pods = _pods(20, cpu="1", mem="1Gi")
+        batch = builder.build(pods)
+        na = state.device_arrays()
+        xs, table = pod_rows_from_batch(batch)
+        cfg = ScoreConfig()
+        carry0 = initial_carry(na)
+        _, scan_assign = run_batch(cfg, na, carry0, xs, table)
+        L = pow2_at_least(len(pods))
+        xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                     tidx=np.int32(batch.tidx[0]))
+        _, packed = run_uniform(
+            cfg, na, carry0, xone, table, np.int32(len(pods)), L,
+            min(L, na.cap.shape[0]), L + 1)
+        packed = np.asarray(packed)
+        assert packed[L] and packed[L + 1]
+        np.testing.assert_array_equal(packed[:len(pods)],
+                                      np.asarray(scan_assign)[:len(pods)])
+
+    def test_best_effort_pods(self):
+        # zero requests: NonZeroRequested defaults drive s_fit; s_bal skipped
+        _run_both(_nodes(5), [make_pod(f"p{i}").obj() for i in range(15)])
+
+    def test_n_actual_shorter_than_bucket(self):
+        # L pads to 32; only the first 20 entries may assign
+        state, batch, na, xs, table = _device_state(_nodes(4), _pods(20))
+        cfg = ScoreConfig()
+        carry0 = initial_carry(na)
+        xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                     tidx=np.int32(batch.tidx[0]))
+        _, packed = run_uniform(cfg, na, carry0, xone, table,
+                                np.int32(20), 32,
+                                min(32, na.cap.shape[0]), 33)
+        packed = np.asarray(packed)
+        assert packed[32] and packed[33]
+        a32 = packed[:32]
+        assert (a32[20:] == -1).all()
+        _, scan_assign = run_batch(cfg, na, carry0, xs, table)
+        np.testing.assert_array_equal(a32[:20],
+                                      np.asarray(scan_assign)[:20])
+
+    def test_chained_chunks_continue_carry(self):
+        # splitting one long run across two run_uniform calls must equal one
+        # scan over the whole run (the L_MAX chaining in the scheduler)
+        state, batch, na, xs, table = _device_state(_nodes(6), _pods(24))
+        cfg = ScoreConfig()
+        carry = initial_carry(na)
+        xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                     tidx=np.int32(batch.tidx[0]))
+        out = []
+        for lo, hi in ((0, 16), (16, 24)):
+            chunk = hi - lo
+            L = pow2_at_least(chunk)
+            carry, packed = run_uniform(cfg, na, carry, xone, table,
+                                        np.int32(chunk), L,
+                                        min(L, na.cap.shape[0]), L + 1)
+            packed = np.asarray(packed)
+            assert packed[L] and packed[L + 1]
+            out.extend(packed[:chunk])
+        _, scan_assign = run_batch(cfg, na, initial_carry(na), xs, table)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(scan_assign)[:24])
+
+    def test_preferred_affinity_fails_closed(self):
+        # nonzero preferred-affinity raw counts ⇒ normalization can shift as
+        # nodes saturate ⇒ ok must be False (scheduler host-gates this too)
+        nodes = [make_node(f"n{i}").capacity({"cpu": 4, "memory": "8Gi",
+                                              "pods": 110})
+                 .label("tier", "gold" if i % 2 else "silver").obj()
+                 for i in range(4)]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .preferred_node_affinity_in("tier", ["gold"], 5).obj()
+                for i in range(8)]
+        _run_both(nodes, pods, expect_ok=False)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_vs_scan(self, seed):
+        """Random preloaded clusters + identical pods: whenever ok, the
+        closed form must equal the scan bit-exactly; ok=False is allowed
+        (the scheduler falls back) but must be rare enough to matter — we
+        only require agreement, not ok."""
+        rng = random.Random(seed)
+        n_nodes = rng.randint(2, 24)
+        nodes = [make_node(f"n{i}").capacity(
+            {"cpu": rng.randint(2, 32),
+             "memory": f"{rng.randint(4, 64)}Gi",
+             "pods": rng.randint(3, 20)}).obj() for i in range(n_nodes)]
+        cache = Cache()
+        for n in nodes:
+            cache.add_node(n)
+        for i in range(rng.randint(0, 3 * n_nodes)):
+            cache.add_pod(make_pod(f"pre{i}").req(
+                {"cpu": str(rng.randint(0, 3)),
+                 "memory": f"{rng.randint(0, 4)}Gi"})
+                .node(f"n{rng.randrange(n_nodes)}").obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        state = ClusterState()
+        state.apply_snapshot(snap, full=True)
+        builder = BatchBuilder(state)
+        cpu, mem = rng.randint(0, 4), rng.randint(0, 4)
+        pods = [make_pod(f"p{i}").req({"cpu": str(cpu), "memory": f"{mem}Gi"})
+                .obj() for i in range(rng.randint(16, 48))]
+        batch = builder.build(pods)
+        na = state.device_arrays()
+        xs, table = pod_rows_from_batch(batch)
+        cfg = ScoreConfig()
+        carry0 = initial_carry(na)
+        _, scan_assign = run_batch(cfg, na, carry0, xs, table)
+        L = pow2_at_least(len(pods))
+        xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                     tidx=np.int32(batch.tidx[0]))
+        _, packed = run_uniform(
+            cfg, na, carry0, xone, table, np.int32(len(pods)), L,
+            min(L, na.cap.shape[0]), L + 1)
+        packed = np.asarray(packed)
+        if packed[L] and packed[L + 1]:
+            np.testing.assert_array_equal(
+                packed[:len(pods)],
+                np.asarray(scan_assign)[:len(pods)])
+
+
+class TestSchedulerFastPath:
+    def _bound_map(self, api):
+        return {p.name: p.spec.node_name for p in api.pods.values()
+                if p.spec.node_name}
+
+    def test_fast_path_matches_scan_path(self):
+        """Same workload through a fast-path scheduler and one with the
+        uniform path disabled (RUN_MIN > batch) must bind identically."""
+        results = []
+        for run_min in (16, 10 ** 9):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            for i in range(10):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 16, "memory": "32Gi", "pods": 110}).obj())
+            sched.UNIFORM_RUN_MIN = run_min
+            for i in range(40):
+                api.create_pod(make_pod(f"p{i}").req(
+                    {"cpu": "1", "memory": "1Gi"}).obj())
+            bound = sched.schedule_pending()
+            assert bound == 40
+            assert sched.reconcile() == []
+            results.append(self._bound_map(api))
+        assert results[0] == results[1]
+
+    def test_mixed_signatures_route_correctly(self):
+        """Interleaved signatures: long uniform runs use the closed form,
+        the stretch in between scans; binds must match the all-scan run."""
+        def workload(api):
+            for i in range(20):
+                api.create_pod(make_pod(f"a{i}").req(
+                    {"cpu": "1", "memory": "1Gi"}).obj())
+            for i in range(5):  # short runs → scan stretch
+                api.create_pod(make_pod(f"b{i}").req(
+                    {"cpu": str(1 + i % 2), "memory": "2Gi"}).obj())
+            for i in range(20):
+                api.create_pod(make_pod(f"c{i}").req(
+                    {"cpu": "2", "memory": "1Gi"}).obj())
+        results = []
+        for run_min in (16, 10 ** 9):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            for i in range(8):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 20, "memory": "40Gi", "pods": 110}).obj())
+            sched.UNIFORM_RUN_MIN = run_min
+            workload(api)
+            assert sched.schedule_pending() == 45
+            assert sched.reconcile() == []
+            results.append(self._bound_map(api))
+        assert results[0] == results[1]
+
+    def test_prefer_no_schedule_taints_gate_to_scan(self):
+        """PreferNoSchedule taints in the cluster must route to the scan
+        (normalization shifts); decisions still match the scan-only run."""
+        def cluster(api):
+            for i in range(6):
+                n = make_node(f"n{i}").capacity(
+                    {"cpu": 8, "memory": "16Gi", "pods": 110})
+                if i < 2:
+                    n = n.taint("burst", "true", "PreferNoSchedule")
+                api.create_node(n.obj())
+        results = []
+        for run_min in (16, 10 ** 9):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            cluster(api)
+            sched.UNIFORM_RUN_MIN = run_min
+            for i in range(24):
+                api.create_pod(make_pod(f"p{i}").req(
+                    {"cpu": "1", "memory": "1Gi"}).obj())
+            assert sched.schedule_pending() == 24
+            results.append(self._bound_map(api))
+        assert results[0] == results[1]
+        # the untainted nodes must win while they have room
+        tainted = {f"n{i}" for i in range(2)}
+        first_16 = [results[0][f"p{i}"] for i in range(16)]
+        assert not tainted & set(first_16)
+
+
+class TestDepthEscalation:
+    def test_shallow_depth_fails_closed(self):
+        # 2 nodes × plenty of room, 32 pods → 16 per node > J=8 entries:
+        # depth flag must fire; J=L+1 must succeed and match the scan
+        state, batch, na, xs, table = _device_state(
+            _nodes(2, cpu=64, mem="128Gi"), _pods(32, cpu="1", mem="1Gi"))
+        cfg = ScoreConfig()
+        carry0 = initial_carry(na)
+        xone = PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[0]),
+                     tidx=np.int32(batch.tidx[0]))
+        _, packed = run_uniform(cfg, na, carry0, xone, table,
+                                np.int32(32), 32, 8, 8)
+        packed = np.asarray(packed)
+        assert packed[32] and not packed[33]
+        _, packed = run_uniform(cfg, na, carry0, xone, table,
+                                np.int32(32), 32, 8, 33)
+        packed = np.asarray(packed)
+        assert packed[32] and packed[33]
+        _, scan_assign = run_batch(cfg, na, carry0, xs, table)
+        np.testing.assert_array_equal(packed[:32],
+                                      np.asarray(scan_assign)[:32])
+
+    def test_scheduler_escalates_depth(self):
+        # few nodes, many pods: j0 starts deep enough or the ladder climbs —
+        # either way binds must match the scan-only scheduler
+        results = []
+        for run_min in (16, 10 ** 9):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=256)
+            sched.UNIFORM_RUN_MIN = run_min
+            for i in range(3):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 100, "memory": "200Gi", "pods": 300}).obj())
+            for i in range(200):
+                api.create_pod(make_pod(f"p{i}").req(
+                    {"cpu": "1", "memory": "1Gi"}).obj())
+            assert sched.schedule_pending() == 200
+            assert sched.reconcile() == []
+            results.append({p.name: p.spec.node_name
+                            for p in api.pods.values() if p.spec.node_name})
+        assert results[0] == results[1]
