@@ -1,19 +1,28 @@
 #!/usr/bin/env python
-"""Headline benchmark: SchedulingBasic 5000Nodes_10000Pods throughput.
+"""Headline benchmark: SchedulingBasic throughput + group-kernel cases.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N/270}
+  {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N/270,
+   "extra": {"TopologySpreading_...": {...}, "SchedulingPodAntiAffinity_...":
+   {...}}}
 
-vs_baseline divides by the reference's threshold for the same workload
-(kubernetes/kubernetes test/integration/scheduler_perf/misc/
-performance-config.yaml:67-75, minimum average 270 pods/s).
+`vs_baseline` divides by the reference's threshold for the same workload
+(kubernetes/kubernetes test/integration/scheduler_perf configs):
+  SchedulingBasic          ≥ 270  (misc/performance-config.yaml:67-75)
+  TopologySpreading        ≥ 85   (topology_spreading/performance-config.yaml:20)
+  SchedulingPodAntiAffinity ≥ 60  (affinity/performance-config.yaml:57-80)
 
-Compile time is excluded: a warm-up workload with identical padded device
-shapes (node bucket 8192, pod batch 512) runs first; the measured phase then
-reuses the jitted program.
+Compile exclusion: each workload runs TWICE in this process — the first
+(unmeasured) pass drives the scheduler through the exact same padded device
+shapes (node bucket, batch bucket, uniform-run L/K/J variants, group
+tensors), so every XLA executable the measured pass needs is already in the
+in-process cache. The measured pass then re-runs the workload on a fresh
+Scheduler/APIServer; a shape bucket compiled in pass one is a cache hit in
+pass two regardless of the new Scheduler instance (the reported
+warm_pass_s / measured_pass_s gap makes any residual compile visible).
 
 Env:
-  KTPU_BENCH_SMALL=1   500 nodes / 1000 pods quick run
+  KTPU_BENCH_SMALL=1   500-node / small-pod quick variants
   KTPU_BENCH_VERBOSE=1 per-batch progress on stderr
 """
 
@@ -22,10 +31,16 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:67-75 threshold
+CASES = [
+    # (case, big workload, small workload, reference threshold)
+    ("SchedulingBasic", "5000Nodes_10000Pods", "500Nodes_1000Pods", 270.0),
+    ("TopologySpreading", "5000Nodes_5000Pods", "500Nodes", 85.0),
+    ("SchedulingPodAntiAffinity", "5000Nodes_2000Pods", "500Nodes", 60.0),
+]
 
 
 def main() -> None:
@@ -36,30 +51,40 @@ def main() -> None:
     cfg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "kubernetes_tpu", "perf", "configs",
                        "performance-config.yaml")
-    workload = "500Nodes_1000Pods" if small else "5000Nodes_10000Pods"
+    results = {}
+    for case, big, small_wl, threshold in CASES:
+        workload = small_wl if small else big
+        t0 = time.perf_counter()
+        run_config(cfg, case, workload)           # warm: compiles all shapes
+        warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = run_config(cfg, case, workload, verbose=verbose)
+        measured_s = time.perf_counter() - t0
+        if not got:
+            raise SystemExit(f"workload {case}/{workload} not found")
+        item, _ = got[0]
+        results[f"{case}_{workload}"] = {
+            "value": round(item.average, 1),
+            "vs_baseline": round(item.average / threshold, 2),
+            "p50": round(item.perc50), "p95": round(item.perc95),
+            "p99": round(item.perc99), "pods": item.pods,
+            "warm_pass_s": round(warm_s, 1),
+            "measured_pass_s": round(measured_s, 1),
+        }
+        if verbose:
+            print(f"  {case}/{workload}: {item.average:.1f} pods/s "
+                  f"(warm pass {warm_s:.1f}s, measured {measured_s:.1f}s)",
+                  file=sys.stderr)
 
-    # warm-up: same device shape buckets (8192-node rows only arise in the
-    # big run; the small warmup still compiles the 512-wide batch program
-    # for its own bucket). Use a miniature run of the same case.
-    if not small:
-        run_config(cfg, "SchedulingBasic", "500Nodes_1000Pods")
-    else:
-        run_config(cfg, "SchedulingBasic", "50Nodes_100Pods")
-
-    results = run_config(cfg, "SchedulingBasic", workload, verbose=verbose)
-    if not results:
-        raise SystemExit(f"workload {workload} not found")
-    item, _threshold = results[0]
+    head_key = next(iter(results))
+    head = results[head_key]
     print(json.dumps({
-        "metric": f"SchedulingBasic_{workload}_throughput",
-        "value": round(item.average, 1),
+        "metric": f"{head_key}_throughput",
+        "value": head["value"],
         "unit": "pods/s",
-        "vs_baseline": round(item.average / BASELINE_PODS_PER_SEC, 2),
+        "vs_baseline": head["vs_baseline"],
+        "extra": {k: v for k, v in results.items() if k != head_key},
     }))
-    if verbose:
-        print(f"  pods={item.pods} duration={item.duration_s:.2f}s "
-              f"p50={item.perc50:.0f} p95={item.perc95:.0f} p99={item.perc99:.0f}",
-              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -132,11 +132,7 @@ class Cache:
         uid = pod.uid
         if uid in self.pod_states:
             raise KeyError(f"pod {uid} is in the cache, so can't be assumed")
-        if not pod.spec.node_name:
-            raise ValueError(f"pod {uid} has no nodeName")
-        item = self._get_or_create(pod.spec.node_name)
-        item.info.add_pod(pi)
-        self._move_to_head(item)
+        self._add_pod_info_to_node(pi)
         ps = _PodState(pod=pod, assumed=True)
         self.pod_states[uid] = ps
         self.assumed_pods.add(uid)
@@ -210,10 +206,14 @@ class Cache:
         return len(self.pod_states)
 
     def _add_pod_to_node(self, pod: Pod) -> None:
+        self._add_pod_info_to_node(PodInfo.of(pod))
+
+    def _add_pod_info_to_node(self, pi: PodInfo) -> None:
+        pod = pi.pod
         if not pod.spec.node_name:
             raise ValueError(f"pod {pod.uid} has no nodeName")
         item = self._get_or_create(pod.spec.node_name)
-        item.info.add_pod(PodInfo.of(pod))
+        item.info.add_pod(pi)
         self._move_to_head(item)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:

@@ -111,57 +111,98 @@ class GroupCarry(NamedTuple):
     ipa_score: object         # i64 [U, N] — symmetric topology score surface
 
 
+class GroupFamilies(NamedTuple):
+    """Static (jit-key) activation mask per constraint family.
+
+    When a family is provably inactive — no signature row carries it and its
+    seeded counts are zero — every one of its carry updates is identically
+    zero and every one of its mask/score contributions is the identity, so
+    the kernels skip it AT TRACE TIME. This matters enormously on TPU: a
+    spread-only workload compiles a program with no inter-pod-affinity
+    compute at all (≈5-8× per scan step), which is what XLA's constant
+    folder would do if the tensors were compile-time constants — but keyed
+    on a 5-bool mask instead of the tensor VALUES, so the executable is
+    reused across group-state rebuilds.
+
+    Pass-through of an inactive family's counts stays exact across later
+    activation: a newly added signature row re-seeds its own counts from the
+    live snapshot (scatter_new_rows), and existing rows' counts could only
+    have received zero increments while the family was inactive."""
+
+    spr_f: bool = True
+    spr_s: bool = True
+    ipa_req: bool = True
+    ipa_anti: bool = True
+    ipa_score: bool = True
+
+
+ALL_FAMILIES = GroupFamilies()
+
+
+
+
 # ---------------------------------------------------------------------------
 # device kernels
 
 
-def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None):
+def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None,
+               fam: Optional[GroupFamilies] = None):
     """Feasibility over the node axis for the pod signature `tidx`:
     spread skew check (filtering.go:314-360) AND the three inter-pod
-    affinity checks (filtering.go:405-432)."""
+    affinity checks (filtering.go:405-432). `fam` statically skips families
+    whose contribution is provably the identity (see GroupFamilies)."""
     import jax.numpy as jnp
     from jax import lax
 
-    # -- spread skew (DoNotSchedule)
-    act = gd.spr_f_active[tidx]                     # [SC]
-    cnt = gc.spr_f_cnt[tidx]                        # [SC, N]
-    elig = gd.spr_f_elig[tidx]
-    tv = gd.spr_f_tv[tidx]
-    minv = jnp.min(jnp.where(elig, cnt, INT32_MAX), axis=-1)   # [SC]
-    if axis is not None:
-        minv = lax.pmin(minv, axis)
-    # fewer eligible domains than minDomains (incl. zero domains) ⇒ min = 0
-    # (filtering.go:66-77)
-    minv = jnp.where(gc.spr_f_min_zero[tidx], 0, minv)
-    ok = (cnt + gd.spr_f_self[tidx][:, None] - minv[:, None]
-          <= gd.spr_f_max_skew[tidx][:, None])
-    # node missing the topology key ⇒ UnschedulableAndUnresolvable
-    spread_ok = jnp.all(~act[:, None] | ((tv != 0) & ok), axis=0)
+    fam = fam or ALL_FAMILIES
+    n = gc.ipa_veto.shape[-1]
+    mask = jnp.ones((n,), bool)
 
-    # -- existing pods' required anti-affinity (filtering.go:204-228)
-    veto_ok = gc.ipa_veto[tidx] == 0
+    if fam.spr_f:
+        # -- spread skew (DoNotSchedule)
+        act = gd.spr_f_active[tidx]                 # [SC]
+        cnt = gc.spr_f_cnt[tidx]                    # [SC, N]
+        elig = gd.spr_f_elig[tidx]
+        tv = gd.spr_f_tv[tidx]
+        minv = jnp.min(jnp.where(elig, cnt, INT32_MAX), axis=-1)   # [SC]
+        if axis is not None:
+            minv = lax.pmin(minv, axis)
+        # fewer eligible domains than minDomains (incl. zero domains) ⇒
+        # min = 0 (filtering.go:66-77)
+        minv = jnp.where(gc.spr_f_min_zero[tidx], 0, minv)
+        ok = (cnt + gd.spr_f_self[tidx][:, None] - minv[:, None]
+              <= gd.spr_f_max_skew[tidx][:, None])
+        # node missing the topology key ⇒ UnschedulableAndUnresolvable
+        mask &= jnp.all(~act[:, None] | ((tv != 0) & ok), axis=0)
 
-    # -- incoming required anti-affinity
-    raa_act = gd.ipa_raa_active[tidx]               # [TAA]
-    raa_tv = gd.ipa_raa_tv[tidx]                    # [TAA, N]
-    aa_bad = jnp.any(raa_act[:, None] & (raa_tv != 0)
-                     & (gc.ipa_aa_cnt[tidx] > 0), axis=0)
+    if fam.ipa_anti:
+        # -- existing pods' required anti-affinity (filtering.go:204-228)
+        mask &= gc.ipa_veto[tidx] == 0
+        # -- incoming required anti-affinity
+        raa_act = gd.ipa_raa_active[tidx]           # [TAA]
+        raa_tv = gd.ipa_raa_tv[tidx]                # [TAA, N]
+        mask &= ~jnp.any(raa_act[:, None] & (raa_tv != 0)
+                         & (gc.ipa_aa_cnt[tidx] > 0), axis=0)
 
-    # -- incoming required affinity (incl. the first-pod-in-series escape
-    # hatch, filtering.go:381-397)
-    ra_act = gd.ipa_ra_active[tidx]                 # [TA]
-    ra_tv = gd.ipa_ra_tv[tidx]                      # [TA, N]
-    tv_all = jnp.all(~ra_act[:, None] | (ra_tv != 0), axis=0)
-    pods_exist = jnp.all(~ra_act[:, None] | (gc.ipa_a_cnt[tidx] > 0), axis=0)
-    escape = (gc.ipa_a_total[tidx] == 0) & gd.ipa_self_all[tidx]
-    aff_ok = jnp.where(jnp.any(ra_act), tv_all & (pods_exist | escape), True)
+    if fam.ipa_req:
+        # -- incoming required affinity (incl. the first-pod-in-series
+        # escape hatch, filtering.go:381-397)
+        ra_act = gd.ipa_ra_active[tidx]             # [TA]
+        ra_tv = gd.ipa_ra_tv[tidx]                  # [TA, N]
+        tv_all = jnp.all(~ra_act[:, None] | (ra_tv != 0), axis=0)
+        pods_exist = jnp.all(~ra_act[:, None] | (gc.ipa_a_cnt[tidx] > 0),
+                             axis=0)
+        escape = (gc.ipa_a_total[tidx] == 0) & gd.ipa_self_all[tidx]
+        mask &= jnp.where(jnp.any(ra_act), tv_all & (pods_exist | escape),
+                          True)
 
-    return spread_ok & veto_ok & ~aa_bad & aff_ok
+    return mask
 
 
 def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
                  tidx, feasible, axis: Optional[str] = None,
-                 n_global: Optional[int] = None):
+                 n_global: Optional[int] = None,
+                 fam: Optional[GroupFamilies] = None):
     """Weighted PodTopologySpread + InterPodAffinity score over the node
     axis, already normalized per the host plugins' Normalize formulas.
     `feasible` is the FULL filtered set (all plugins), matching the host
@@ -170,6 +211,7 @@ def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
     import jax.numpy as jnp
     from jax import lax
 
+    fam = fam or ALL_FAMILIES
     N = feasible.shape[0]
     if n_global is None:
         n_global = N
@@ -183,6 +225,10 @@ def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
     def _gsum(x):
         return lax.psum(x, axis) if axis is not None else x
 
+    if not fam.spr_s and not fam.ipa_score:
+        return jnp.zeros((N,), jnp.int64)
+    if not fam.spr_s:
+        return w_ipa * _ipa_norm_scores(gc, tidx, feasible, _gmin, _gmax)
     # ---- PodTopologySpread (scoring.go:199-271) ----
     s_act = gd.spr_s_active[tidx]                   # [SC]
     has_s = jnp.any(s_act)
@@ -215,22 +261,30 @@ def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
     spread_score = jnp.where(has_s & scored, norm, 0)
     # ignored (missing-keys) nodes score 0; infeasible rows are masked later
 
-    # ---- InterPodAffinity (scoring.go:263-293) ----
+    if not fam.ipa_score:
+        return w_spread * spread_score
+    return (w_spread * spread_score
+            + w_ipa * _ipa_norm_scores(gc, tidx, feasible, _gmin, _gmax))
+
+
+def _ipa_norm_scores(gc: GroupCarry, tidx, feasible, _gmin, _gmax):
+    """InterPodAffinity normalized score surface (scoring.go:263-293)."""
+    import jax.numpy as jnp
+
     s = gc.ipa_score[tidx]                          # [N] i64
     big = jnp.iinfo(jnp.int64).max
     minv2 = _gmin(jnp.min(jnp.where(feasible, s, big)))
     maxv2 = _gmax(jnp.max(jnp.where(feasible, s, -big)))
     diff = maxv2 - minv2
-    ipa_norm = jnp.where(
+    return jnp.where(
         diff > 0,
         (MAX_NODE_SCORE * (s - minv2).astype(jnp.float64)
          / jnp.maximum(diff, 1).astype(jnp.float64)),
         0.0).astype(jnp.int64)
 
-    return w_spread * spread_score + w_ipa * ipa_norm
 
-
-def group_update(gd: GroupsDev, gc: GroupCarry, tidx, pick, is_chosen, gate):
+def group_update(gd: GroupsDev, gc: GroupCarry, tidx, pick, is_chosen, gate,
+                 fam: Optional[GroupFamilies] = None):
     """Carry update after placing a pod of signature `tidx`.
 
     `pick(arr)` extracts `arr[..., b]` for the chosen node b (the sharded
@@ -241,65 +295,82 @@ def group_update(gd: GroupsDev, gc: GroupCarry, tidx, pick, is_chosen, gate):
     the incremental broadcast equals the reference's per-cycle rebuild."""
     import jax.numpy as jnp
 
+    fam = fam or ALL_FAMILIES
     u = tidx
     gate_i = gate.astype(jnp.int32)
+    spr_f_cnt, spr_s_cnt = gc.spr_f_cnt, gc.spr_s_cnt
+    ipa_veto, ipa_a_cnt = gc.ipa_veto, gc.ipa_a_cnt
+    ipa_a_total, ipa_aa_cnt = gc.ipa_a_total, gc.ipa_aa_cnt
+    ipa_score = gc.ipa_score
 
-    # spread filter counts: +1 at every node sharing the chosen node's
-    # topology value, per consumer constraint the placed pod matches, iff the
-    # chosen node is count-eligible for that constraint
-    tvb_f = pick(gd.spr_f_tv)                       # [U, SC]
-    eligb_f = pick(gd.spr_f_elig)                   # [U, SC]
-    inc_f = ((gd.m_spr_f[u] & eligb_f)[:, :, None]
-             & (gd.spr_f_tv == tvb_f[:, :, None]) & (tvb_f[:, :, None] != 0))
-    spr_f_cnt = gc.spr_f_cnt + gate_i * inc_f.astype(jnp.int32)
+    if fam.spr_f:
+        # spread filter counts: +1 at every node sharing the chosen node's
+        # topology value, per consumer constraint the placed pod matches,
+        # iff the chosen node is count-eligible for that constraint
+        tvb_f = pick(gd.spr_f_tv)                   # [U, SC]
+        eligb_f = pick(gd.spr_f_elig)               # [U, SC]
+        inc_f = ((gd.m_spr_f[u] & eligb_f)[:, :, None]
+                 & (gd.spr_f_tv == tvb_f[:, :, None])
+                 & (tvb_f[:, :, None] != 0))
+        spr_f_cnt = gc.spr_f_cnt + gate_i * inc_f.astype(jnp.int32)
 
-    # spread score counts: hostname constraints count the node's own pods
-    # (scoring.go score()); other keys share by topology value
-    tvb_s = pick(gd.spr_s_tv)
-    eligb_s = pick(gd.spr_s_elig)
-    is_b = is_chosen[None, None, :]                 # [1, 1, N]
-    share_s = jnp.where(gd.spr_s_is_host[:, :, None], is_b,
-                        (gd.spr_s_tv == tvb_s[:, :, None])
-                        & (tvb_s[:, :, None] != 0))
-    gate_c = jnp.where(gd.spr_s_is_host, gd.m_spr_s[u],
-                       gd.m_spr_s[u] & eligb_s)
-    spr_s_cnt = gc.spr_s_cnt + gate_i * (gate_c[:, :, None] & share_s).astype(jnp.int32)
+    if fam.spr_s:
+        # spread score counts: hostname constraints count the node's own
+        # pods (scoring.go score()); other keys share by topology value
+        tvb_s = pick(gd.spr_s_tv)
+        eligb_s = pick(gd.spr_s_elig)
+        is_b = is_chosen[None, None, :]             # [1, 1, N]
+        share_s = jnp.where(gd.spr_s_is_host[:, :, None], is_b,
+                            (gd.spr_s_tv == tvb_s[:, :, None])
+                            & (tvb_s[:, :, None] != 0))
+        gate_c = jnp.where(gd.spr_s_is_host, gd.m_spr_s[u],
+                           gd.m_spr_s[u] & eligb_s)
+        spr_s_cnt = gc.spr_s_cnt + gate_i * (
+            gate_c[:, :, None] & share_s).astype(jnp.int32)
 
-    # existing-anti veto: the placed pod's own required anti terms add a
-    # (term.key, tv(b)) pair for every consumer signature they match
-    tvb_p_anti = pick(gd.ipa_raa_tv)[u]             # [TAA]
-    share_anti = ((gd.ipa_raa_tv[u] == tvb_p_anti[:, None])
-                  & (tvb_p_anti[:, None] != 0))     # [TAA, N]
-    delta_veto = jnp.sum(gd.m_ipa_exist[u][:, :, None] & share_anti[None],
-                         axis=1).astype(jnp.int32)  # [U, N]
-    ipa_veto = gc.ipa_veto + gate_i * delta_veto
+    if fam.ipa_anti:
+        # existing-anti veto: the placed pod's own required anti terms add
+        # a (term.key, tv(b)) pair for every consumer signature they match
+        tvb_p_anti = pick(gd.ipa_raa_tv)[u]         # [TAA]
+        share_anti = ((gd.ipa_raa_tv[u] == tvb_p_anti[:, None])
+                      & (tvb_p_anti[:, None] != 0))  # [TAA, N]
+        delta_veto = jnp.sum(
+            gd.m_ipa_exist[u][:, :, None] & share_anti[None],
+            axis=1).astype(jnp.int32)               # [U, N]
+        ipa_veto = gc.ipa_veto + gate_i * delta_veto
+        # incoming-anti counts (per consumer term)
+        tvb_aa = pick(gd.ipa_raa_tv)                # [U, TAA]
+        share_aa = ((gd.ipa_raa_tv == tvb_aa[:, :, None])
+                    & (tvb_aa[:, :, None] != 0))
+        inc_aa = gd.m_ipa_aa[u][:, :, None] & share_aa
+        ipa_aa_cnt = gc.ipa_aa_cnt + gate_i * inc_aa.astype(jnp.int32)
 
-    # incoming-affinity counts: placed pod matching ALL of a consumer's
-    # required terms bumps each term's (key, tv(b)) pair
-    tvb_a = pick(gd.ipa_ra_tv)                      # [U, TA]
-    share_a = (gd.ipa_ra_tv == tvb_a[:, :, None]) & (tvb_a[:, :, None] != 0)
-    inc_a = (gd.m_ipa_a[u][:, None] & gd.ipa_ra_active)[:, :, None] & share_a
-    ipa_a_cnt = gc.ipa_a_cnt + gate_i * inc_a.astype(jnp.int32)
-    ipa_a_total = gc.ipa_a_total + (
-        gate_i * gd.m_ipa_a[u]
-        * jnp.sum(gd.ipa_ra_active & (tvb_a != 0), axis=1)).astype(jnp.int64)
+    if fam.ipa_req:
+        # incoming-affinity counts: placed pod matching ALL of a consumer's
+        # required terms bumps each term's (key, tv(b)) pair
+        tvb_a = pick(gd.ipa_ra_tv)                  # [U, TA]
+        share_a = ((gd.ipa_ra_tv == tvb_a[:, :, None])
+                   & (tvb_a[:, :, None] != 0))
+        inc_a = ((gd.m_ipa_a[u][:, None] & gd.ipa_ra_active)[:, :, None]
+                 & share_a)
+        ipa_a_cnt = gc.ipa_a_cnt + gate_i * inc_a.astype(jnp.int32)
+        ipa_a_total = gc.ipa_a_total + (
+            gate_i * gd.m_ipa_a[u]
+            * jnp.sum(gd.ipa_ra_active & (tvb_a != 0), axis=1)
+        ).astype(jnp.int64)
 
-    # incoming-anti counts (per consumer term)
-    tvb_aa = pick(gd.ipa_raa_tv)                    # [U, TAA]
-    share_aa = (gd.ipa_raa_tv == tvb_aa[:, :, None]) & (tvb_aa[:, :, None] != 0)
-    inc_aa = gd.m_ipa_aa[u][:, :, None] & share_aa
-    ipa_aa_cnt = gc.ipa_aa_cnt + gate_i * inc_aa.astype(jnp.int32)
-
-    # symmetric score surface: consumer-side preferred terms matching the
-    # placed pod, plus placed-side (req×hardWeight + preferred) terms
-    # matching the consumer (scoring.go:81-124)
-    tvb_c = pick(gd.ipa_stc_tv)                     # [U, CT]
-    share_c = (gd.ipa_stc_tv == tvb_c[:, :, None]) & (tvb_c[:, :, None] != 0)
-    d_cons = jnp.sum(gd.w_stc[u][:, :, None] * share_c, axis=1)   # [U, N]
-    tvb_p = pick(gd.ipa_stp_tv)[u]                  # [PT]
-    share_p = (gd.ipa_stp_tv[u] == tvb_p[:, None]) & (tvb_p[:, None] != 0)
-    d_plcd = jnp.sum(gd.w_stp[u][:, :, None] * share_p[None], axis=1)
-    ipa_score = gc.ipa_score + gate.astype(jnp.int64) * (d_cons + d_plcd)
+    if fam.ipa_score:
+        # symmetric score surface: consumer-side preferred terms matching
+        # the placed pod, plus placed-side (req×hardWeight + preferred)
+        # terms matching the consumer (scoring.go:81-124)
+        tvb_c = pick(gd.ipa_stc_tv)                 # [U, CT]
+        share_c = ((gd.ipa_stc_tv == tvb_c[:, :, None])
+                   & (tvb_c[:, :, None] != 0))
+        d_cons = jnp.sum(gd.w_stc[u][:, :, None] * share_c, axis=1)  # [U, N]
+        tvb_p = pick(gd.ipa_stp_tv)[u]              # [PT]
+        share_p = (gd.ipa_stp_tv[u] == tvb_p[:, None]) & (tvb_p[:, None] != 0)
+        d_plcd = jnp.sum(gd.w_stp[u][:, :, None] * share_p[None], axis=1)
+        ipa_score = gc.ipa_score + gate.astype(jnp.int64) * (d_cons + d_plcd)
 
     return GroupCarry(spr_f_cnt=spr_f_cnt, spr_f_min_zero=gc.spr_f_min_zero,
                       spr_s_cnt=spr_s_cnt, ipa_veto=ipa_veto,
@@ -721,6 +792,23 @@ class GroupManager:
         return out
 
     # -- assembly -------------------------------------------------------------
+
+    def families(self, snapshot) -> GroupFamilies:
+        """Host-side activation analysis (no device readbacks): a family is
+        active when some signature row carries it, or — for the symmetric
+        inter-pod families — when existing cluster pods seed its counts."""
+        return GroupFamilies(
+            spr_f=bool(self.spr_f_active.any()),
+            spr_s=bool(self.spr_s_active.any()),
+            ipa_req=bool(self.ipa_ra_active.any()),
+            ipa_anti=bool(
+                self.ipa_raa_active.any() or self.m_ipa_exist.any()
+                or snapshot.have_pods_with_required_anti_affinity_list),
+            ipa_score=bool(
+                self.w_stc.any() or self.w_stp.any()
+                or snapshot.have_pods_with_affinity_list
+                or snapshot.have_pods_with_required_anti_affinity_list),
+        )
 
     def build_dev(self, snapshot) -> "tuple[GroupsDev, GroupCarry]":
         """Full (GroupsDev, GroupCarry) numpy build for all rows."""

@@ -36,8 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.groups import (GroupCarry, GroupsDev, group_mask, group_scores,
-                          group_update)
+from ..ops.groups import (GroupCarry, GroupFamilies, GroupsDev, group_mask,
+                          group_scores, group_update)
 from ..state.batch import (OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN,
                            OP_LT, OP_NOT_IN, TOL_EQUAL, TOL_EXISTS)
 from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
@@ -330,13 +330,17 @@ def _gather_row(table: PodTableDev, x) -> PodRow:
     return PodRow(valid=x.valid, sig=x.sig, **fields)
 
 
+def table_from_batch(batch) -> PodTableDev:
+    """PodBatch → device signature table."""
+    return PodTableDev(*(jnp.asarray(getattr(batch.table, f))
+                         for f in PodTableDev._fields))
+
+
 def pod_rows_from_batch(batch) -> tuple[PodXs, PodTableDev]:
     """PodBatch → (per-pod xs, device signature table)."""
     xs = PodXs(valid=jnp.asarray(batch.valid), sig=jnp.asarray(batch.sig),
                tidx=jnp.asarray(batch.tidx))
-    table = PodTableDev(*(jnp.asarray(getattr(batch.table, f))
-                          for f in PodTableDev._fields))
-    return xs, table
+    return xs, table_from_batch(batch)
 
 
 def _fit_scores(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
@@ -407,7 +411,8 @@ def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
 
 def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
               axis: str | None = None, groups: GroupsDev | None = None,
-              tidx=None, n_global: int | None = None):
+              tidx=None, n_global: int | None = None,
+              fam: GroupFamilies | None = None):
     """Feasibility + total score for one pod over all nodes → (mask, score,
     parts). Consults the signature cache: a pod whose sig matches the carry's
     reuses every carry-independent kernel (the expensive ones). Group kernels
@@ -428,7 +433,8 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
         # fold in BEFORE normalization: the host runtime normalizes over the
         # fully-filtered node list, so a group-filtered node must not set the
         # normalization max (runtime/framework.go:1286-1390 semantics)
-        feasible &= group_mask(groups, carry.groups, tidx, axis=axis)
+        feasible &= group_mask(groups, carry.groups, tidx, axis=axis,
+                               fam=fam)
     s_taint = default_normalize(taint_raw, feasible, reverse=True, axis=axis)
     s_na = default_normalize(na_raw, feasible, reverse=False, axis=axis)
     total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
@@ -436,7 +442,7 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     if groups is not None:
         total = total + group_scores(cfg.w_spread, cfg.w_ipa, groups,
                                      carry.groups, tidx, feasible,
-                                     axis=axis, n_global=n_global)
+                                     axis=axis, n_global=n_global, fam=fam)
     parts = SigCache(sig=pod.sig, static_mask=m, taint_raw=taint_raw,
                      na_raw=na_raw, fit_ok=fit_ok, s_fit=s_fit, s_bal=s_bal)
     return feasible, total, parts
@@ -465,21 +471,25 @@ def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
                           ports=ports)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg", "fam"))
 def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
-              table: PodTableDev, groups: GroupsDev | None = None):
+              table: PodTableDev, groups: GroupsDev | None = None,
+              fam: GroupFamilies | None = None):
     """Scan the batch; returns (final carry, assignments int32[B] (-1 = none)).
 
     `groups` (with `carry.groups`) enables the PodTopologySpread /
     InterPodAffinity kernels; pass None (and carry.groups None) for the lean
-    program — the two compile to distinct executables."""
+    program — the two compile to distinct executables. `fam` (static)
+    trims the group kernels to the active constraint families — a
+    spread-only batch compiles a program with zero inter-pod-affinity
+    compute (≈5-8× per step on TPU); see groups.GroupFamilies."""
 
     n = na.npods.shape[0]
 
     def step(c: Carry, x: PodXs):
         pod = _gather_row(table, x)
         mask, score, parts = _eval_pod(cfg, na, c, pod, groups=groups,
-                                       tidx=x.tidx)
+                                       tidx=x.tidx, fam=fam)
         masked = jnp.where(mask, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)
         assigned = (masked[best] >= 0) & pod.valid
@@ -491,7 +501,7 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
                 groups, c2.groups, x.tidx,
                 pick=lambda arr: arr[..., best],
                 is_chosen=jnp.arange(n, dtype=jnp.int32) == best,
-                gate=assigned))
+                gate=assigned, fam=fam))
         return c2, jnp.where(assigned, best, -1)
 
     final, assignments = lax.scan(step, carry, pods)
@@ -598,13 +608,18 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
             col_ok, jnp.minimum(used_pl / jnp.maximum(cap_c, 1), 1.0), 0.0))
         bal_cols_ok.append(col_ok)
     s_fit_kj = jnp.where(w_sum > 0, score_sum // jnp.maximum(w_sum, 1), 0)
-    # same float-op sequence as balanced_allocation() so results are
-    # bit-identical to the scan's (an |f0−f1|/2 shortcut could differ by an
-    # ulp at floor boundaries and break assignment parity)
-    cnt = sum(ok_.astype(jnp.int32) for ok_ in bal_cols_ok)
-    mean = sum(fracs) / jnp.maximum(cnt, 1)
-    var = sum(jnp.where(ok_, (f - mean) ** 2, 0.0)
-              for f, ok_ in zip(fracs, bal_cols_ok)) / jnp.maximum(cnt, 1)
+    # same float-op structure as balanced_allocation() — stacked jnp.sum
+    # reductions over the column axis, not a sequential Python sum chain —
+    # so XLA lowers the same associativity and results stay bit-identical
+    # to the scan's (an |f0−f1|/2 shortcut, or a different reduction order,
+    # could differ by an ulp at floor boundaries and break parity)
+    frac_kjc = jnp.stack(fracs, axis=-1)                 # [K, J, C]
+    ok_kjc = jnp.stack(bal_cols_ok, axis=-1) & jnp.ones(
+        frac_kjc.shape, bool)
+    cnt = jnp.sum(ok_kjc, axis=-1)
+    mean = jnp.sum(frac_kjc, axis=-1) / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(ok_kjc, (frac_kjc - mean[..., None]) ** 2, 0.0),
+                  axis=-1) / jnp.maximum(cnt, 1)
     std = jnp.sqrt(var)
     s_bal_kj = jnp.where(
         pod.skip_balanced, 0,

@@ -38,7 +38,7 @@ from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
 from .ops.program import (PodXs, ScoreConfig, initial_carry,
-                          pod_rows_from_batch, run_batch, run_uniform)
+                          run_batch, run_uniform, table_from_batch)
 from .plugins import noderesources as nr
 from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
                                   PrioritySort, SchedulingGates,
@@ -145,6 +145,7 @@ class Scheduler:
         self._device_carry = None
         # group (spread / inter-pod affinity) device state lifecycle
         self._gd_dev = None          # GroupsDev (jnp) for the current carry
+        self._gd_fam = None          # static active-family mask (jit key)
         self._gd_capacity = None     # (table_rows, node_bucket) it was built for
         self._seeded_rows = 0        # signature rows whose counts are seeded
 
@@ -317,9 +318,11 @@ class Scheduler:
             if groups_needed:
                 gd_np, gc_np = self.builder.groups.build_dev(self.snapshot)
                 self._gd_dev = to_device(gd_np)
+                self._gd_fam = self.builder.groups.families(self.snapshot)
                 gcarry = to_device(gc_np)
             else:
                 self._gd_dev = None
+                self._gd_fam = None
             self._gd_capacity = capacity
             self._seeded_rows = self.builder.table_used
             carry = initial_carry(na, gcarry)
@@ -330,11 +333,12 @@ class Scheduler:
             self._gd_dev, gcarry = scatter_new_rows(
                 self._gd_dev, carry.groups, self.builder.groups,
                 self.snapshot, self._seeded_rows, self.builder.table_used)
+            self._gd_fam = self.builder.groups.families(self.snapshot)
             carry = carry._replace(groups=gcarry)
             self._seeded_rows = self.builder.table_used
-        xs, table = pod_rows_from_batch(segment_batch)
+        table = table_from_batch(segment_batch)
         carry, assignments = self._run_device_program(
-            profile.score_config, na, carry, segment_batch, xs, table,
+            profile.score_config, na, carry, segment_batch, table,
             len(qpis), groups_needed)
         # the carry stays device-resident: the only readback per batch is the
         # assignment vector
@@ -384,7 +388,7 @@ class Scheduler:
             i = j
         return runs
 
-    def _run_device_program(self, cfg: ScoreConfig, na, carry, batch, xs,
+    def _run_device_program(self, cfg: ScoreConfig, na, carry, batch,
                             table, n: int, groups_needed: bool):
         """Route the drain through the fastest exact program — and through
         the FEWEST device↔host round trips, which on a tunneled TPU
@@ -405,9 +409,13 @@ class Scheduler:
         fast_ok = (not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
         if not fast_ok:
-            carry, assignments = run_batch(cfg, na, carry, xs, table,
-                                           groups=self._gd_dev)
+            # pow2-bucketed scan: a residual drain must not pay the full
+            # standing-batch step count (the group program costs ~ms/step)
+            carry, assignments = self._scan_dispatch(cfg, na, carry, batch,
+                                                     0, n, table)
             return carry, np.asarray(assignments)[:n]
+        # (the fast path builds per-segment PodXs in _scan_dispatch /
+        # run_uniform; only the signature table ships whole)
         runs = self._classify_runs(batch, n)
         out = np.full((n,), -1, np.int32)
         n_nodes = max(len(self.snapshot.node_info_list), 1)
@@ -507,7 +515,8 @@ class Scheduler:
         tidx = np.full((bucket,), batch.tidx[j - 1], np.int32)
         tidx[:m] = batch.tidx[i:j]
         xs = PodXs(valid=valid, sig=sig, tidx=tidx)
-        return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev)
+        return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev,
+                         fam=self._gd_fam)
 
     def reconcile(self) -> list:
         """Debug/divergence check (cache debugger analog): pull the resident
