@@ -305,6 +305,8 @@ class PodSpec:
     scheduling_gates: list[PodSchedulingGate] = field(default_factory=list)
     overhead: dict[str, int] = field(default_factory=dict)
     host_network: bool = False
+    # PreemptLowerPriority (default) | Never (core/v1 PreemptionPolicy)
+    preemption_policy: str = "PreemptLowerPriority"
     # gang scheduling: name of the Workload/pod-group this pod belongs to
     # (reference: scheduling/v1alpha1.Workload via pod labels; we model it as
     # a direct field + the label fallback used by workloadmanager).

@@ -113,15 +113,18 @@ class APIServer:
                 h.on_update(old, new)
 
     def patch_pod_status(self, pod: Pod, condition: dict,
-                         nominated_node_name: str = "") -> None:
+                         nominated_node_name=None) -> None:
+        """nominated_node_name: None = leave unchanged, "" = clear (the
+        preemption demotion patch), otherwise set."""
         current = self.pods.get(pod.uid)
         if current is None:
             raise NotFound(pod.uid)
-        conditions = [c for c in current.status.conditions
-                      if c.get("type") != condition.get("type")]
-        conditions.append(condition)
-        current.status.conditions = conditions
-        if nominated_node_name:
+        if condition:
+            conditions = [c for c in current.status.conditions
+                          if c.get("type") != condition.get("type")]
+            conditions.append(condition)
+            current.status.conditions = conditions
+        if nominated_node_name is not None:
             current.status.nominated_node_name = nominated_node_name
 
     # -- nodes ----------------------------------------------------------------

@@ -392,16 +392,7 @@ class Cache:
 
 def _snapshot_node_info(info: NodeInfo) -> NodeInfo:
     """NodeInfo.Snapshot(): structural copy sharing immutable PodInfos."""
-    clone = NodeInfo(node=info.node, generation=info.generation)
-    clone.pods = list(info.pods)
-    clone.pods_with_affinity = list(info.pods_with_affinity)
-    clone.pods_with_required_anti_affinity = list(info.pods_with_required_anti_affinity)
-    clone.requested = dict(info.requested)
-    clone.non_zero_cpu = info.non_zero_cpu
-    clone.non_zero_mem = info.non_zero_mem
-    clone.used_ports.ports = set(info.used_ports.ports)
-    clone.image_sizes = dict(info.image_sizes)
-    return clone
+    return info.snapshot_clone()
 
 
 def _zone_of(node: Node) -> str:

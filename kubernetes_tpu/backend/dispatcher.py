@@ -28,11 +28,13 @@ from ..api.types import Pod
 class CallType(str, enum.Enum):
     BIND = "pod_binding"
     STATUS_PATCH = "pod_status_patch"
+    DELETE = "pod_delete"
 
 
 # relevance ordering (api_calls.go Relevances): a BIND replaces a pending
-# STATUS_PATCH for the same pod; a STATUS_PATCH never replaces a BIND.
-_RELEVANCE = {CallType.STATUS_PATCH: 1, CallType.BIND: 2}
+# STATUS_PATCH for the same pod; a STATUS_PATCH never replaces a BIND; a
+# DELETE (preemption victim) supersedes everything for that pod.
+_RELEVANCE = {CallType.STATUS_PATCH: 1, CallType.BIND: 2, CallType.DELETE: 3}
 
 
 @dataclass
@@ -41,7 +43,8 @@ class APICall:
     pod: Pod
     node_name: str = ""
     condition: Optional[dict] = None
-    nominated_node_name: str = ""
+    # None = leave unchanged; "" = clear (preemption demotion)
+    nominated_node_name: Optional[str] = None
 
 
 @dataclass
@@ -68,6 +71,8 @@ class APIDispatcher:
             try:
                 if call.call_type == CallType.BIND:
                     self.client.bind(call.pod, call.node_name)
+                elif call.call_type == CallType.DELETE:
+                    self.client.delete_pod(call.pod.uid)
                 else:
                     self.client.patch_pod_status(
                         call.pod, call.condition or {},
@@ -79,6 +84,12 @@ class APIDispatcher:
                         and self.on_bind_error is not None):
                     self.on_bind_error(call.pod, call.node_name, e)
         return len(calls)
+
+    def is_delete_pending(self, uid: str) -> bool:
+        """A victim whose DELETE is queued but not flushed is the in-memory
+        analog of a terminating pod (preemption.go:431 eligibility)."""
+        pending = self._queue.get(uid)
+        return pending is not None and pending.call_type == CallType.DELETE
 
     def __len__(self) -> int:
         return len(self._queue)

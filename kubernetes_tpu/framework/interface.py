@@ -92,8 +92,13 @@ class CycleState:
         return self._data.get(key)
 
     def clone(self) -> "CycleState":
+        """cycle_state.go Clone: plugin state objects that implement
+        clone() are deep-copied (StateData.Clone in the reference) so
+        AddPod/RemovePod simulations on the clone never leak into the
+        original; immutable values are shared."""
         cs = CycleState()
-        cs._data = dict(self._data)
+        cs._data = {k: (v.clone() if hasattr(v, "clone") else v)
+                    for k, v in self._data.items()}
         cs.skip_filter_plugins = set(self.skip_filter_plugins)
         cs.skip_score_plugins = set(self.skip_score_plugins)
         return cs

@@ -1,0 +1,243 @@
+"""Preemption Evaluator: the PostFilter path.
+
+Mirrors pkg/scheduler/framework/preemption/preemption.go:
+- `Evaluator.preempt` (:268) — eligibility → candidates → pick → prepare.
+- `pod_eligible_to_preempt_others` (:431) — preemptionPolicy Never, and the
+  nominated-node "victim already terminating" check. Our in-memory API
+  server deletes synchronously (no graceful termination window), so the
+  terminating-victim branch can only observe pending DELETE calls still
+  sitting in the dispatcher queue.
+- `dry_run_preemption` (:775) / `select_victims_on_node`
+  (plugins/defaultpreemption/default_preemption.go:583) — remove all
+  lower-priority pods, check fit with nominated pods, then reprieve victims
+  most-important-first.
+- `pick_one_node` (:658) — the 5-step ordering. We have no
+  PodDisruptionBudget objects yet, so every candidate has zero PDB
+  violations and step 1 never discriminates; victim start times map to
+  `creation_index` (latest-started = highest index).
+- `prepare_candidate` (:180) — victim deletes via the API dispatcher +
+  clearing lower-priority nominations on the node; the caller publishes
+  NominatedNodeName.
+
+Candidate count follows default_preemption.go:174 GetOffsetAndNumCandidates
+with a deterministic offset of 0 (the reference randomizes only for
+inter-scheduler fairness; determinism keeps decisions reproducible and is a
+legal instance of the randomized choice).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import Pod
+from .interface import Code, CycleState, Status
+from .types import Diagnosis, NodeInfo, PodInfo
+
+
+@dataclass
+class Candidate:
+    """preemption.go:60 candidate: victims + the node."""
+
+    node_name: str
+    victims: list[PodInfo] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+
+class Evaluator:
+    """preemption.go:100 — drives one preemption attempt for one pod."""
+
+    def __init__(self, framework, nominator=None,
+                 min_candidate_nodes_percentage: int = 10,
+                 min_candidate_nodes_absolute: int = 100,
+                 is_delete_pending: Optional[Callable[[str], bool]] = None):
+        self.fwk = framework
+        self.nominator = nominator
+        self.min_pct = min_candidate_nodes_percentage
+        self.min_abs = min_candidate_nodes_absolute
+        self._is_delete_pending = is_delete_pending or (lambda uid: False)
+
+    # -- entry (preemption.go:268 Preempt) ------------------------------------
+
+    def preempt(self, state: CycleState, pod: Pod,
+                nodes: list[NodeInfo], diagnosis: Diagnosis
+                ) -> tuple[Optional[Candidate], Status]:
+        if not self.pod_eligible_to_preempt_others(pod, nodes):
+            return None, Status.unschedulable(
+                "pod is not eligible for preemption",
+                plugin="DefaultPreemption")
+        potential = self.nodes_where_preemption_might_help(nodes, diagnosis)
+        if not potential:
+            return None, Status.unschedulable(
+                "preemption will not help scheduling",
+                plugin="DefaultPreemption")
+        num = self.get_num_candidates(len(potential))
+        candidates = self.dry_run_preemption(state, pod, potential, num,
+                                             all_nodes=nodes)
+        if not candidates:
+            return None, Status.unschedulable(
+                "no preemption victims found for incoming pod",
+                plugin="DefaultPreemption")
+        best = self.pick_one_node(candidates)
+        return best, Status.success()
+
+    # -- eligibility (preemption.go:431) ---------------------------------------
+
+    def pod_eligible_to_preempt_others(self, pod: Pod,
+                                       nodes: list[NodeInfo]) -> bool:
+        if pod.spec.preemption_policy == "Never":
+            return False
+        nominated = pod.status.nominated_node_name
+        if nominated:
+            # a lower-priority victim already terminating on the nominated
+            # node means preemption is in flight — don't preempt again
+            ni = next((n for n in nodes if n.name == nominated), None)
+            if ni is not None:
+                for pi in ni.pods:
+                    if (pi.pod.spec.priority < pod.spec.priority
+                            and self._is_delete_pending(pi.pod.uid)):
+                        return False
+        return True
+
+    # -- candidate universe (preemption.go:291) --------------------------------
+
+    @staticmethod
+    def nodes_where_preemption_might_help(nodes: list[NodeInfo],
+                                          diagnosis: Diagnosis
+                                          ) -> list[NodeInfo]:
+        """Nodes that failed resolvably. A node absent from node_to_status
+        (the device path reports only global infeasibility) is assumed
+        resolvable."""
+        out = []
+        for ni in nodes:
+            st = diagnosis.node_to_status.get(ni.name)
+            if st is not None and st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue
+            out.append(ni)
+        return out
+
+    def get_num_candidates(self, num_nodes: int) -> int:
+        """default_preemption.go:174 GetOffsetAndNumCandidates."""
+        n = num_nodes * self.min_pct // 100
+        n = max(n, self.min_abs)
+        return min(n, num_nodes)
+
+    # -- dry run (preemption.go:775) -------------------------------------------
+
+    def dry_run_preemption(self, state: CycleState, pod: Pod,
+                           nodes: list[NodeInfo], num_candidates: int,
+                           all_nodes: Optional[list[NodeInfo]] = None
+                           ) -> list[Candidate]:
+        """`nodes` are the preemption candidates; `all_nodes` the FULL
+        snapshot list — PreFilter state (spread counts etc.) must be seeded
+        over every node exactly like a real scheduling cycle, not over the
+        resolvable subset."""
+        candidates: list[Candidate] = []
+        for ni in nodes:
+            victims, pdb_violations, ok = self.select_victims_on_node(
+                pod, ni, all_nodes=all_nodes or nodes)
+            if ok:
+                candidates.append(Candidate(
+                    node_name=ni.name, victims=victims,
+                    num_pdb_violations=pdb_violations))
+                if len(candidates) >= num_candidates:
+                    break
+        return candidates
+
+    def select_victims_on_node(self, pod: Pod, node_info: NodeInfo,
+                               all_nodes: list[NodeInfo]
+                               ) -> tuple[list[PodInfo], int, bool]:
+        """default_preemption.go:583. Returns (victims, pdbViolations, fits).
+
+        Simulation runs on a structural copy of the NodeInfo and a FRESH
+        CycleState re-seeded by PreFilter (the reference clones CycleState;
+        re-running PreFilter yields the same plugin state without requiring
+        every plugin's state object to implement Clone). The cheap
+        potential-victims check runs FIRST so nodes with nothing to preempt
+        — the common case when a full cluster rejects a default-priority
+        pod — cost no PreFilter work."""
+        potential = [pi for pi in node_info.pods
+                     if pi.pod.spec.priority < pod.spec.priority]
+        if not potential:
+            return [], 0, False
+        # the clone shares the immutable PodInfo objects: `potential` stays
+        # valid against it
+        ni = node_info.snapshot_clone()
+        state = CycleState()
+        _, status = self.fwk.run_pre_filter_plugins(state, pod, all_nodes)
+        if not status.is_success():
+            return [], 0, False
+        for pi in potential:
+            self._remove_pod(state, pod, pi, ni)
+        # preemptor must fit with ALL lower-priority pods gone
+        if not self._fits(state, pod, ni):
+            return [], 0, False
+        # reprieve pods most-important-first (util.MoreImportantPod:
+        # priority desc, then earlier start via creation_index) while the
+        # preemptor still fits (no PDBs yet: the violating-first partition
+        # is empty)
+        victims: list[PodInfo] = []
+        potential.sort(key=lambda pi: (-pi.pod.spec.priority,
+                                       pi.pod.metadata.creation_index))
+        for pi in potential:
+            self._add_pod(state, pod, pi, ni)
+            if not self._fits(state, pod, ni):
+                self._remove_pod(state, pod, pi, ni)
+                victims.append(pi)
+        return victims, 0, True
+
+    def _fits(self, state: CycleState, pod: Pod, ni: NodeInfo) -> bool:
+        status = self.fwk.run_filter_plugins_with_nominated_pods(
+            state, pod, ni, self.nominator)
+        return status.is_success()
+
+    def _remove_pod(self, state: CycleState, pod: Pod, pi: PodInfo,
+                    ni: NodeInfo) -> None:
+        ni.remove_pod(pi)
+        self.fwk.run_pre_filter_extensions_remove_pod(state, pod, pi, ni)
+
+    def _add_pod(self, state: CycleState, pod: Pod, pi: PodInfo,
+                 ni: NodeInfo) -> None:
+        ni.add_pod(pi)
+        self.fwk.run_pre_filter_extensions_add_pod(state, pod, pi, ni)
+
+    # -- pick (preemption.go:658 pickOneNodeForPreemption) ---------------------
+
+    @staticmethod
+    def pick_one_node(candidates: list[Candidate]) -> Candidate:
+        best = candidates
+        # 1. fewest PDB violations
+        m = min(c.num_pdb_violations for c in best)
+        best = [c for c in best if c.num_pdb_violations == m]
+        if len(best) == 1:
+            return best[0]
+        # a node with no victims at all wins outright (preemption.go:672)
+        for c in best:
+            if not c.victims:
+                return c
+        # 2. lowest highest-victim priority
+        m = min(max(pi.pod.spec.priority for pi in c.victims) for c in best)
+        best = [c for c in best
+                if max(pi.pod.spec.priority for pi in c.victims) == m]
+        if len(best) == 1:
+            return best[0]
+        # 3. smallest sum of victim priorities
+        m = min(sum(pi.pod.spec.priority for pi in c.victims) for c in best)
+        best = [c for c in best
+                if sum(pi.pod.spec.priority for pi in c.victims) == m]
+        if len(best) == 1:
+            return best[0]
+        # 4. fewest victims
+        m = min(len(c.victims) for c in best)
+        best = [c for c in best if len(c.victims) == m]
+        if len(best) == 1:
+            return best[0]
+        # 5. latest start time of the highest-priority victim → prefer the
+        # node whose top victim started most recently (creation_index max)
+        def top_victim_start(c: Candidate) -> int:
+            top = max(c.victims, key=lambda pi: (pi.pod.spec.priority,
+                                                 -pi.pod.metadata.creation_index))
+            return top.pod.metadata.creation_index
+        m = max(top_victim_start(c) for c in best)
+        best = [c for c in best if top_victim_start(c) == m]
+        return best[0]

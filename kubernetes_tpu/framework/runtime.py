@@ -116,13 +116,18 @@ class Framework:
     def find_nodes_that_pass_filters(self, state: CycleState, pod: Pod,
                                      nodes: list[NodeInfo],
                                      pre_result: Optional[PreFilterResult],
-                                     diagnosis: Diagnosis) -> list[NodeInfo]:
+                                     diagnosis: Diagnosis,
+                                     nominator=None) -> list[NodeInfo]:
         feasible = []
         allowed = pre_result.node_names if pre_result and not pre_result.all_nodes() else None
         for ni in nodes:
             if allowed is not None and ni.name not in allowed:
                 continue
-            status = self.run_filter_plugins(state, pod, ni)
+            if nominator is not None:
+                status = self.run_filter_plugins_with_nominated_pods(
+                    state, pod, ni, nominator)
+            else:
+                status = self.run_filter_plugins(state, pod, ni)
             if status.is_success():
                 feasible.append(ni)
             else:
@@ -130,6 +135,75 @@ class Framework:
                 if status.plugin:
                     diagnosis.unschedulable_plugins.add(status.plugin)
         return feasible
+
+    def run_filter_plugins_with_nominated_pods(self, state: CycleState,
+                                               pod: Pod, node_info: NodeInfo,
+                                               nominator=None) -> Status:
+        """runtime/framework.go:1158-1231 — two-pass filter: first WITH all
+        higher-or-equal-priority pods nominated onto this node (their
+        resources assumed occupied via the AddPod extensions on a NodeInfo
+        copy), then, only if nominated pods existed, again WITHOUT them.
+        Both passes must succeed."""
+        nominated = (nominator.pods_for_node(node_info.name)
+                     if nominator is not None else [])
+        relevant = [q for q in nominated
+                    if q.pod.spec.priority >= pod.spec.priority
+                    and q.pod.uid != pod.uid]
+        if relevant:
+            ni = node_info.snapshot_clone()
+            state_w = state.clone()
+            for q in relevant:
+                pi = q.pod_info
+                ni.add_pod(pi)
+                self.run_pre_filter_extensions_add_pod(state_w, pod, pi, ni)
+            status = self.run_filter_plugins(state_w, pod, ni)
+            if not status.is_success():
+                return status
+        return self.run_filter_plugins(state, pod, node_info)
+
+    # -- PreFilterExtensions (preemption dry-run support) ---------------------
+
+    def run_pre_filter_extensions_add_pod(self, state: CycleState, pod: Pod,
+                                          pi, node_info: NodeInfo) -> Status:
+        for p in self.pre_filter_plugins:
+            if p.name() in state.skip_filter_plugins:
+                continue
+            if hasattr(p, "add_pod"):
+                status = p.add_pod(state, pod, pi, node_info)
+                if not status.is_success():
+                    return status
+        return Status.success()
+
+    def run_pre_filter_extensions_remove_pod(self, state: CycleState,
+                                             pod: Pod, pi,
+                                             node_info: NodeInfo) -> Status:
+        for p in self.pre_filter_plugins:
+            if p.name() in state.skip_filter_plugins:
+                continue
+            if hasattr(p, "remove_pod"):
+                status = p.remove_pod(state, pod, pi, node_info)
+                if not status.is_success():
+                    return status
+        return Status.success()
+
+    # -- PostFilter (runtime/framework.go:1068) --------------------------------
+
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod,
+                                filtered_node_status_map
+                                ) -> tuple[Optional[str], Status]:
+        """Returns (nominated node name | None, status). First plugin that
+        succeeds (or errors) short-circuits; Unschedulable statuses merge."""
+        statuses = []
+        for p in self.post_filter_plugins:
+            result, status = p.post_filter(state, pod,
+                                           filtered_node_status_map)
+            if status.is_success():
+                return result, status
+            if status.code == Code.ERROR:
+                return None, status
+            statuses.append(status)
+        reasons = tuple(r for s in statuses for r in s.reasons)
+        return None, Status.unschedulable(*reasons)
 
     # -- Score (three phases, reference runtime:1286-1390) -------------------
 
@@ -236,7 +310,7 @@ class ScheduleResult:
 
 
 def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
-                 nodes: list[NodeInfo]) -> ScheduleResult:
+                 nodes: list[NodeInfo], nominator=None) -> ScheduleResult:
     if not nodes:
         raise FitError(pod, 0)
     diagnosis = Diagnosis()
@@ -249,7 +323,8 @@ def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
             raise FitError(pod, len(nodes), diagnosis)
         raise RuntimeError(f"prefilter error: {status.reasons}")
 
-    feasible = fwk.find_nodes_that_pass_filters(state, pod, nodes, pre_result, diagnosis)
+    feasible = fwk.find_nodes_that_pass_filters(state, pod, nodes, pre_result,
+                                                diagnosis, nominator=nominator)
     if not feasible:
         raise FitError(pod, len(nodes), diagnosis)
     if len(feasible) == 1:

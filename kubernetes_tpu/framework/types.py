@@ -194,6 +194,21 @@ class NodeInfo:
     def bump(self) -> None:
         self.generation = next_generation()
 
+    def snapshot_clone(self) -> "NodeInfo":
+        """NodeInfo.Snapshot(): structural copy sharing immutable PodInfos
+        (types.go Snapshot) — mutation-safe for preemption dry runs."""
+        clone = NodeInfo(node=self.node, generation=self.generation)
+        clone.pods = list(self.pods)
+        clone.pods_with_affinity = list(self.pods_with_affinity)
+        clone.pods_with_required_anti_affinity = list(
+            self.pods_with_required_anti_affinity)
+        clone.requested = dict(self.requested)
+        clone.non_zero_cpu = self.non_zero_cpu
+        clone.non_zero_mem = self.non_zero_mem
+        clone.used_ports.ports = set(self.used_ports.ports)
+        clone.image_sizes = dict(self.image_sizes)
+        return clone
+
     # -- pod add/remove (reference types.go AddPodInfo/RemovePod) ------------
 
     def add_pod(self, pi: PodInfo) -> None:

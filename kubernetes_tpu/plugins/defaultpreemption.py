@@ -1,0 +1,78 @@
+"""DefaultPreemption: the PostFilter plugin.
+
+Mirrors pkg/scheduler/framework/plugins/defaultpreemption/
+default_preemption.go:
+- `PostFilter` (:107) delegates to the preemption Evaluator and converts
+  its result to a nominated node name.
+- candidate sizing (:174) lives in the Evaluator.
+- victim deletion + nomination publication happen in `prepare` here (the
+  reference's Evaluator.prepareCandidate, preemption.go:180): victims go to
+  the API dispatcher as DELETE calls, and lower-priority pods nominated on
+  the chosen node lose their nomination (preemption.go:210).
+
+The plugin is constructed by the Scheduler with live handles (dispatcher,
+nominator) — the reference wires the same dependencies through
+frameworkImpl."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.types import Pod
+from ..framework.interface import CycleState, Status
+from ..framework.preemption import Evaluator
+
+
+class DefaultPreemption:
+    def __init__(self, dispatcher=None, nominator=None, snapshot=None):
+        self.dispatcher = dispatcher
+        self.nominator = nominator
+        self.snapshot = snapshot
+        self._evaluator: Optional[Evaluator] = None
+        self._fwk = None
+
+    def name(self) -> str:
+        return "DefaultPreemption"
+
+    def set_framework(self, fwk) -> None:
+        """Called by the Scheduler after the Framework exists (the Evaluator
+        needs the full plugin set for its dry-run filters)."""
+        self._fwk = fwk
+        self._evaluator = Evaluator(
+            fwk, nominator=self.nominator,
+            is_delete_pending=(self.dispatcher.is_delete_pending
+                               if self.dispatcher is not None else None))
+
+    def post_filter(self, state: CycleState, pod: Pod,
+                    filtered_node_status_map) -> tuple[Optional[str], Status]:
+        """default_preemption.go:107 → (nominated node name, status)."""
+        if self._evaluator is None or self.snapshot is None:
+            return None, Status.unschedulable("preemption not wired",
+                                              plugin=self.name())
+        from ..framework.types import Diagnosis
+        diagnosis = Diagnosis(node_to_status=dict(filtered_node_status_map))
+        nodes = self.snapshot.node_info_list
+        candidate, status = self._evaluator.preempt(state, pod, nodes,
+                                                    diagnosis)
+        if not status.is_success() or candidate is None:
+            return None, status
+        self._prepare(pod, candidate)
+        return candidate.node_name, Status.success()
+
+    def _prepare(self, pod: Pod, candidate) -> None:
+        """preemption.go:180 prepareCandidate: delete victims, demote
+        lower-priority nominations on the node."""
+        from ..backend.dispatcher import APICall, CallType
+        for pi in candidate.victims:
+            self.dispatcher.add(APICall(CallType.DELETE, pi.pod))
+        if self.nominator is not None:
+            for q in self.nominator.pods_for_node(candidate.node_name):
+                if q.pod.spec.priority < pod.spec.priority:
+                    self.nominator.delete(q.pod)
+                    # clear the live object too: Nominator.add falls back to
+                    # pod.status.nominated_node_name on requeue and must not
+                    # resurrect the demoted nomination
+                    q.pod.status.nominated_node_name = ""
+                    self.dispatcher.add(APICall(
+                        CallType.STATUS_PATCH, q.pod,
+                        condition={}, nominated_node_name=""))

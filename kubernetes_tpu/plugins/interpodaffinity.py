@@ -136,6 +136,18 @@ class _PreFilterState:
     pod: Optional[Pod] = None
     namespace_labels: dict[str, str] = field(default_factory=dict)
 
+    def clone(self) -> "_PreFilterState":
+        """filtering.go preFilterState.Clone() — count maps copied,
+        parsed terms shared (immutable)."""
+        return _PreFilterState(
+            existing_anti_affinity_counts=dict(self.existing_anti_affinity_counts),
+            affinity_counts=dict(self.affinity_counts),
+            anti_affinity_counts=dict(self.anti_affinity_counts),
+            req_affinity_terms=self.req_affinity_terms,
+            req_anti_affinity_terms=self.req_anti_affinity_terms,
+            pod=self.pod,
+            namespace_labels=self.namespace_labels)
+
 
 def _update_counts(counts: dict[tuple[str, str], int], node_labels: dict[str, str],
                    tk: str, value: int) -> None:

@@ -152,6 +152,11 @@ class _CriticalPaths:
         self.v0, self.n0 = None, _MAX_INT32
         self.v1, self.n1 = None, _MAX_INT32
 
+    def copy(self) -> "_CriticalPaths":
+        cp = _CriticalPaths()
+        cp.v0, cp.n0, cp.v1, cp.n1 = self.v0, self.n0, self.v1, self.n1
+        return cp
+
     def update(self, tp_val: str, num: int) -> None:
         if tp_val == self.v0:
             self.n0 = num
@@ -176,6 +181,14 @@ class _PreFilterState:
     constraints: list[_Constraint] = field(default_factory=list)
     critical_paths: list[_CriticalPaths] = field(default_factory=list)
     tp_value_to_match_num: list[dict[str, int]] = field(default_factory=list)
+
+    def clone(self) -> "_PreFilterState":
+        """filtering.go preFilterState.Clone() — mutable counts copied,
+        parsed constraints shared (immutable)."""
+        return _PreFilterState(
+            constraints=self.constraints,
+            critical_paths=[cp.copy() for cp in self.critical_paths],
+            tp_value_to_match_num=[dict(d) for d in self.tp_value_to_match_num])
 
     def min_match_num(self, i: int, min_domains: int) -> int:
         """filtering.go:66-77 — fewer eligible domains than minDomains ⇒

@@ -126,6 +126,24 @@ class Scheduler:
             queueing_hints=self._build_queueing_hints(default_fwk),
             clock=clock)
 
+        # wire preemption (PostFilter) into every profile: the Evaluator
+        # needs live handles (dispatcher, nominator, snapshot) that exist
+        # only now — the reference threads the same deps through
+        # frameworkImpl (default_preemption.go New)
+        from .plugins.defaultpreemption import DefaultPreemption
+        for prof in self.profiles.values():
+            fwk = prof.framework
+            dp = next((p for p in fwk.plugins
+                       if isinstance(p, DefaultPreemption)), None)
+            if dp is None:
+                dp = DefaultPreemption()
+                fwk.plugins.append(dp)
+                fwk.post_filter_plugins.append(dp)
+            dp.dispatcher = self.dispatcher
+            dp.nominator = self.queue.nominator
+            dp.snapshot = self.snapshot
+            dp.set_framework(fwk)
+
         self._register_event_handlers()
         # stats (metrics/metrics.go essentials; full registry in metrics/)
         self.schedule_attempts = 0
@@ -134,6 +152,7 @@ class Scheduler:
         self.error_count = 0
         self.device_batches = 0
         self.host_scheduled = 0
+        self.preemption_attempts = 0
         # per-pod consecutive bind-error count → escalating error backoff
         self._bind_errors: dict[str, int] = {}
         # Device-resident scan carry, reused across batches while no event
@@ -249,6 +268,13 @@ class Scheduler:
         return self.scheduled_count - start
 
     def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
+        if self.queue.nominator.nominated_pods:
+            # nominated (preemptor) pods change OTHER pods' filter results
+            # (two-pass RunFilterPluginsWithNominatedPods); the device
+            # program doesn't model nominations, so the host oracle takes
+            # over until they resolve — nominations are short-lived (victim
+            # deletes flush at the end of the previous cycle)
+            return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         pods = [q.pod for q in qpis]
         self.cache.update_snapshot(self.snapshot)
         batch = self.builder.build(pods, snapshot=self.snapshot,
@@ -579,7 +605,8 @@ class Scheduler:
         state = CycleState()
         try:
             result = schedule_pod(profile.framework, state, pod,
-                                  self.snapshot.node_info_list)
+                                  self.snapshot.node_info_list,
+                                  nominator=self.queue.nominator)
         except FitError as err:
             self._handle_failure(qpi, err, state)
             return False
@@ -626,14 +653,16 @@ class Scheduler:
                 fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
                 self.cache.forget_pod(assumed)
                 self._invalidate_device_state()
-                self._handle_failure(qpi, FitError(pod, 0))
+                self._handle_failure(qpi, FitError(pod, 0),
+                                     try_preempt=False)
                 return
             status = fwk.run_permit_plugins(cs, assumed, node_name)
             if status.is_rejected():
                 fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
                 self.cache.forget_pod(assumed)
                 self._invalidate_device_state()
-                self._handle_failure(qpi, FitError(pod, 0))
+                self._handle_failure(qpi, FitError(pod, 0),
+                                     try_preempt=False)
                 return
         # Wait status (gang quorum) parks the pod; WaitOnPermit resolves at
         # flush time via the workload manager (gang plugin allows all).
@@ -671,17 +700,35 @@ class Scheduler:
     # -- failure path ---------------------------------------------------------
 
     def _handle_failure(self, qpi: QueuedPodInfo, err: FitError,
-                        state: Optional[CycleState] = None) -> None:
-        """schedule_one.go:1038 handleSchedulingFailure (PostFilter/preemption
-        wired in plugins/preemption integration)."""
+                        state: Optional[CycleState] = None,
+                        try_preempt: bool = True) -> None:
+        """schedule_one.go:1038 handleSchedulingFailure. A genuine
+        scheduling FitError runs the PostFilter (preemption) path first —
+        reserve/permit failures pass try_preempt=False, matching the
+        reference where PostFilter only follows schedulePod failures
+        (schedule_one.go:150-170)."""
         self.unschedulable_count += 1
         qpi.unschedulable_plugins = set(err.diagnosis.unschedulable_plugins)
         qpi.pending_plugins = set(err.diagnosis.pending_plugins)
+        pod = qpi.pod
+        nominated = pod.status.nominated_node_name
+        profile = self.profiles.get(pod.spec.scheduler_name)
+        if (try_preempt and err.num_all_nodes > 0 and profile is not None
+                and profile.framework.post_filter_plugins):
+            self.cache.update_snapshot(self.snapshot)
+            result, status = profile.framework.run_post_filter_plugins(
+                state or CycleState(), pod, err.diagnosis.node_to_status)
+            if status.is_success() and result:
+                nominated = result
+                pod.status.nominated_node_name = nominated
+                self.queue.nominator.add(qpi, nominated)
+                self.preemption_attempts += 1
         self.queue.add_unschedulable_if_not_present(qpi)
         self.dispatcher.add(APICall(
             CallType.STATUS_PATCH, qpi.pod,
             condition={"type": "PodScheduled", "status": "False",
-                       "reason": "Unschedulable", "message": str(err)}))
+                       "reason": "Unschedulable", "message": str(err)},
+            nominated_node_name=nominated))
 
     # -- housekeeping ---------------------------------------------------------
 

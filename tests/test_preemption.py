@@ -1,0 +1,168 @@
+"""Preemption (PostFilter) — Evaluator semantics + end-to-end eviction.
+
+Mirrors the reference's TestPostFilter / dry-run behaviors
+(pkg/scheduler/framework/preemption/preemption.go:268,431,658;
+plugins/defaultpreemption/default_preemption_test.go): victim selection is
+minimal, pick ordering follows the 5-step rules, Never policy opts out, and
+an end-to-end preemption frees the node, nominates the preemptor, and binds
+it on the next cycle.
+"""
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.framework.preemption import Candidate, Evaluator
+from kubernetes_tpu.framework.types import PodInfo
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _cluster(n_nodes=3, cpu=4, run_min=10**9):
+    api = APIServer()
+    clock = FakeClock()
+    sched = Scheduler(api, batch_size=64, clock=clock)
+    sched._clock_handle = clock
+    sched.UNIFORM_RUN_MIN = run_min  # host/scan path keeps tests deterministic
+    for i in range(n_nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": cpu, "memory": "16Gi", "pods": 110}).obj())
+    return api, sched
+
+
+def _fill(api, sched, n_nodes=3, cpu_each="4", prio=0):
+    for i in range(n_nodes):
+        api.create_pod(make_pod(f"low{i}").req(
+            {"cpu": cpu_each, "memory": "1Gi"}).priority(prio).obj())
+    assert sched.schedule_pending() == n_nodes
+
+
+class TestEndToEnd:
+    def test_high_priority_evicts_and_lands(self):
+        api, sched = _cluster()
+        _fill(api, sched)
+        # cluster is full; a high-priority pod must preempt exactly one victim
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        assert sched.schedule_pending() == 0   # this cycle: nominate + evict
+        vip = api.pods["default/vip"]
+        assert vip.status.nominated_node_name != ""
+        assert sched.preemption_attempts == 1
+        # exactly one victim deleted
+        remaining = [p for p in api.pods.values() if p.name.startswith("low")]
+        assert len(remaining) == 2
+        # victim delete requeued the preemptor; next cycle binds it onto the
+        # freed (nominated) node
+        sched._clock_handle.t += 15.0   # past the requeue backoff
+        sched.flush_queues()
+        bound = sched.schedule_pending()
+        assert bound == 1
+        assert api.pods["default/vip"].spec.node_name == vip.status.nominated_node_name
+
+    def test_equal_priority_cannot_preempt(self):
+        api, sched = _cluster()
+        _fill(api, sched, prio=50)
+        api.create_pod(make_pod("peer").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(50).obj())
+        assert sched.schedule_pending() == 0
+        assert api.pods["default/peer"].status.nominated_node_name == ""
+        assert len([p for p in api.pods.values()
+                    if p.name.startswith("low")]) == 3
+
+    def test_preemption_policy_never(self):
+        api, sched = _cluster()
+        _fill(api, sched)
+        pod = make_pod("nice").req({"cpu": "4", "memory": "1Gi"}).priority(100).obj()
+        pod.spec.preemption_policy = "Never"
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 0
+        assert api.pods["default/nice"].status.nominated_node_name == ""
+        assert len(api.pods) == 4  # nothing deleted
+
+    def test_minimal_victim_set(self):
+        # node n0 holds 4×1cpu low pods; preemptor needs 2cpu → exactly two
+        # victims (the least important two), the other two reprieved
+        api, sched = _cluster(n_nodes=1, cpu=4)
+        for i in range(4):
+            api.create_pod(make_pod(f"low{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).priority(i).obj())
+        assert sched.schedule_pending() == 4
+        api.create_pod(make_pod("vip").req({"cpu": "2", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        survivors = sorted(p.name for p in api.pods.values()
+                           if p.name.startswith("low"))
+        # lowest-priority pods (low0, low1) evicted; low2/low3 reprieved
+        assert survivors == ["low2", "low3"]
+        sched._clock_handle.t += 15.0
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/vip"].spec.node_name == "n0"
+
+    def test_victims_spread_resolution_via_device_path_after(self):
+        # after preemption resolves, subsequent pods take the device path
+        api, sched = _cluster(n_nodes=2, cpu=4, run_min=16)
+        _fill(api, sched, n_nodes=2)
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(10).obj())
+        sched.schedule_pending()
+        sched._clock_handle.t += 15.0
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
+        assert not sched.queue.nominator.nominated_pods  # nomination cleared
+
+
+class TestPickOneNode:
+    def _cand(self, node, prios, idx0=0):
+        return Candidate(node_name=node, victims=[
+            PodInfo.of(make_pod(f"v-{node}-{i}").priority(p).obj())
+            for i, p in enumerate(prios)])
+
+    def test_no_victims_wins(self):
+        c = Evaluator.pick_one_node([
+            self._cand("a", [5]), Candidate(node_name="b"), self._cand("c", [1])])
+        assert c.node_name == "b"
+
+    def test_lowest_max_priority_wins(self):
+        c = Evaluator.pick_one_node([
+            self._cand("a", [9, 1]), self._cand("b", [5, 4]),
+            self._cand("c", [8, 2])])
+        assert c.node_name == "b"
+
+    def test_smallest_priority_sum_breaks_tie(self):
+        c = Evaluator.pick_one_node([
+            self._cand("a", [5, 5]), self._cand("b", [5, 3])])
+        assert c.node_name == "b"
+
+    def test_fewest_victims_breaks_tie(self):
+        c = Evaluator.pick_one_node([
+            self._cand("a", [5, 3, 0]), self._cand("b", [5, 3])])
+        assert c.node_name == "b"
+
+
+class TestNominatedPods:
+    def test_nominated_resources_block_other_pods(self):
+        """A pending preemptor's nominated resources must repel lower-pri
+        pods (RunFilterPluginsWithNominatedPods two-pass,
+        runtime/framework.go:1158)."""
+        api, sched = _cluster(n_nodes=1, cpu=4)
+        _fill(api, sched, n_nodes=1)
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()          # evict + nominate, not yet rebound
+        # a new low-priority pod arrives while the nomination is pending;
+        # it must NOT steal the freed capacity
+        api.create_pod(make_pod("sneak").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(0).obj())
+        sched._clock_handle.t += 15.0
+        sched.flush_queues()
+        sched.schedule_pending()
+        assert api.pods["default/vip"].spec.node_name == "n0"
+        assert api.pods["default/sneak"].spec.node_name == ""
