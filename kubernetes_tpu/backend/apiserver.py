@@ -45,6 +45,7 @@ class APIServer:
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
+    workload_handlers: list[WatchHandlers] = field(default_factory=list)
     binding_count: int = 0
 
     # -- watch registration ---------------------------------------------------
@@ -54,6 +55,9 @@ class APIServer:
 
     def watch_nodes(self, h: WatchHandlers) -> None:
         self.node_handlers.append(h)
+
+    def watch_workloads(self, h: WatchHandlers) -> None:
+        self.workload_handlers.append(h)
 
     # -- pods -----------------------------------------------------------------
 
@@ -160,6 +164,9 @@ class APIServer:
 
     def create_workload(self, w: Workload) -> Workload:
         self.workloads[w.metadata.name] = w
+        for h in self.workload_handlers:
+            if h.on_add:
+                h.on_add(w)
         return w
 
     def get_workload(self, name: str) -> Optional[Workload]:

@@ -433,12 +433,16 @@ class SchedulingQueue:
     def gated_pods_could_be_ungated(self) -> list[QueuedPodInfo]:
         return [q for q in self.unschedulable_pods.values() if q.gated]
 
-    def retry_gated(self) -> int:
+    def retry_gated(self, predicate=None) -> int:
         """Re-runs PreEnqueue for gated pods (the reference re-evaluates on
-        pod-update events; we expose an explicit sweep too)."""
+        pod-update events; we expose an explicit sweep too). `predicate`
+        narrows the sweep to the pods an event could actually un-gate —
+        e.g. only one gang's members on a member-pod add."""
         moved = 0
         for uid, qpi in list(self.unschedulable_pods.items()):
             if not qpi.gated:
+                continue
+            if predicate is not None and not predicate(qpi.pod):
                 continue
             del self.unschedulable_pods[uid]
             self.unschedulable_since.pop(uid, None)

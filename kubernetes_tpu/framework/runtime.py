@@ -260,18 +260,22 @@ class Framework:
         for p in reversed(self.reserve_plugins):
             p.unreserve(state, pod, node_name)
 
-    def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
-        """Returns Success, Wait (max timeout), or a rejection."""
+    def run_permit_plugins(self, state: CycleState, pod: Pod,
+                           node_name: str) -> tuple[Status, float]:
+        """Returns (Success | Wait | rejection, max wait timeout) —
+        runtime/framework.go RunPermitPlugins."""
         wait_status: Optional[Status] = None
+        max_timeout = 0.0
         for p in self.permit_plugins:
-            status, _timeout = p.permit(state, pod, node_name)
+            status, timeout = p.permit(state, pod, node_name)
             if status.code == Code.WAIT:
                 wait_status = status
+                max_timeout = max(max_timeout, timeout or 0.0)
                 continue
             if not status.is_success():
                 status.plugin = status.plugin or p.name()
-                return status
-        return wait_status or Status.success()
+                return status, 0.0
+        return wait_status or Status.success(), max_timeout
 
     def run_pre_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for p in self.pre_bind_plugins:

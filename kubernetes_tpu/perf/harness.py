@@ -136,7 +136,7 @@ def _make_nodes(api: APIServer, count: int, start: int, params: dict) -> None:
 
 
 def _pod_from_template(name: str, template: Optional[dict], seq: int = 0,
-                       zones: int = 16):
+                       zones: int = 16, gang_size: int = 1):
     w = make_pod(name)
     t = template or {}
     w = w.req({"cpu": t.get("cpu", "900m"), "memory": t.get("memory", "1Gi")})
@@ -153,6 +153,9 @@ def _pod_from_template(name: str, template: Optional[dict], seq: int = 0,
                            t["podAntiAffinity"], anti=True)
     if "podAffinity" in t:
         w = w.pod_affinity(t.get("topologyKey", LABEL_ZONE), t["podAffinity"])
+    if "workloadRef" in t:
+        ref = t["workloadRef"].replace("$gang", str(seq // max(gang_size, 1)))
+        w = w.workload(ref.replace("$seq", str(seq)))
     return w.obj()
 
 
@@ -196,7 +199,8 @@ class WorkloadRunner:
                         seq = pod_seq + created + i
                         api.create_pod(_pod_from_template(
                             f"pod-{seq}", template, seq=seq,
-                            zones=params.get("zones", 16)))
+                            zones=params.get("zones", 16),
+                            gang_size=int(params.get("gangSize", 1))))
                     created += n
                     t0 = time.perf_counter()
                     bound = sched.schedule_pending()
@@ -210,6 +214,16 @@ class WorkloadRunner:
                 if col:
                     col.end()
                     items.append(col.item(f"{tc.name}/{wl.name}"))
+            elif code == "createWorkloads":
+                from ..api.types import ObjectMeta, PodGroup, Workload
+                count = int(_resolve(op, "count", params, 1))
+                min_count = int(_resolve(op, "minCount", params, 1))
+                prefix = op.get("namePrefix", "wl")
+                for i in range(count):
+                    api.create_workload(Workload(
+                        metadata=ObjectMeta(name=f"{prefix}-{i}"),
+                        pod_groups=[PodGroup(name="workers",
+                                             min_count=min_count)]))
             elif code == "barrier":
                 deadline = time.time() + float(op.get("timeoutSeconds", 60))
                 while len(sched.queue) and time.time() < deadline:

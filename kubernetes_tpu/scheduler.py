@@ -33,7 +33,9 @@ from .backend.apiserver import APIServer, WatchHandlers
 from .backend.cache import Cache, Snapshot
 from .backend.dispatcher import APICall, APIDispatcher, CallType
 from .backend.queue import ClusterEventWithHint, SchedulingQueue
-from .framework.interface import CycleState, Status
+from .backend.workloadmanager import (DEFAULT_SCHEDULING_TIMEOUT,
+                                      WorkloadManager)
+from .framework.interface import Code, CycleState, Status
 from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
@@ -71,10 +73,11 @@ DEFAULT_WEIGHTS = {
 
 def default_plugins(client=None, ns_lister=None) -> list:
     from .plugins.defaultbinder import DefaultBinder
+    from .plugins.gangscheduling import GangScheduling
     plugins = [
-        SchedulingGates(), PrioritySort(), NodeUnschedulable(), NodeName(),
-        TaintToleration(), NodeAffinity(), NodePorts(), nr.Fit(),
-        nr.BalancedAllocation(), PodTopologySpread(),
+        SchedulingGates(), GangScheduling(), PrioritySort(),
+        NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
+        NodePorts(), nr.Fit(), nr.BalancedAllocation(), PodTopologySpread(),
         InterPodAffinity(ns_lister=ns_lister), ImageLocality(),
     ]
     if client is not None:
@@ -87,6 +90,39 @@ class Profile:
     name: str = DEFAULT_SCHEDULER_NAME
     framework: Optional[Framework] = None
     score_config: ScoreConfig = ScoreConfig()
+    # True when every reserve/permit plugin is gang-only: non-gang pods can
+    # then skip the per-bind framework hooks entirely (hot path)
+    gang_only_hooks: bool = False
+
+
+@dataclass
+class _WaitingPodRec:
+    """A pod parked at Permit (reference runtime/waiting_pods_map.go): its
+    resources stay assumed in the cache until allowed or rejected."""
+
+    qpi: QueuedPodInfo
+    assumed: Pod
+    node_name: str
+    cycle_state: CycleState
+    deadline: float
+    wait_plugin: str = ""
+
+
+class _WaitingPodHandle:
+    """The WaitingPod the Permit plugins see (framework.WaitingPod). With a
+    single permit plugin per profile, one Allow releases the pod (the
+    reference requires every permit plugin's allow; the plugin-set loop in
+    run_permit_plugins already serializes them)."""
+
+    def __init__(self, scheduler: "Scheduler", uid: str):
+        self._scheduler = scheduler
+        self._uid = uid
+
+    def allow(self, plugin_name: str) -> None:
+        self._scheduler._allow_waiting(self._uid)
+
+    def reject(self, plugin_name: str, reason: str = "") -> None:
+        self._scheduler._reject_waiting(self._uid, reason)
 
 
 class Scheduler:
@@ -125,6 +161,20 @@ class Scheduler:
             pre_enqueue=default_fwk.run_pre_enqueue_plugins,
             queueing_hints=self._build_queueing_hints(default_fwk),
             clock=clock)
+
+        self.workload_manager = WorkloadManager(clock=clock)
+        # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
+        self._waiting_pods: dict[str, _WaitingPodRec] = {}
+        # hand every GangScheduling plugin its Handle (this Scheduler)
+        from .plugins.gangscheduling import GangScheduling
+        for prof in self.profiles.values():
+            for p in prof.framework.plugins:
+                if isinstance(p, GangScheduling):
+                    p.handle = self
+            prof.gang_only_hooks = all(
+                isinstance(p, GangScheduling)
+                for p in (prof.framework.reserve_plugins
+                          + prof.framework.permit_plugins))
 
         # wire preemption (PostFilter) into every profile: the Evaluator
         # needs live handles (dispatcher, nominator, snapshot) that exist
@@ -178,6 +228,55 @@ class Scheduler:
                 hints[p.name()] = list(p.events_to_register())
         return hints
 
+    # -- framework.Handle surface for Permit plugins --------------------------
+
+    def get_workload(self, namespace: str, name: str):
+        return self.client.get_workload(name)
+
+    def activate(self, pods: list[Pod]) -> None:
+        self.queue.activate(pods)
+
+    def now(self) -> float:
+        return self.clock()
+
+    def get_waiting_pod(self, uid: str):
+        if uid in self._waiting_pods:
+            return _WaitingPodHandle(self, uid)
+        return None
+
+    def _allow_waiting(self, uid: str) -> None:
+        """WaitOnPermit resolved positively: complete the parked pod's
+        binding (schedule_one.go:302 onward)."""
+        rec = self._waiting_pods.pop(uid, None)
+        if rec is None:
+            return
+        self.cache.finish_binding(rec.assumed)
+        self.dispatcher.add(APICall(CallType.BIND, rec.assumed,
+                                    node_name=rec.node_name))
+        self.scheduled_count += 1
+        rec.qpi.unschedulable_plugins = set()
+        rec.qpi.consecutive_errors_count = 0
+
+    def _reject_waiting(self, uid: str, reason: str = "") -> None:
+        """WaitOnPermit rejection (timeout or plugin): unreserve, release
+        the assumed resources, requeue as unschedulable."""
+        rec = self._waiting_pods.pop(uid, None)
+        if rec is None:
+            return
+        pod = rec.qpi.pod
+        profile = self.profiles.get(pod.spec.scheduler_name)
+        if profile is not None:
+            profile.framework.run_reserve_plugins_unreserve(
+                rec.cycle_state, rec.assumed, rec.node_name)
+        try:
+            self.cache.forget_pod(rec.assumed)
+        except (KeyError, ValueError):
+            pass
+        self._invalidate_device_state()
+        err = FitError(pod, 0)
+        err.diagnosis.unschedulable_plugins = {rec.wait_plugin or "Permit"}
+        self._handle_failure(rec.qpi, err, try_preempt=False)
+
     def _register_event_handlers(self) -> None:
         """eventhandlers.go:499 addAllEventHandlers."""
         self.client.watch_pods(WatchHandlers(
@@ -186,6 +285,9 @@ class Scheduler:
         self.client.watch_nodes(WatchHandlers(
             on_add=self._on_node_add, on_update=self._on_node_update,
             on_delete=self._on_node_delete))
+        if hasattr(self.client, "watch_workloads"):
+            self.client.watch_workloads(WatchHandlers(
+                on_add=self._on_workload_add))
 
     def _responsible(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.profiles
@@ -196,6 +298,7 @@ class Scheduler:
         self._device_carry = None
 
     def _on_pod_add(self, pod: Pod) -> None:
+        self.workload_manager.add_pod(pod)
         if pod.spec.node_name:
             self.cache.add_pod(pod)
             self._invalidate_device_state()
@@ -203,8 +306,15 @@ class Scheduler:
                 EVENT_ASSIGNED_POD_ADD, None, pod)
         elif self._responsible(pod):
             self.queue.add(pod)
+            if pod.spec.workload_ref:
+                # a new gang member can un-gate ITS group (PreEnqueue
+                # quorum); other gangs' quorums are unaffected
+                ref = pod.spec.workload_ref
+                self.queue.retry_gated(
+                    predicate=lambda p: p.spec.workload_ref == ref)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        self.workload_manager.update_pod(old, new)
         if new.spec.node_name:
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
@@ -226,6 +336,9 @@ class Scheduler:
                 EVENT_POD_UPDATE, old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        self.workload_manager.delete_pod(pod)
+        if pod.uid in self._waiting_pods:
+            self._reject_waiting(pod.uid, "pod deleted")
         self._bind_errors.pop(pod.uid, None)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
@@ -234,6 +347,14 @@ class Scheduler:
                 EVENT_ASSIGNED_POD_DELETE, pod, None)
         else:
             self.queue.delete(pod)
+
+    def _on_workload_add(self, workload) -> None:
+        """A Workload's arrival can un-gate its gang's pods (PreEnqueue)
+        and requeue unschedulable members (gangscheduling.go:100)."""
+        self.queue.retry_gated()
+        self.queue.move_all_to_active_or_backoff_queue(
+            ClusterEvent(EventResource.WORKLOAD, ActionType.ADD),
+            None, workload)
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -646,7 +767,9 @@ class Scheduler:
         self.queue.nominator.delete(pod)
         profile = self.profiles.get(pod.spec.scheduler_name)
         fwk = profile.framework
-        if fwk.reserve_plugins or fwk.permit_plugins:
+        run_hooks = (fwk.reserve_plugins or fwk.permit_plugins) and (
+            pod.spec.workload_ref or not profile.gang_only_hooks)
+        if run_hooks:
             cs = state or CycleState()
             status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
             if not status.is_success():
@@ -656,16 +779,36 @@ class Scheduler:
                 self._handle_failure(qpi, FitError(pod, 0),
                                      try_preempt=False)
                 return
-            status = fwk.run_permit_plugins(cs, assumed, node_name)
-            if status.is_rejected():
+            status, wait_timeout = fwk.run_permit_plugins(cs, assumed,
+                                                          node_name)
+            if status.code == Code.WAIT and wait_timeout <= 0:
+                # the group's scheduling deadline already expired: reject
+                # instead of parking for another round (the reference's
+                # WaitOnPermit timer fires immediately at timeout 0)
+                status = Status.unschedulable(
+                    "gang scheduling deadline expired",
+                    plugin=status.plugin)
+            if not status.is_success() and status.code != Code.WAIT:
+                # rejection OR plugin error: either way the pod must not
+                # bind — unreserve, release the assumed resources, requeue
                 fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
                 self.cache.forget_pod(assumed)
                 self._invalidate_device_state()
+                if status.code == Code.ERROR:
+                    self.error_count += 1
                 self._handle_failure(qpi, FitError(pod, 0),
                                      try_preempt=False)
                 return
-        # Wait status (gang quorum) parks the pod; WaitOnPermit resolves at
-        # flush time via the workload manager (gang plugin allows all).
+            if status.code == Code.WAIT:
+                # WaitOnPermit (schedule_one.go:302): park; resources stay
+                # assumed; a later gang member's Permit (or the timeout
+                # sweep in flush_queues) resolves it
+                self.queue.done(pod.uid)
+                self._waiting_pods[pod.uid] = _WaitingPodRec(
+                    qpi=qpi, assumed=assumed, node_name=node_name,
+                    cycle_state=cs, deadline=self.clock() + wait_timeout,
+                    wait_plugin=status.plugin)
+                return
         self.queue.done(pod.uid)
         self.cache.finish_binding(assumed)
         self.dispatcher.add(APICall(CallType.BIND, assumed, node_name=node_name))
@@ -733,7 +876,12 @@ class Scheduler:
     # -- housekeeping ---------------------------------------------------------
 
     def flush_queues(self) -> None:
-        """SchedulingQueue.Run periodic work (scheduling_queue.go:406-413)."""
+        """SchedulingQueue.Run periodic work (scheduling_queue.go:406-413)
+        + the WaitOnPermit timeout sweep (waiting_pods_map.go timers)."""
+        now = self.clock()
+        for uid, rec in list(self._waiting_pods.items()):
+            if rec.deadline <= now:
+                self._reject_waiting(uid, "permit wait timeout")
         self.queue.flush_backoff_completed()
         self.queue.flush_unschedulable_leftover()
 

@@ -1,0 +1,171 @@
+"""Gang scheduling: WorkloadManager + GangScheduling plugin + WaitOnPermit.
+
+Mirrors the reference behaviors (gangscheduling.go:120-251,
+workloadmanager.go:32-129): PreEnqueue gates below quorum, Reserve marks
+assumed, Permit parks at Wait until assumed+assigned ≥ MinCount then
+releases the whole gang atomically, and timeouts reject every parked
+member, releasing their assumed resources.
+"""
+
+from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _cluster(n_nodes=4, cpu=8):
+    api = APIServer()
+    clock = FakeClock()
+    sched = Scheduler(api, batch_size=64, clock=clock)
+    sched._clock_handle = clock
+    for i in range(n_nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": cpu, "memory": "16Gi", "pods": 110}).obj())
+    return api, sched
+
+
+def _workload(api, name="job", min_count=3):
+    api.create_workload(Workload(metadata=ObjectMeta(name=name),
+                                 pod_groups=[PodGroup(name="workers",
+                                                      min_count=min_count)]))
+
+
+def _gang_pod(name, ref="job", cpu="1"):
+    return make_pod(name).req({"cpu": cpu, "memory": "1Gi"}).workload(ref).obj()
+
+
+class TestPreEnqueueQuorum:
+    def test_gated_until_workload_exists(self):
+        api, sched = _cluster()
+        api.create_pod(_gang_pod("g0"))
+        assert sched.schedule_pending() == 0
+        n, summary = sched.queue.pending_pods()
+        assert "unschedulablePods:1" in summary
+
+    def test_gated_until_min_count_pods(self):
+        api, sched = _cluster()
+        _workload(api, min_count=3)
+        api.create_pod(_gang_pod("g0"))
+        api.create_pod(_gang_pod("g1"))
+        assert sched.schedule_pending() == 0      # 2 < 3: both gated
+        api.create_pod(_gang_pod("g2"))           # quorum of known pods
+        assert sched.schedule_pending() == 3      # whole gang binds together
+        bound = [p.spec.node_name for p in api.pods.values()]
+        assert all(bound)
+
+    def test_non_gang_pods_unaffected(self):
+        api, sched = _cluster()
+        api.create_pod(make_pod("plain").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+
+
+class TestAllOrNothing:
+    def test_partial_gang_holds_at_permit(self):
+        """Capacity admits only 2 of 3 members: nothing binds, the two
+        placeable pods park at Permit holding their resources."""
+        api, sched = _cluster(n_nodes=2, cpu=1)
+        _workload(api, min_count=3)
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}", cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert len(sched._waiting_pods) == 2
+        assert api.binding_count == 0
+
+    def test_timeout_rejects_all_and_releases_resources(self):
+        api, sched = _cluster(n_nodes=2, cpu=1)
+        _workload(api, min_count=3)
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}", cpu="1"))
+        sched.schedule_pending()
+        assert len(sched._waiting_pods) == 2
+        sched._clock_handle.t += 400.0            # past the 300s gang timeout
+        sched.flush_queues()
+        assert len(sched._waiting_pods) == 0
+        assert api.binding_count == 0
+        # the freed capacity is usable again by ordinary pods
+        api.create_pod(make_pod("plain0").req({"cpu": "1", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("plain1").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 2
+
+    def test_gang_completes_when_capacity_arrives(self):
+        api, sched = _cluster(n_nodes=2, cpu=1)
+        _workload(api, min_count=3)
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}", cpu="1"))
+        sched.schedule_pending()
+        assert api.binding_count == 0
+        # a third node arrives: the remaining member schedules, quorum hits,
+        # the whole gang binds
+        api.create_node(make_node("n2").capacity(
+            {"cpu": 1, "memory": "16Gi", "pods": 110}).obj())
+        sched._clock_handle.t += 15.0
+        sched.flush_queues()
+        assert sched.schedule_pending() == 3
+        assert api.binding_count == 3
+
+    def test_two_gangs_independent(self):
+        api, sched = _cluster(n_nodes=6, cpu=1)
+        _workload(api, "job-a", min_count=2)
+        _workload(api, "job-b", min_count=3)
+        for i in range(2):
+            api.create_pod(_gang_pod(f"a{i}", ref="job-a"))
+        for i in range(2):
+            api.create_pod(_gang_pod(f"b{i}", ref="job-b"))  # below quorum
+        assert sched.schedule_pending() == 2      # only gang A binds
+        assert api.pods["default/a0"].spec.node_name
+        assert not api.pods["default/b0"].spec.node_name
+        api.create_pod(_gang_pod("b2", ref="job-b"))
+        assert sched.schedule_pending() == 3      # gang B completes
+
+
+class TestWorkloadArrivalUngates:
+    def test_pods_before_workload(self):
+        api, sched = _cluster()
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}"))
+        assert sched.schedule_pending() == 0      # gated: no Workload yet
+        _workload(api, min_count=3)               # arrival un-gates the gang
+        assert sched.schedule_pending() == 3
+
+
+class TestWorkloadManagerState:
+    def test_sets_track_lifecycle(self):
+        api, sched = _cluster()
+        _workload(api, min_count=2)
+        api.create_pod(_gang_pod("g0"))
+        api.create_pod(_gang_pod("g1"))
+        info = sched.workload_manager.pod_group_info(api.pods["default/g0"])
+        assert len(info.all_pods) == 2 and len(info.unscheduled) == 2
+        sched.schedule_pending()
+        info = sched.workload_manager.pod_group_info(api.pods["default/g0"])
+        assert len(info.assigned) == 2 and not info.unscheduled
+        api.delete_pod("default/g0")
+        info = sched.workload_manager.pod_group_info(api.pods["default/g1"])
+        assert len(info.all_pods) == 1
+
+    def test_expired_deadline_rejects_immediately_on_retry(self):
+        """After the group deadline passes, retries must not re-park for
+        another full timeout while holding assumed resources."""
+        api, sched = _cluster(n_nodes=2, cpu=1)
+        _workload(api, min_count=3)
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}", cpu="1"))
+        sched.schedule_pending()
+        assert len(sched._waiting_pods) == 2
+        sched._clock_handle.t += 400.0
+        sched.flush_queues()          # deadline sweep rejects both
+        assert not sched._waiting_pods
+        sched._clock_handle.t += 20.0
+        sched.flush_queues()          # backoff expires; pods retry
+        sched.schedule_pending()
+        # expired group deadline: no pod may park again
+        assert not sched._waiting_pods
+        assert api.binding_count == 0
