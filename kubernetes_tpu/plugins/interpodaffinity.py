@@ -271,6 +271,48 @@ class InterPodAffinity:
         state.write(_PRE_FILTER_KEY, s)
         return None, Status.success()
 
+    def events_to_register(self):
+        """interpodaffinity EventsToRegister (plugin.go): an assigned pod
+        helps when it matches one of my terms (affinity satisfied, or an
+        anti-affinity blocker removed on delete), or when I match one of
+        ITS anti-affinity terms (the symmetric veto disappearing); node
+        add / label change can create new matching topologies."""
+        from ..backend.queue import ClusterEventWithHint
+        from ..framework.types import (ActionType, ClusterEvent,
+                                       EventResource, QueueingHint)
+
+        def after_pod_change(pod: Pod, old, new):
+            # BOTH sides of an update matter: a label removal can clear an
+            # anti-affinity blocker (the old pod matched, the new doesn't)
+            candidates = [p for p in (old, new) if p is not None]
+            if not candidates:
+                return QueueingHint.QUEUE
+            req_a, req_aa, pref_a, pref_aa = parse_pod_affinity_terms(pod)
+            my_terms = req_a + req_aa + [w.term for w in pref_a + pref_aa]
+            my_ns_labels = self.ns_lister.labels_of(pod.namespace)
+            for other in candidates:
+                ns_labels = self.ns_lister.labels_of(other.namespace)
+                for t in my_terms:
+                    if t.matches(other, ns_labels):
+                        return QueueingHint.QUEUE
+                _, o_req_aa, _, _ = parse_pod_affinity_terms(other)
+                for t in o_req_aa:
+                    if t.matches(pod, my_ns_labels):
+                        return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.ASSIGNED_POD,
+                             ActionType.ADD | ActionType.DELETE
+                             | ActionType.UPDATE_POD_LABEL),
+                after_pod_change),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.NODE,
+                             ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+                None),
+        ]
+
     # -- PreFilterExtensions --------------------------------------------------
 
     def add_pod(self, state: CycleState, pod_to_schedule: Pod,

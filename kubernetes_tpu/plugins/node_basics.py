@@ -27,6 +27,12 @@ _PORTS_PRE_FILTER_KEY = "PreFilter" + NODE_PORTS
 _TAINT_PRE_SCORE_KEY = "PreScore" + TAINT_TOLERATION
 
 
+def _hint_events():
+    from ..backend.queue import ClusterEventWithHint
+    from ..framework.types import ActionType, ClusterEvent, EventResource
+    return ClusterEventWithHint, ActionType, ClusterEvent, EventResource
+
+
 class NodeName:
     """F, Sg — nodename/node_name.go: pod.Spec.NodeName must equal node name."""
 
@@ -38,6 +44,19 @@ class NodeName:
             return Status.unresolvable(
                 "node(s) didn't match the requested node name", plugin=NODE_NAME)
         return Status.success()
+
+    def events_to_register(self):
+        """node_name.go EventsToRegister: only the arrival of the named
+        node can help."""
+        CEWH, AT, CE, ER = _hint_events()
+
+        def after_node_add(pod: Pod, old, new):
+            from ..framework.types import QueueingHint
+            if new is not None and pod.spec.node_name == new.metadata.name:
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [CEWH(CE(ER.NODE, AT.ADD), after_node_add)]
 
     def sign(self, pod: Pod) -> tuple:
         return ("nodename", pod.spec.node_name)
@@ -59,6 +78,26 @@ class NodeUnschedulable:
         if any(t.tolerates(self.TAINT) for t in pod.spec.tolerations):
             return Status.success()
         return Status.unresolvable("node(s) were unschedulable", plugin=NODE_UNSCHEDULABLE)
+
+    def events_to_register(self):
+        """node_unschedulable.go isSchedulableAfterNodeChange: only a node
+        that is (now) schedulable — or whose cordon the pod tolerates —
+        can help. Cordon flips arrive as UPDATE_NODE_TAINT (the reference
+        maps spec.unschedulable to the taint event)."""
+        CEWH, AT, CE, ER = _hint_events()
+
+        def after_node_change(pod: Pod, old, new):
+            from ..framework.types import QueueingHint
+            if new is None:
+                return QueueingHint.QUEUE
+            if (not new.spec.unschedulable
+                    or any(t.tolerates(self.TAINT)
+                           for t in pod.spec.tolerations)):
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [CEWH(CE(ER.NODE, AT.ADD | AT.UPDATE_NODE_TAINT),
+                     after_node_change)]
 
     def sign(self, pod: Pod) -> tuple:
         return ("tolerations:unschedulable",
@@ -119,6 +158,24 @@ class TaintToleration:
         scores[:] = default_normalize(scores, reverse=True)
         return Status.success()
 
+    def events_to_register(self):
+        """taint_toleration.go isSchedulableAfterNodeChange: queue only
+        when the pod tolerates the (new) node's hard taints — e.g. a
+        taint removal."""
+        CEWH, AT, CE, ER = _hint_events()
+
+        def after_node_change(pod: Pod, old, new):
+            from ..framework.types import QueueingHint
+            if new is None:
+                return QueueingHint.QUEUE
+            taint = find_matching_untolerated_taint(
+                new.spec.taints, pod.spec.tolerations, self.FILTER_EFFECTS)
+            return (QueueingHint.SKIP if taint is not None
+                    else QueueingHint.QUEUE)
+
+        return [CEWH(CE(ER.NODE, AT.ADD | AT.UPDATE_NODE_TAINT),
+                     after_node_change)]
+
     def sign(self, pod: Pod) -> tuple:
         return ("tolerations", tuple(pod.spec.tolerations))
 
@@ -150,6 +207,25 @@ class NodePorts:
                                             plugin=NODE_PORTS)
         return Status.success()
 
+    def events_to_register(self):
+        """node_ports.go: an assigned pod's deletion helps only when it
+        held one of the ports this pod wants; new nodes always might."""
+        CEWH, AT, CE, ER = _hint_events()
+
+        def after_pod_delete(pod: Pod, old, new):
+            from ..framework.types import QueueingHint
+            if old is None:
+                return QueueingHint.QUEUE
+            mine = {(p.protocol or "TCP", p.host_port)
+                    for p in self._container_ports(pod)}
+            theirs = {(p.protocol or "TCP", p.host_port)
+                      for p in self._container_ports(old)}
+            return (QueueingHint.QUEUE if mine & theirs
+                    else QueueingHint.SKIP)
+
+        return [CEWH(CE(ER.NODE, AT.ADD), None),
+                CEWH(CE(ER.ASSIGNED_POD, AT.DELETE), after_pod_delete)]
+
     def sign(self, pod: Pod) -> tuple:
         return ("hostports", tuple((p.protocol, p.host_port, p.host_ip)
                                    for p in self._container_ports(pod)))
@@ -167,6 +243,10 @@ class SchedulingGates:
         gates = ", ".join(g.name for g in pod.spec.scheduling_gates)
         return Status.unresolvable(f"waiting for scheduling gates: {gates}",
                                    plugin=SCHEDULING_GATES)
+
+    # no events_to_register: gated pods never reach the unschedulable
+    # pool's hint path (move_all skips gated entries) — gate removal is
+    # handled by queue.update re-running PreEnqueue
 
 
 class PrioritySort:

@@ -141,6 +141,28 @@ class NodeAffinity:
         scores[:] = default_normalize(scores)
         return Status.success()
 
+    def events_to_register(self):
+        """node_affinity.go isSchedulableAfterNodeChange: queue only when
+        the (new) node satisfies the pod's nodeSelector + required
+        affinity."""
+        from ..backend.queue import ClusterEventWithHint
+        from ..framework.types import (ActionType, ClusterEvent,
+                                       EventResource, QueueingHint)
+
+        def after_node_change(pod: Pod, old, new):
+            if new is None:
+                return QueueingHint.QUEUE
+            # the helper covers nodeSelector AND required affinity terms
+            if required_node_affinity_matches(pod, new.metadata.labels,
+                                              new.metadata.name):
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [ClusterEventWithHint(
+            ClusterEvent(EventResource.NODE,
+                         ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+            after_node_change)]
+
     def sign(self, pod: Pod) -> tuple:
         aff = pod.spec.affinity
         return ("nodeaffinity",

@@ -252,6 +252,59 @@ class Fit:
     def normalize_scores(self, state, pod, scores, node_names=None) -> Status:
         return Status.success()
 
+    def events_to_register(self):
+        """fit.go EventsToRegister + isSchedulableAfterNodeChange /
+        isSchedulableAfterPodEvent: node arrivals or allocatable growth
+        queue only when the pod's requests could fit the node outright;
+        an assigned pod's deletion queues only when it releases a resource
+        this pod asks for."""
+        from ..backend.queue import ClusterEventWithHint
+        from ..framework.types import (ActionType, ClusterEvent,
+                                       EventResource, QueueingHint)
+
+        def after_node_change(pod: Pod, old, new):
+            if new is None:
+                return QueueingHint.QUEUE
+            requests = res.pod_requests(pod)
+            alloc = new.status.allocatable
+            for r, v in requests.items():
+                if v > 0 and v > alloc.get(r, 0):
+                    return QueueingHint.SKIP
+            if alloc.get(res.PODS, 1) < 1:
+                return QueueingHint.SKIP
+            return QueueingHint.QUEUE
+
+        def after_pod_event(pod: Pod, old, new):
+            # DELETE of an assigned pod (old=pod, new=None) frees its whole
+            # request; a scale-down frees only the old−new delta. Queue
+            # only when a freed resource overlaps one this pod asks for.
+            if old is None:
+                return QueueingHint.QUEUE
+            freed = dict(res.pod_requests(old))
+            if new is not None:
+                for r, v in res.pod_requests(new).items():
+                    freed[r] = freed.get(r, 0) - v
+            mine = res.pod_requests(pod)
+            for r, v in mine.items():
+                if v > 0 and freed.get(r, 0) > 0:
+                    return QueueingHint.QUEUE
+            # a deletion also frees a pod-count slot; only relevant when
+            # the pod requests nothing else
+            return (QueueingHint.QUEUE
+                    if new is None and not any(mine.values())
+                    else QueueingHint.SKIP)
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.NODE,
+                             ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE),
+                after_node_change),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.ASSIGNED_POD,
+                             ActionType.DELETE | ActionType.UPDATE_POD_SCALE_DOWN),
+                after_pod_event),
+        ]
+
     def sign(self, pod: Pod) -> tuple:
         return ("resources", tuple(sorted(res.pod_requests(pod).items())))
 

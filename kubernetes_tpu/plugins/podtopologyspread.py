@@ -274,6 +274,42 @@ class PodTopologySpread:
         state.write(_PRE_FILTER_KEY, s)
         return None, Status.success()
 
+    def events_to_register(self):
+        """podtopologyspread.go EventsToRegister: assigned-pod churn in the
+        pod's namespace matching a spread selector moves its counts; node
+        add / label change can alter the topology domains."""
+        from ..api.types import UnsatisfiableConstraintAction as UCA
+        from ..backend.queue import ClusterEventWithHint
+        from ..framework.types import (ActionType, ClusterEvent,
+                                       EventResource, QueueingHint)
+
+        def after_pod_change(pod: Pod, old, new):
+            other = new if new is not None else old
+            if other is None:
+                return QueueingHint.QUEUE
+            if other.namespace != pod.namespace:
+                return QueueingHint.SKIP
+            constraints = (self._get_constraints(pod, UCA.DO_NOT_SCHEDULE.value)
+                           + self._get_constraints(pod, UCA.SCHEDULE_ANYWAY.value))
+            for c in constraints:
+                for cand in (old, new):
+                    if (cand is not None
+                            and c.selector.matches(cand.metadata.labels)):
+                        return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.ASSIGNED_POD,
+                             ActionType.ADD | ActionType.DELETE
+                             | ActionType.UPDATE_POD_LABEL),
+                after_pod_change),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.NODE,
+                             ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+                None),
+        ]
+
     # -- PreFilterExtensions (preemption dry-run support) ---------------------
 
     def add_pod(self, state: CycleState, pod_to_schedule: Pod,

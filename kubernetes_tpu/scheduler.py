@@ -59,6 +59,45 @@ EVENT_ASSIGNED_POD_DELETE = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.
 EVENT_ASSIGNED_POD_ADD = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD)
 EVENT_POD_UPDATE = ClusterEvent(EventResource.POD, ActionType.UPDATE)
 
+
+def node_update_action(old: Node, new: Node) -> ActionType:
+    """Per-property node update flags (eventhandlers.go:88-99
+    nodeSchedulingPropertiesChange): precise flags let queueing hints skip
+    pods whose rejection the change cannot fix. Unschedulable flips map to
+    the taint flag exactly like the reference (cordon == taint)."""
+    flags = ActionType(0)
+    if new.status.allocatable != old.status.allocatable:
+        flags |= ActionType.UPDATE_NODE_ALLOCATABLE
+    if new.metadata.labels != old.metadata.labels:
+        flags |= ActionType.UPDATE_NODE_LABEL
+    if (new.spec.taints != old.spec.taints
+            or new.spec.unschedulable != old.spec.unschedulable):
+        flags |= ActionType.UPDATE_NODE_TAINT
+    return flags
+
+
+def pod_update_action(old: Pod, new: Pod) -> ActionType:
+    """Per-property pod update flags (eventhandlers.go
+    podSchedulingPropertiesChange)."""
+    flags = ActionType(0)
+    if new.metadata.labels != old.metadata.labels:
+        flags |= ActionType.UPDATE_POD_LABEL
+    if new.spec.scheduling_gates != old.spec.scheduling_gates:
+        flags |= ActionType.UPDATE_POD_SCHEDULING_GATES
+    if new.spec.tolerations != old.spec.tolerations:
+        flags |= ActionType.UPDATE_POD_TOLERATION
+    old_req: dict[str, int] = {}
+    for c in old.spec.containers:
+        for k, v in c.requests.items():
+            old_req[k] = old_req.get(k, 0) + v
+    new_req: dict[str, int] = {}
+    for c in new.spec.containers:
+        for k, v in c.requests.items():
+            new_req[k] = new_req.get(k, 0) + v
+    if any(new_req.get(k, 0) < v for k, v in old_req.items()):
+        flags |= ActionType.UPDATE_POD_SCALE_DOWN
+    return flags
+
 # default plugin weights (apis/config/v1/default_plugins.go:30-93)
 DEFAULT_WEIGHTS = {
     "TaintToleration": 3,
@@ -319,6 +358,11 @@ class Scheduler:
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
                 self._invalidate_device_state()
+                flags = pod_update_action(old, new)
+                if flags:
+                    self.queue.move_all_to_active_or_backoff_queue(
+                        ClusterEvent(EventResource.ASSIGNED_POD, flags),
+                        old, new)
             else:
                 # became bound. Our own bind echo confirms a pod the device
                 # carry already accounts for (it was assumed before the bind
@@ -332,8 +376,12 @@ class Scheduler:
                     EVENT_ASSIGNED_POD_ADD, old, new)
         elif self._responsible(new):
             self.queue.update(old, new)
-            self.queue.move_all_to_active_or_backoff_queue(
-                EVENT_POD_UPDATE, old, new)
+            flags = pod_update_action(old, new)
+            if flags:
+                # gate removal needs no special-casing: queue.update above
+                # already re-ran PreEnqueue for the gated entry
+                self.queue.move_all_to_active_or_backoff_queue(
+                    ClusterEvent(EventResource.POD, flags), old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
         self.workload_manager.delete_pod(pod)
@@ -364,7 +412,10 @@ class Scheduler:
     def _on_node_update(self, old: Node, new: Node) -> None:
         self.cache.update_node(old, new)
         self._invalidate_device_state()
-        self.queue.move_all_to_active_or_backoff_queue(EVENT_NODE_UPDATE, old, new)
+        flags = node_update_action(old, new)
+        if flags:
+            self.queue.move_all_to_active_or_backoff_queue(
+                ClusterEvent(EventResource.NODE, flags), old, new)
 
     def _on_node_delete(self, node: Node) -> None:
         self.cache.remove_node(node)
@@ -492,14 +543,17 @@ class Scheduler:
         self._device_carry = carry
         self.device_batches += 1
         bound = 0
-        for qpi, a in zip(qpis, assignments):
+        diag_cache: dict[int, object] = {}
+        for i, (qpi, a) in enumerate(zip(qpis, assignments)):
             self.schedule_attempts += 1
             if a >= 0:
                 node_name = self.state.node_names[int(a)]
                 self._assume_and_bind(qpi, node_name)
                 bound += 1
             else:
-                self._handle_failure(qpi, self._device_fit_error(qpi))
+                err = self._device_fit_error(
+                    qpi, profile, int(segment_batch.sig[i]), diag_cache)
+                self._handle_failure(qpi, err)
         return bound
 
     # below this run length the scan's per-step cost beats the matrix setup
@@ -678,27 +732,39 @@ class Scheduler:
                                    touched=gens)
         return self.state.reconcile(self.snapshot)
 
-    def _device_fit_error(self, qpi: QueuedPodInfo) -> FitError:
-        """Device reports only infeasibility; attribute to the plugins whose
-        constraints the pod carries so queueing hints stay precise enough."""
+    def _device_fit_error(self, qpi: QueuedPodInfo, profile: Profile,
+                          sig: int, diag_cache: dict) -> FitError:
+        """The device reports only global infeasibility; run the host
+        oracle's FILTER phase once per failed signature per batch to
+        recover the exact per-node statuses and rejecting plugins —
+        queueing hints and preemption's resolvable-node pruning both need
+        the real diagnosis, not a guess from the pod spec. Identical
+        signatures share identical filter outcomes, so the dict lookup
+        makes mass failures (a full cluster rejecting a homogeneous tail)
+        cost ONE host filter sweep per batch instead of one per pod."""
+        from .framework.types import Diagnosis
+        cached = diag_cache.get(sig) if sig != 0 else None
+        if cached is None:
+            fwk = profile.framework
+            nodes = self.snapshot.node_info_list
+            diagnosis = Diagnosis()
+            state = CycleState()
+            pre_result, status = fwk.run_pre_filter_plugins(
+                state, qpi.pod, nodes)
+            if not status.is_success():
+                diagnosis.pre_filter_msg = "; ".join(status.reasons)
+                if status.plugin:
+                    diagnosis.unschedulable_plugins.add(status.plugin)
+            else:
+                fwk.find_nodes_that_pass_filters(state, qpi.pod, nodes,
+                                                 pre_result, diagnosis)
+            if not diagnosis.unschedulable_plugins:
+                diagnosis.unschedulable_plugins = {"NodeResourcesFit"}
+            cached = diagnosis
+            if sig != 0:
+                diag_cache[sig] = cached
         err = FitError(qpi.pod, len(self.snapshot.node_info_list))
-        plugins = {"NodeResourcesFit"}
-        spec = qpi.pod.spec
-        if spec.node_selector or (spec.affinity and spec.affinity.node_affinity):
-            plugins.add("NodeAffinity")
-        if spec.node_name:
-            plugins.add("NodeName")
-        if any(p.host_port > 0 for c in spec.containers for p in c.ports):
-            plugins.add("NodePorts")
-        if spec.topology_spread_constraints:
-            plugins.add("PodTopologySpread")
-        if spec.affinity and (spec.affinity.pod_affinity
-                              or spec.affinity.pod_anti_affinity):
-            plugins.add("InterPodAffinity")
-        elif self.snapshot.have_pods_with_required_anti_affinity_list:
-            # existing pods' anti-affinity can veto any pod
-            plugins.add("InterPodAffinity")
-        err.diagnosis.unschedulable_plugins = plugins
+        err.diagnosis = cached
         return err
 
     # -- scheduling: host path (oracle + fallback) ----------------------------
