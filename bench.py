@@ -21,6 +21,10 @@ Scheduler/APIServer; a shape bucket compiled in pass one is a cache hit in
 pass two regardless of the new Scheduler instance (the reported
 warm_pass_s / measured_pass_s gap makes any residual compile visible).
 
+Each measured run also appends its full Prometheus exposition to
+`bench_metrics.prom` (the reference benchmark scrapes /metrics the same
+way).
+
 Env:
   KTPU_BENCH_SMALL=1   500-node / small-pod quick variants
   KTPU_BENCH_VERBOSE=1 per-batch progress on stderr
@@ -58,7 +62,8 @@ def main() -> None:
         run_config(cfg, case, workload)           # warm: compiles all shapes
         warm_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        got = run_config(cfg, case, workload, verbose=verbose)
+        got = run_config(cfg, case, workload, verbose=verbose,
+                         metrics_path="bench_metrics.prom")
         measured_s = time.perf_counter() - t0
         if not got:
             raise SystemExit(f"workload {case}/{workload} not found")

@@ -51,6 +51,7 @@ class APICall:
 class APIDispatcher:
     client: object  # APIServer-shaped
     on_bind_error: Optional[Callable[[Pod, str, Exception], None]] = None
+    metrics: Optional[object] = None  # SchedulerMetrics (api_dispatcher_calls)
     _queue: dict[str, APICall] = field(default_factory=dict)  # uid → pending
     executed: int = 0
     errors: int = 0
@@ -78,8 +79,14 @@ class APIDispatcher:
                         call.pod, call.condition or {},
                         call.nominated_node_name)
                 self.executed += 1
+                if self.metrics is not None:
+                    self.metrics.api_dispatcher_calls.inc(
+                        call.call_type.value, "success")
             except Exception as e:
                 self.errors += 1
+                if self.metrics is not None:
+                    self.metrics.api_dispatcher_calls.inc(
+                        call.call_type.value, "error")
                 if (call.call_type == CallType.BIND
                         and self.on_bind_error is not None):
                     self.on_bind_error(call.pod, call.node_name, e)

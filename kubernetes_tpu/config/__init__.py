@@ -1,0 +1,185 @@
+"""Scheduler ComponentConfig: KubeSchedulerConfiguration-shaped setup.
+
+Mirrors pkg/scheduler/apis/config/types.go:37-98 (KubeSchedulerConfiguration
++ KubeSchedulerProfile :100-138, Plugins enable/disable :176-232) and the
+defaulting in apis/config/v1/default_plugins.go:30, reduced to the knobs
+this framework actually consumes:
+
+- per-profile scheduler name, plugin enable/disable by extension-point-free
+  name (our plugin objects carry all their extension points), plugin
+  weights (MultiPoint weights, default_plugins.go:93), and the scoring
+  strategy (NodeResourcesFitArgs.ScoringStrategy, types_pluginargs.go).
+- queue tuning: podInitialBackoffSeconds / podMaxBackoffSeconds
+  (types.go:80-87) and percentageOfNodesToScore (types.go:62).
+- TPU additions under the same roof: device batch size and the padded batch
+  dims — these replace the reference's Parallelism knob (types.go:58),
+  because on this architecture the device program IS the parallelism.
+
+`load(path)` / `from_dict` accept the YAML/dict form; `validate()` mirrors
+apis/config/validation/validation.go (duplicate profiles, unknown plugin
+names, non-positive backoffs); `build_profiles()` turns the config into the
+Scheduler's Profile list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import DEFAULT_SCHEDULER_NAME
+
+
+@dataclass
+class PluginSet:
+    """types.go:176 Plugins — enabled adds to defaults, disabled removes
+    ('*' disables all defaults first)."""
+
+    enabled: list[str] = field(default_factory=list)
+    disabled: list[str] = field(default_factory=list)
+
+
+@dataclass
+class KubeSchedulerProfile:
+    """types.go:100 KubeSchedulerProfile."""
+
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    plugins: PluginSet = field(default_factory=PluginSet)
+    # plugin name → weight (MultiPoint weight, default_plugins.go:93)
+    plugin_weights: dict[str, int] = field(default_factory=dict)
+    # NodeResourcesFit scoring strategy: LeastAllocated | MostAllocated
+    scoring_strategy: str = "LeastAllocated"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    """types.go:37 KubeSchedulerConfiguration (consumed subset)."""
+
+    profiles: list[KubeSchedulerProfile] = field(
+        default_factory=lambda: [KubeSchedulerProfile()])
+    percentage_of_nodes_to_score: int = 100          # types.go:62
+    pod_initial_backoff_seconds: float = 1.0         # types.go:80
+    pod_max_backoff_seconds: float = 10.0            # types.go:84
+    # TPU batch shape (replaces Parallelism, types.go:58)
+    batch_size: int = 512
+
+    # -- validation (apis/config/validation/validation.go) -------------------
+
+    def validate(self) -> None:
+        if not self.profiles:
+            raise ValueError("at least one profile is required")
+        names = [p.scheduler_name for p in self.profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate profile schedulerName in {names}")
+        if self.pod_initial_backoff_seconds <= 0:
+            raise ValueError("podInitialBackoffSeconds must be > 0")
+        if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
+            raise ValueError(
+                "podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
+        if not 0 < self.percentage_of_nodes_to_score <= 100:
+            raise ValueError("percentageOfNodesToScore must be in (0, 100]")
+        if self.batch_size <= 0:
+            raise ValueError("batchSize must be > 0")
+        known = set(_default_plugin_names())
+        for p in self.profiles:
+            for n in p.plugins.enabled + p.plugins.disabled:
+                if n not in known and n != "*":
+                    raise ValueError(f"unknown plugin {n!r} in profile "
+                                     f"{p.scheduler_name!r} (known: "
+                                     f"{sorted(known)})")
+            if p.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
+                raise ValueError(
+                    f"unknown scoringStrategy {p.scoring_strategy!r}")
+
+    # -- round trip ----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "profiles": [{
+                "schedulerName": p.scheduler_name,
+                "plugins": {"enabled": list(p.plugins.enabled),
+                            "disabled": list(p.plugins.disabled)},
+                "pluginWeights": dict(p.plugin_weights),
+                "scoringStrategy": p.scoring_strategy,
+            } for p in self.profiles],
+            "percentageOfNodesToScore": self.percentage_of_nodes_to_score,
+            "podInitialBackoffSeconds": self.pod_initial_backoff_seconds,
+            "podMaxBackoffSeconds": self.pod_max_backoff_seconds,
+            "batchSize": self.batch_size,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "KubeSchedulerConfiguration":
+        profiles = [
+            KubeSchedulerProfile(
+                scheduler_name=pd.get("schedulerName",
+                                      DEFAULT_SCHEDULER_NAME),
+                plugins=PluginSet(
+                    enabled=list(pd.get("plugins", {}).get("enabled", [])),
+                    disabled=list(pd.get("plugins", {}).get("disabled", []))),
+                plugin_weights=dict(pd.get("pluginWeights", {})),
+                scoring_strategy=pd.get("scoringStrategy", "LeastAllocated"))
+            for pd in d.get("profiles", [{}])
+        ] or [KubeSchedulerProfile()]
+        return KubeSchedulerConfiguration(
+            profiles=profiles,
+            percentage_of_nodes_to_score=d.get("percentageOfNodesToScore",
+                                               100),
+            pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds",
+                                              1.0),
+            pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
+            batch_size=d.get("batchSize", 512))
+
+
+def load(path: str) -> KubeSchedulerConfiguration:
+    """Load + validate a YAML KubeSchedulerConfiguration."""
+    import yaml
+    with open(path) as f:
+        cfg = KubeSchedulerConfiguration.from_dict(yaml.safe_load(f) or {})
+    cfg.validate()
+    return cfg
+
+
+def _default_plugin_names() -> list[str]:
+    from ..scheduler import default_plugins
+    return [p.name() for p in default_plugins()] + ["DefaultPreemption"]
+
+
+def build_profiles(cfg: KubeSchedulerConfiguration, client=None):
+    """Config → the Scheduler's Profile list (profile.NewMap analog,
+    profile/profile.go:46): defaults ± enable/disable, weights applied,
+    ScoreConfig strategy set per profile."""
+    from ..framework.runtime import Framework
+    from ..ops.program import ScoreConfig
+    from ..scheduler import DEFAULT_WEIGHTS, Profile, default_plugins
+
+    out = []
+    for p in cfg.profiles:
+        plugins = default_plugins(client)
+        if "*" in p.plugins.disabled:
+            plugins = []
+        else:
+            plugins = [pl for pl in plugins
+                       if pl.name() not in p.plugins.disabled]
+        have = {pl.name() for pl in plugins}
+        for name in p.plugins.enabled:
+            if name in have:
+                continue
+            pl = next((d for d in default_plugins(client)
+                       if d.name() == name), None)
+            if pl is not None:
+                plugins.append(pl)
+        weights = dict(DEFAULT_WEIGHTS)
+        weights.update(p.plugin_weights)
+        fwk = Framework(p.scheduler_name, plugins, weights=weights)
+        score_cfg = ScoreConfig(
+            strategy=p.scoring_strategy,
+            w_taint=weights.get("TaintToleration", 3),
+            w_node_affinity=weights.get("NodeAffinity", 2),
+            w_spread=weights.get("PodTopologySpread", 2),
+            w_ipa=weights.get("InterPodAffinity", 2),
+            w_fit=weights.get("NodeResourcesFit", 1),
+            w_balanced=weights.get("NodeResourcesBalancedAllocation", 1))
+        out.append(Profile(name=p.scheduler_name, framework=fwk,
+                           score_config=score_cfg,
+                           disabled_plugins=tuple(p.plugins.disabled)))
+    return out

@@ -1,0 +1,225 @@
+"""Scheduler metrics: labeled counters/gauges/histograms + text exposition.
+
+Mirrors pkg/scheduler/metrics/metrics.go (:196-460) in spirit and naming —
+the ~dozen series the reference dashboards actually read — on a minimal
+Prometheus-style registry (component-base/metrics stand-in):
+
+  scheduler_schedule_attempts_total{result,profile}
+  scheduler_scheduling_attempt_duration_seconds{result,profile}
+  scheduler_pod_scheduling_sli_duration_seconds{attempts}
+  scheduler_pending_pods{queue}
+  scheduler_preemption_attempts_total / scheduler_preemption_victims
+  scheduler_queue_incoming_pods_total{event,queue}
+  scheduler_permit_wait_duration_seconds{result}
+  scheduler_device_batch_size / scheduler_device_batch_duration_seconds
+  scheduler_api_dispatcher_calls_total{call_type,result}
+
+The TPU-specific device_* series replace the reference's goroutines/
+plugin-execution timers: on this architecture the device batch IS the
+execution unit worth observing.
+
+The reference offloads observations via MetricAsyncRecorder
+(metric_recorder.go) so the hot path never touches Prometheus locks; the
+single-threaded host loop has no lock contention, so observations are
+direct writes into plain dicts (cheaper than the reference's channel hop)
+and `Registry.exposition()` renders on scrape.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# reference metrics.go:73 SchedulerSubsystem
+SUBSYSTEM = "scheduler"
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str
+    label_names: tuple[str, ...] = ()
+    _values: dict[tuple[str, ...], float] = field(default_factory=dict)
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        key = tuple(labels)
+        self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *labels: str) -> float:
+        return self._values.get(tuple(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
+        return out
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str
+    label_names: tuple[str, ...] = ()
+    # a callback gauge computes its value at scrape time (queue depths)
+    callback: Optional[Callable[[], dict[tuple[str, ...], float]]] = None
+    _values: dict[tuple[str, ...], float] = field(default_factory=dict)
+
+    def set(self, value: float, *labels: str) -> None:
+        self._values[tuple(labels)] = value
+
+    def value(self, *labels: str) -> float:
+        if self.callback is not None:
+            return self.callback().get(tuple(labels), 0.0)
+        return self._values.get(tuple(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        values = self.callback() if self.callback is not None else self._values
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for key, v in sorted(values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
+        return out
+
+
+# metrics.go attempt-duration buckets: exponential 0.001 * 2^i
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    return [start * factor ** i for i in range(count)]
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    buckets: list[float] = field(
+        default_factory=lambda: exponential_buckets(0.001, 2, 15))
+    label_names: tuple[str, ...] = ()
+    _counts: dict[tuple[str, ...], list[int]] = field(default_factory=dict)
+    _sums: dict[tuple[str, ...], float] = field(default_factory=dict)
+    _totals: dict[tuple[str, ...], int] = field(default_factory=dict)
+
+    def observe(self, value: float, *labels: str) -> None:
+        key = tuple(labels)
+        counts = self._counts.get(key)
+        if counts is None:
+            counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+        counts[bisect.bisect_left(self.buckets, value)] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + value
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, *labels: str) -> int:
+        return self._totals.get(tuple(labels), 0)
+
+    def sum(self, *labels: str) -> float:
+        return self._sums.get(tuple(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for key, counts in sorted(self._counts.items()):
+            cumulative = 0
+            names = self.label_names + ("le",)
+            for le, c in zip(self.buckets, counts):
+                cumulative += c
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(names, key + (f'{le:g}',))} {cumulative}")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(names, key + ('+Inf',))} "
+                       f"{self._totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} "
+                       f"{self._sums[key]:g}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} "
+                       f"{self._totals[key]}")
+        return out
+
+
+class Registry:
+    """component-base metrics registry stand-in + /metrics exposition."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+
+    def register(self, metric):
+        if metric.name in self._metrics:
+            raise ValueError(f"metric {metric.name} already registered")
+        self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def exposition(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# scheduled result labels (metrics.go:76-86)
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+
+class SchedulerMetrics:
+    """The scheduler's series, bound to one Registry (metrics.go Register)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 queue_depths: Optional[Callable[[], dict]] = None):
+        r = self.registry = registry or Registry()
+        n = f"{SUBSYSTEM}_"
+        self.schedule_attempts = r.register(Counter(
+            n + "schedule_attempts_total",
+            "Number of attempts to schedule pods, by result and profile.",
+            ("result", "profile")))
+        self.attempt_duration = r.register(Histogram(
+            n + "scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (scheduling algorithm + binding).",
+            label_names=("result", "profile")))
+        self.sli_duration = r.register(Histogram(
+            n + "pod_scheduling_sli_duration_seconds",
+            "E2e latency from first queue add to binding, by attempt count.",
+            buckets=exponential_buckets(0.01, 2, 20),
+            label_names=("attempts",)))
+        self.pending_pods = r.register(Gauge(
+            n + "pending_pods",
+            "Pending pods by queue (active/backoff/unschedulable/gated).",
+            ("queue",), callback=queue_depths))
+        self.preemption_attempts = r.register(Counter(
+            n + "preemption_attempts_total",
+            "Total preemption attempts in the cluster."))
+        self.preemption_victims = r.register(Histogram(
+            n + "preemption_victims",
+            "Number of selected preemption victims.",
+            buckets=[1, 2, 4, 8, 16, 32, 64]))
+        self.queue_incoming_pods = r.register(Counter(
+            n + "queue_incoming_pods_total",
+            "Pods added to scheduling queues by event and queue.",
+            ("queue", "event")))
+        self.permit_wait_duration = r.register(Histogram(
+            n + "permit_wait_duration_seconds",
+            "Time pods spend parked at WaitOnPermit.",
+            label_names=("result",)))
+        self.device_batch_size = r.register(Histogram(
+            n + "device_batch_size",
+            "Pods assigned per device program dispatch.",
+            buckets=[1, 8, 32, 128, 512, 1024, 2048, 4096, 8192]))
+        self.device_batch_duration = r.register(Histogram(
+            n + "device_batch_duration_seconds",
+            "Wall time of one device batch (dispatch to readback)."))
+        self.api_dispatcher_calls = r.register(Counter(
+            n + "api_dispatcher_calls_total",
+            "API calls flushed by the dispatcher, by type and result.",
+            ("call_type", "result")))
+
+    def exposition(self) -> str:
+        return self.registry.exposition()

@@ -173,7 +173,7 @@ class WorkloadRunner:
 
     def run(self, tc: TestCase, wl: Workload, verbose: bool = False) -> list[DataItem]:
         api = APIServer()
-        sched = self.factory(api)
+        sched = self.last_scheduler = self.factory(api)
         params = wl.params
         items: list[DataItem] = []
         node_seq = 0
@@ -246,9 +246,11 @@ class WorkloadRunner:
 
 
 def run_config(path: str, case_filter: str = "", workload_filter: str = "",
-               verbose: bool = False,
-               scheduler_factory=None) -> list[tuple[DataItem, float]]:
-    """Run matching (case, workload) pairs; returns [(item, threshold)]."""
+               verbose: bool = False, scheduler_factory=None,
+               metrics_path: str = "") -> list[tuple[DataItem, float]]:
+    """Run matching (case, workload) pairs; returns [(item, threshold)].
+    `metrics_path` appends each run's Prometheus exposition (the reference
+    benchmark collects /metrics the same way, scheduler_perf/util.go)."""
     out = []
     for tc in load_test_cases(path):
         if case_filter and case_filter != tc.name:
@@ -259,4 +261,8 @@ def run_config(path: str, case_filter: str = "", workload_filter: str = "",
             runner = WorkloadRunner(scheduler_factory=scheduler_factory)
             for item in runner.run(tc, wl, verbose=verbose):
                 out.append((item, wl.threshold))
+            if metrics_path:
+                with open(metrics_path, "a") as f:
+                    f.write(f"# == {tc.name}/{wl.name} ==\n")
+                    f.write(runner.last_scheduler.metrics.exposition())
     return out

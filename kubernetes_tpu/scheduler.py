@@ -86,14 +86,9 @@ def pod_update_action(old: Pod, new: Pod) -> ActionType:
         flags |= ActionType.UPDATE_POD_SCHEDULING_GATES
     if new.spec.tolerations != old.spec.tolerations:
         flags |= ActionType.UPDATE_POD_TOLERATION
-    old_req: dict[str, int] = {}
-    for c in old.spec.containers:
-        for k, v in c.requests.items():
-            old_req[k] = old_req.get(k, 0) + v
-    new_req: dict[str, int] = {}
-    for c in new.spec.containers:
-        for k, v in c.requests.items():
-            new_req[k] = new_req.get(k, 0) + v
+    from .api import resources as res
+    old_req = res.pod_requests(old)
+    new_req = res.pod_requests(new)
     if any(new_req.get(k, 0) < v for k, v in old_req.items()):
         flags |= ActionType.UPDATE_POD_SCALE_DOWN
     return flags
@@ -132,6 +127,8 @@ class Profile:
     # True when every reserve/permit plugin is gang-only: non-gang pods can
     # then skip the per-bind framework hooks entirely (hot path)
     gang_only_hooks: bool = False
+    # plugin names the config disabled (auto-wiring must not re-add them)
+    disabled_plugins: tuple = ()
 
 
 @dataclass
@@ -144,6 +141,7 @@ class _WaitingPodRec:
     node_name: str
     cycle_state: CycleState
     deadline: float
+    parked_at: float = 0.0
     wait_plugin: str = ""
 
 
@@ -169,13 +167,31 @@ class Scheduler:
 
     def __init__(self, client: APIServer,
                  profiles: Optional[list[Profile]] = None,
-                 batch_size: int = 512,
+                 batch_size: Optional[int] = None,
                  batch_dims: Optional[BatchDims] = None,
                  clock: Callable[[], float] = _time.monotonic,
-                 percentage_of_nodes_to_score: int = 100):
+                 percentage_of_nodes_to_score: Optional[int] = None,
+                 config=None,
+                 metrics=None):
+        """`config` is a config.KubeSchedulerConfiguration — when given it
+        supplies profiles, batch size, backoffs and sampling percentage;
+        explicitly passed arguments win over the config's values."""
         self.client = client
         self.clock = clock
-        self.batch_size = batch_size
+        queue_backoffs = {}
+        if config is not None:
+            config.validate()
+            from .config import build_profiles
+            if profiles is None:
+                profiles = build_profiles(config, client)
+            if batch_size is None:
+                batch_size = config.batch_size
+            if percentage_of_nodes_to_score is None:
+                percentage_of_nodes_to_score = config.percentage_of_nodes_to_score
+            queue_backoffs = dict(
+                pod_initial_backoff=config.pod_initial_backoff_seconds,
+                pod_max_backoff=config.pod_max_backoff_seconds)
+        self.batch_size = 512 if batch_size is None else batch_size
         if profiles is None:
             fwk = Framework(DEFAULT_SCHEDULER_NAME, default_plugins(client),
                             weights=dict(DEFAULT_WEIGHTS))
@@ -199,7 +215,12 @@ class Scheduler:
         self.queue = SchedulingQueue(
             pre_enqueue=default_fwk.run_pre_enqueue_plugins,
             queueing_hints=self._build_queueing_hints(default_fwk),
-            clock=clock)
+            clock=clock, **queue_backoffs)
+
+        from .metrics import SchedulerMetrics
+        self.metrics = metrics or SchedulerMetrics(
+            queue_depths=self._queue_depths)
+        self.dispatcher.metrics = self.metrics
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -225,6 +246,8 @@ class Scheduler:
             dp = next((p for p in fwk.plugins
                        if isinstance(p, DefaultPreemption)), None)
             if dp is None:
+                if "DefaultPreemption" in prof.disabled_plugins:
+                    continue  # config turned preemption off for this profile
                 dp = DefaultPreemption()
                 fwk.plugins.append(dp)
                 fwk.post_filter_plugins.append(dp)
@@ -267,6 +290,15 @@ class Scheduler:
                 hints[p.name()] = list(p.events_to_register())
         return hints
 
+    def _queue_depths(self) -> dict:
+        gated = sum(1 for q in self.queue.unschedulable_pods.values()
+                    if q.gated)
+        return {("active",): float(len(self.queue.active_q)),
+                ("backoff",): float(len(self.queue.backoff_q)),
+                ("unschedulable",): float(
+                    len(self.queue.unschedulable_pods) - gated),
+                ("gated",): float(gated)}
+
     # -- framework.Handle surface for Permit plugins --------------------------
 
     def get_workload(self, namespace: str, name: str):
@@ -289,10 +321,19 @@ class Scheduler:
         rec = self._waiting_pods.pop(uid, None)
         if rec is None:
             return
+        self.metrics.permit_wait_duration.observe(
+            max(self.clock() - rec.parked_at, 0.0), "allowed")
         self.cache.finish_binding(rec.assumed)
         self.dispatcher.add(APICall(CallType.BIND, rec.assumed,
                                     node_name=rec.node_name))
         self.scheduled_count += 1
+        from .metrics import SCHEDULED
+        pod = rec.qpi.pod
+        self.metrics.schedule_attempts.inc(SCHEDULED,
+                                           pod.spec.scheduler_name)
+        start = rec.qpi.initial_attempt_timestamp or rec.qpi.timestamp
+        self.metrics.sli_duration.observe(max(self.clock() - start, 0.0),
+                                          str(rec.qpi.attempts or 1))
         rec.qpi.unschedulable_plugins = set()
         rec.qpi.consecutive_errors_count = 0
 
@@ -302,6 +343,8 @@ class Scheduler:
         rec = self._waiting_pods.pop(uid, None)
         if rec is None:
             return
+        self.metrics.permit_wait_duration.observe(
+            max(self.clock() - rec.parked_at, 0.0), "rejected")
         pod = rec.qpi.pod
         profile = self.profiles.get(pod.spec.scheduler_name)
         if profile is not None:
@@ -345,6 +388,9 @@ class Scheduler:
                 EVENT_ASSIGNED_POD_ADD, None, pod)
         elif self._responsible(pod):
             self.queue.add(pod)
+            gated = (pod.uid in self.queue.unschedulable_pods)
+            self.metrics.queue_incoming_pods.inc(
+                "gated" if gated else "active", "PodAdd")
             if pod.spec.workload_ref:
                 # a new gang member can un-gate ITS group (PreEnqueue
                 # quorum); other gangs' quorums are unaffected
@@ -535,15 +581,31 @@ class Scheduler:
             carry = carry._replace(groups=gcarry)
             self._seeded_rows = self.builder.table_used
         table = table_from_batch(segment_batch)
+        t0 = _time.perf_counter()
         carry, assignments = self._run_device_program(
             profile.score_config, na, carry, segment_batch, table,
             len(qpis), groups_needed)
+        batch_dt = _time.perf_counter() - t0
+        self.metrics.device_batch_duration.observe(batch_dt)
+        self.metrics.device_batch_size.observe(len(qpis))
+        # per-attempt latency: the device batch amortizes one scheduling
+        # algorithm pass over the whole drain (metrics.go:214 analog), so
+        # each pod's attempt cost is the batch wall time split evenly
+        per_pod = batch_dt / max(len(qpis), 1)
+        from .metrics import SCHEDULED, UNSCHEDULABLE
+        n_ok = int((assignments >= 0).sum())
+        if n_ok:
+            self.metrics.attempt_duration.observe(per_pod, SCHEDULED,
+                                                  profile.name)
+        if len(qpis) - n_ok:
+            self.metrics.attempt_duration.observe(per_pod, UNSCHEDULABLE,
+                                                  profile.name)
         # the carry stays device-resident: the only readback per batch is the
         # assignment vector
         self._device_carry = carry
         self.device_batches += 1
         bound = 0
-        diag_cache: dict[int, object] = {}
+        diag_cache: dict = {}
         for i, (qpi, a) in enumerate(zip(qpis, assignments)):
             self.schedule_attempts += 1
             if a >= 0:
@@ -551,8 +613,7 @@ class Scheduler:
                 self._assume_and_bind(qpi, node_name)
                 bound += 1
             else:
-                err = self._device_fit_error(
-                    qpi, profile, int(segment_batch.sig[i]), diag_cache)
+                err = self._device_fit_error(qpi, profile, diag_cache)
                 self._handle_failure(qpi, err)
         return bound
 
@@ -733,7 +794,7 @@ class Scheduler:
         return self.state.reconcile(self.snapshot)
 
     def _device_fit_error(self, qpi: QueuedPodInfo, profile: Profile,
-                          sig: int, diag_cache: dict) -> FitError:
+                          diag_cache: dict) -> FitError:
         """The device reports only global infeasibility; run the host
         oracle's FILTER phase once per failed signature per batch to
         recover the exact per-node statuses and rejecting plugins —
@@ -743,7 +804,10 @@ class Scheduler:
         makes mass failures (a full cluster rejecting a homogeneous tail)
         cost ONE host filter sweep per batch instead of one per pod."""
         from .framework.types import Diagnosis
-        cached = diag_cache.get(sig) if sig != 0 else None
+        # content key, not the numeric sig id: host-port pods carry sig 0
+        # yet still share identical filter outcomes
+        sig = BatchBuilder._sig_key(qpi.pod)
+        cached = diag_cache.get(sig)
         if cached is None:
             fwk = profile.framework
             nodes = self.snapshot.node_info_list
@@ -760,9 +824,7 @@ class Scheduler:
                                                  pre_result, diagnosis)
             if not diagnosis.unschedulable_plugins:
                 diagnosis.unschedulable_plugins = {"NodeResourcesFit"}
-            cached = diagnosis
-            if sig != 0:
-                diag_cache[sig] = cached
+            diag_cache[sig] = cached = diagnosis
         err = FitError(qpi.pod, len(self.snapshot.node_info_list))
         err.diagnosis = cached
         return err
@@ -870,15 +932,22 @@ class Scheduler:
                 # assumed; a later gang member's Permit (or the timeout
                 # sweep in flush_queues) resolves it
                 self.queue.done(pod.uid)
+                now = self.clock()
                 self._waiting_pods[pod.uid] = _WaitingPodRec(
                     qpi=qpi, assumed=assumed, node_name=node_name,
-                    cycle_state=cs, deadline=self.clock() + wait_timeout,
-                    wait_plugin=status.plugin)
+                    cycle_state=cs, deadline=now + wait_timeout,
+                    parked_at=now, wait_plugin=status.plugin)
                 return
         self.queue.done(pod.uid)
         self.cache.finish_binding(assumed)
         self.dispatcher.add(APICall(CallType.BIND, assumed, node_name=node_name))
         self.scheduled_count += 1
+        from .metrics import SCHEDULED
+        self.metrics.schedule_attempts.inc(
+            SCHEDULED, pod.spec.scheduler_name)
+        start = qpi.initial_attempt_timestamp or qpi.timestamp
+        self.metrics.sli_duration.observe(
+            max(self.clock() - start, 0.0), str(qpi.attempts or 1))
         qpi.unschedulable_plugins = set()
         qpi.consecutive_errors_count = 0
 
@@ -932,6 +1001,12 @@ class Scheduler:
                 pod.status.nominated_node_name = nominated
                 self.queue.nominator.add(qpi, nominated)
                 self.preemption_attempts += 1
+                self.metrics.preemption_attempts.inc()
+        from .metrics import UNSCHEDULABLE
+        self.metrics.schedule_attempts.inc(
+            UNSCHEDULABLE, pod.spec.scheduler_name)
+        self.metrics.queue_incoming_pods.inc("unschedulable",
+                                             "ScheduleAttemptFailure")
         self.queue.add_unschedulable_if_not_present(qpi)
         self.dispatcher.add(APICall(
             CallType.STATUS_PATCH, qpi.pod,

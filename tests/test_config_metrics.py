@@ -1,0 +1,180 @@
+"""ComponentConfig (config/) + metrics registry (metrics/).
+
+Mirrors the consumed subset of apis/config/types.go:37 and
+metrics/metrics.go:196-460: config round-trip + validation, profile
+construction from config (enable/disable/weights/strategy), and the
+scheduler's series moving during real scheduling.
+"""
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.config import (KubeSchedulerConfiguration,
+                                   KubeSchedulerProfile, PluginSet,
+                                   build_profiles)
+from kubernetes_tpu.metrics import (Counter, Gauge, Histogram, Registry,
+                                    SchedulerMetrics)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class TestConfig:
+    def test_round_trip(self):
+        cfg = KubeSchedulerConfiguration(
+            profiles=[
+                KubeSchedulerProfile(scheduler_name="default-scheduler"),
+                KubeSchedulerProfile(
+                    scheduler_name="batch",
+                    plugins=PluginSet(disabled=["InterPodAffinity"]),
+                    plugin_weights={"TaintToleration": 5},
+                    scoring_strategy="MostAllocated"),
+            ],
+            pod_initial_backoff_seconds=0.5,
+            pod_max_backoff_seconds=5.0,
+            batch_size=1024)
+        cfg.validate()
+        again = KubeSchedulerConfiguration.from_dict(cfg.to_dict())
+        assert again == cfg
+
+    def test_yaml_load(self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("""
+profiles:
+- schedulerName: default-scheduler
+  pluginWeights: {NodeAffinity: 7}
+batchSize: 256
+podInitialBackoffSeconds: 2
+podMaxBackoffSeconds: 20
+""")
+        from kubernetes_tpu.config import load
+        cfg = load(str(p))
+        assert cfg.batch_size == 256
+        assert cfg.profiles[0].plugin_weights == {"NodeAffinity": 7}
+
+    def test_validation_rejects(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            KubeSchedulerConfiguration(profiles=[
+                KubeSchedulerProfile(), KubeSchedulerProfile()]).validate()
+        with pytest.raises(ValueError, match="unknown plugin"):
+            KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+                plugins=PluginSet(disabled=["NoSuchPlugin"]))]).validate()
+        with pytest.raises(ValueError, match="podMaxBackoff"):
+            KubeSchedulerConfiguration(
+                pod_initial_backoff_seconds=5,
+                pod_max_backoff_seconds=1).validate()
+        with pytest.raises(ValueError, match="scoringStrategy"):
+            KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+                scoring_strategy="Weird")]).validate()
+
+    def test_build_profiles_disable_and_weights(self):
+        cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+            plugins=PluginSet(disabled=["InterPodAffinity",
+                                        "PodTopologySpread"]),
+            plugin_weights={"NodeAffinity": 9})])
+        (prof,) = build_profiles(cfg)
+        names = {p.name() for p in prof.framework.plugins}
+        assert "InterPodAffinity" not in names
+        assert "PodTopologySpread" not in names
+        assert prof.framework.weights["NodeAffinity"] == 9
+        assert prof.score_config.w_node_affinity == 9
+
+    def test_scheduler_consumes_config(self):
+        cfg = KubeSchedulerConfiguration(
+            batch_size=128, pod_initial_backoff_seconds=2.0,
+            pod_max_backoff_seconds=30.0)
+        api = APIServer()
+        sched = Scheduler(api, config=cfg)
+        assert sched.batch_size == 128
+        assert sched.queue.pod_initial_backoff == 2.0
+        assert sched.queue.pod_max_backoff == 30.0
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+
+    def test_most_allocated_strategy_routes_to_scan(self):
+        """MostAllocated packs onto the fewest nodes (the closed form is
+        gated off; decisions still match the host oracle's strategy)."""
+        cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+            scoring_strategy="MostAllocated")])
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        for i in range(3):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 100}).obj())
+        for i in range(6):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 6
+        used = {p.spec.node_name for p in api.pods.values()}
+        assert len(used) == 1  # bin-packing: all on one node
+
+
+class TestMetricsPrimitives:
+    def test_counter_labels(self):
+        c = Counter("x_total", "help", ("a",))
+        c.inc("one")
+        c.inc("one")
+        c.inc("two", by=3)
+        assert c.value("one") == 2 and c.value("two") == 3
+        text = "\n".join(c.expose())
+        assert 'x_total{a="one"} 2' in text
+
+    def test_histogram_buckets(self):
+        h = Histogram("lat", "help", buckets=[0.1, 1.0])
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count() == 3 and h.sum() == 5.55
+        text = "\n".join(h.expose())
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 2' in text
+        assert 'lat_bucket{le="+Inf"} 3' in text
+
+    def test_registry_rejects_duplicates(self):
+        r = Registry()
+        r.register(Counter("dup", "h"))
+        with pytest.raises(ValueError):
+            r.register(Gauge("dup", "h"))
+
+
+class TestSchedulerMetrics:
+    def test_series_move_during_scheduling(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("big").req({"cpu": "64", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        m = sched.metrics
+        assert m.schedule_attempts.value("scheduled", "default-scheduler") == 3
+        assert m.schedule_attempts.value("unschedulable",
+                                         "default-scheduler") == 1
+        assert m.device_batch_size.count() >= 1
+        assert m.sli_duration.count("1") == 3
+        assert m.api_dispatcher_calls.value("pod_binding", "success") == 3
+        depths = sched._queue_depths()
+        assert depths[("unschedulable",)] == 1.0
+        text = m.exposition()
+        assert "scheduler_schedule_attempts_total" in text
+        assert "scheduler_pending_pods" in text
+
+    def test_disable_preemption_via_config(self):
+        cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+            plugins=PluginSet(disabled=["DefaultPreemption"]))])
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        api.create_pod(make_pod("low").req(
+            {"cpu": "4", "memory": "1Gi"}).priority(0).obj())
+        assert sched.schedule_pending() == 1
+        api.create_pod(make_pod("vip").req(
+            {"cpu": "4", "memory": "1Gi"}).priority(100).obj())
+        assert sched.schedule_pending() == 0
+        # preemption off: no eviction, no nomination
+        assert "default/low" in api.pods
+        assert api.pods["default/vip"].status.nominated_node_name == ""
+        assert sched.preemption_attempts == 0
