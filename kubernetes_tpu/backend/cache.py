@@ -255,6 +255,7 @@ class Cache:
         else:
             self._imputed_nodes.discard(node.name)
             item.info.node = node
+            item.info.sync_images()
         self._touch(item)
         self._node_tree_add(node)
         return item.info
@@ -265,6 +266,7 @@ class Cache:
             return self.add_node(new)
         old_zone = _zone_of(item.info.node)
         item.info.node = new
+        item.info.sync_images()
         self._touch(item)
         if old_zone != _zone_of(new):
             self._node_tree_remove(new.name, old_zone)

@@ -182,6 +182,17 @@ class NodeInfo:
     def __post_init__(self) -> None:
         if not self.generation:
             self.generation = next_generation()
+        if not self.image_sizes:
+            self.sync_images()
+
+    def sync_images(self) -> None:
+        """node.status.images → name→size map (cache.go updateImageStates:
+        every name of an image entry resolves to its size)."""
+        sizes: dict[str, int] = {}
+        for img in self.node.status.images:
+            for name in img.names:
+                sizes[name] = img.size_bytes
+        self.image_sizes = sizes
 
     @property
     def name(self) -> str:
@@ -197,7 +208,8 @@ class NodeInfo:
     def snapshot_clone(self) -> "NodeInfo":
         """NodeInfo.Snapshot(): structural copy sharing immutable PodInfos
         (types.go Snapshot) — mutation-safe for preemption dry runs."""
-        clone = NodeInfo(node=self.node, generation=self.generation)
+        clone = NodeInfo(node=self.node, generation=self.generation,
+                         image_sizes=dict(self.image_sizes))
         clone.pods = list(self.pods)
         clone.pods_with_affinity = list(self.pods_with_affinity)
         clone.pods_with_required_anti_affinity = list(
@@ -206,7 +218,6 @@ class NodeInfo:
         clone.non_zero_cpu = self.non_zero_cpu
         clone.non_zero_mem = self.non_zero_mem
         clone.used_ports.ports = set(self.used_ports.ports)
-        clone.image_sizes = dict(self.image_sizes)
         return clone
 
     # -- pod add/remove (reference types.go AddPodInfo/RemovePod) ------------

@@ -59,6 +59,7 @@ class ScoreConfig(NamedTuple):
     w_node_affinity: int = 2
     w_spread: int = 2                           # PodTopologySpread weight
     w_ipa: int = 2                              # InterPodAffinity weight
+    w_image: int = 1                            # ImageLocality weight
     strategy: str = "LeastAllocated"            # or MostAllocated
 
 
@@ -73,6 +74,7 @@ class SigCache(NamedTuple):
     static_mask: jnp.ndarray  # bool [N] — nodename/unsched/taints/selector/ports
     taint_raw: jnp.ndarray    # i64 [N] — PreferNoSchedule counts (pre-normalize)
     na_raw: jnp.ndarray       # i64 [N] — preferred-affinity weights (pre-normalize)
+    s_img: jnp.ndarray        # i64 [N] — ImageLocality score (carry-independent)
     fit_ok: jnp.ndarray       # bool [N]
     s_fit: jnp.ndarray        # i64 [N]
     s_bal: jnp.ndarray        # i64 [N]
@@ -208,6 +210,43 @@ def ports_mask(ports, pod_port_ids):
 # score kernels
 
 
+# single source of truth for the reference thresholds: the host plugin
+from ..plugins.imagelocality import (MAX_CONTAINER_THRESHOLD as
+                                     IMG_MAX_CONTAINER_THRESHOLD,
+                                     MIN_THRESHOLD as IMG_MIN_THRESHOLD)
+
+
+def image_locality_score(na: NodeArrays, pod, axis=None):
+    """image_locality.go:95-131 on device: per container image, the node's
+    stored size scaled by the image's cluster spread (numNodes/totalNodes,
+    float64 then truncated — the host plugin's exact arithmetic), summed,
+    clamped to [minThreshold, containers·maxContainerThreshold], mapped to
+    [0, 100]. Carry-independent: node images are static per snapshot."""
+    # presence[N, IC]: does node n hold image c; sizes via the same match
+    match = (na.image_id[:, :, None] == pod.img_ids[None, None, :]) & (
+        pod.img_ids[None, None, :] != 0)                     # [N, I, IC]
+    size_c = jnp.sum(jnp.where(match, na.image_size[:, :, None], 0),
+                     axis=1)                                 # [N, IC]
+    present_c = jnp.any(match, axis=1)                       # [N, IC]
+    # numNodesWithImage over valid nodes; total = schedulable node count —
+    # GLOBAL across shards (the spread ratio is a cluster-wide quantity)
+    num_with = jnp.sum(present_c & na.valid[:, None], axis=0)  # [IC]
+    total = jnp.sum(na.valid)
+    if axis is not None:
+        num_with = lax.psum(num_with, axis)
+        total = lax.psum(total, axis)
+    total = jnp.maximum(total, 1)
+    spread = num_with.astype(jnp.float64) / total.astype(jnp.float64)
+    scaled = (size_c.astype(jnp.float64) * spread[None, :]).astype(jnp.int64)
+    sum_scores = jnp.sum(scaled, axis=1)                     # [N]
+    nc = jnp.maximum(pod.img_containers, 1).astype(jnp.int64)
+    max_thr = IMG_MAX_CONTAINER_THRESHOLD * nc
+    clamped = jnp.clip(sum_scores, IMG_MIN_THRESHOLD, max_thr)
+    score = (MAX_SCORE * (clamped - IMG_MIN_THRESHOLD)
+             // jnp.maximum(max_thr - IMG_MIN_THRESHOLD, 1))
+    return jnp.where(pod.img_containers > 0, score, 0)
+
+
 def least_allocated(cfg: ScoreConfig, cap, used_cols):
     """least_allocated.go:30-60 exact int64 arithmetic, per node.
     cap/used_cols: [N, C] for the configured score columns. Padding rows
@@ -285,6 +324,8 @@ class PodTableDev(NamedTuple):
     pref_val: jnp.ndarray
     port_ids: jnp.ndarray
     skip_balanced: jnp.ndarray
+    img_ids: jnp.ndarray
+    img_containers: jnp.ndarray
 
 
 class PodXs(NamedTuple):
@@ -322,6 +363,8 @@ class PodRow(NamedTuple):
     pref_val: jnp.ndarray
     port_ids: jnp.ndarray
     skip_balanced: jnp.ndarray
+    img_ids: jnp.ndarray
+    img_containers: jnp.ndarray
 
 
 def _gather_row(table: PodTableDev, x) -> PodRow:
@@ -358,7 +401,8 @@ def _fit_scores(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
     return s_fit, s_bal
 
 
-def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
+def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
+                axis: str | None = None):
     """The full kernel set: everything SigCache caches, freshly computed.
     ports_mask folds into static_mask — pods eligible for the fast path
     carry no host ports (BatchBuilder gives them sig 0 otherwise), so the
@@ -371,9 +415,10 @@ def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
     m &= ports_mask(carry.ports, pod.port_ids)
     taint_raw = taint_prefer_count(na, pod)
     na_raw = preferred_affinity_score(na, pod)
+    s_img = image_locality_score(na, pod, axis=axis)
     fit_ok = fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods, pod.req)
     s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
-    return m, taint_raw, na_raw, fit_ok, s_fit, s_bal
+    return m, taint_raw, na_raw, s_img, fit_ok, s_fit, s_bal
 
 
 def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
@@ -400,6 +445,7 @@ def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
         static_mask=cache.static_mask,
         taint_raw=cache.taint_raw,
         na_raw=cache.na_raw,
+        s_img=cache.s_img,
         fit_ok=cache.fit_ok.at[best].set(
             jnp.where(gate, fit_ok_b, cache.fit_ok[best])),
         s_fit=cache.s_fit.at[best].set(
@@ -422,11 +468,11 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     shard."""
     cache = carry.cache
     use_fast = (pod.sig != 0) & (pod.sig == cache.sig)
-    m, taint_raw, na_raw, fit_ok, s_fit, s_bal = lax.cond(
+    m, taint_raw, na_raw, s_img, fit_ok, s_fit, s_bal = lax.cond(
         use_fast,
         lambda: (cache.static_mask, cache.taint_raw, cache.na_raw,
-                 cache.fit_ok, cache.s_fit, cache.s_bal),
-        lambda: _slow_parts(cfg, na, carry, pod))
+                 cache.s_img, cache.fit_ok, cache.s_fit, cache.s_bal),
+        lambda: _slow_parts(cfg, na, carry, pod, axis=axis))
 
     feasible = m & fit_ok
     if groups is not None:
@@ -438,13 +484,15 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     s_taint = default_normalize(taint_raw, feasible, reverse=True, axis=axis)
     s_na = default_normalize(na_raw, feasible, reverse=False, axis=axis)
     total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal
-             + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)
+             + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na
+             + cfg.w_image * s_img)
     if groups is not None:
         total = total + group_scores(cfg.w_spread, cfg.w_ipa, groups,
                                      carry.groups, tidx, feasible,
                                      axis=axis, n_global=n_global, fam=fam)
     parts = SigCache(sig=pod.sig, static_mask=m, taint_raw=taint_raw,
-                     na_raw=na_raw, fit_ok=fit_ok, s_fit=s_fit, s_bal=s_bal)
+                     na_raw=na_raw, s_img=s_img, fit_ok=fit_ok, s_fit=s_fit,
+                     s_bal=s_bal)
     return feasible, total, parts
 
 
@@ -558,7 +606,10 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     # static per-node score components (constant under the norm gate)
     s_taint = default_normalize(parts.taint_raw, feasible0, reverse=True)
     s_na = default_normalize(parts.na_raw, feasible0, reverse=False)
-    static_add = (cfg.w_taint * s_taint + cfg.w_node_affinity * s_na)[cand]
+    # ImageLocality is unnormalized and carry-independent: safe to fold
+    # into the per-candidate constant
+    static_add = (cfg.w_taint * s_taint + cfg.w_node_affinity * s_na
+                  + cfg.w_image * parts.s_img)[cand]
     static_m = parts.static_mask[cand]
     norm_ok = (jnp.max(jnp.where(feasible0, parts.taint_raw, 0)) == 0) & (
         jnp.max(jnp.where(feasible0, parts.na_raw, 0)) == 0)
@@ -636,7 +687,7 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     # (score ≤ 100·Σweights): TPU sorts int32 ~2× faster than int64.
     n_nodes = na.cap.shape[0]
     score_max = MAX_SCORE * (cfg.w_fit + cfg.w_balanced + cfg.w_taint
-                             + cfg.w_node_affinity)
+                             + cfg.w_node_affinity + cfg.w_image)
     M = n_nodes * J
     key_dt = jnp.int32 if (score_max + 2) * M < 2 ** 31 else jnp.int64
     ent_id = (cand[:, None].astype(key_dt) * J
@@ -663,7 +714,7 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     new_cache = SigCache(
         sig=pod.sig,
         static_mask=parts.static_mask, taint_raw=parts.taint_raw,
-        na_raw=parts.na_raw,
+        na_raw=parts.na_raw, s_img=parts.s_img,
         fit_ok=parts.fit_ok.at[cand].set(fit_kj[ar, cnt_i]),
         s_fit=parts.s_fit.at[cand].set(s_fit_kj[ar, cnt_i]),
         s_bal=parts.s_bal.at[cand].set(s_bal_kj[ar, cnt_i]))
@@ -688,6 +739,7 @@ def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:
         static_mask=jnp.zeros((n,), bool),
         taint_raw=jnp.zeros((n,), jnp.int64),
         na_raw=jnp.zeros((n,), jnp.int64),
+        s_img=jnp.zeros((n,), jnp.int64),
         fit_ok=jnp.zeros((n,), bool),
         s_fit=jnp.zeros((n,), jnp.int64),
         s_bal=jnp.zeros((n,), jnp.int64),

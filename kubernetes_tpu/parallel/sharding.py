@@ -50,6 +50,7 @@ _INT_MAX = jnp.iinfo(jnp.int32).max
 # the signature-cache sig is a replicated scalar; every other carry leaf is
 # sharded along the node axis
 _CACHE_SPEC = SigCache(sig=P(), static_mask=P(NODE_AXIS), taint_raw=P(NODE_AXIS),
+                       s_img=P(NODE_AXIS),
                        na_raw=P(NODE_AXIS), fit_ok=P(NODE_AXIS),
                        s_fit=P(NODE_AXIS), s_bal=P(NODE_AXIS))
 

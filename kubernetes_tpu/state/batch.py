@@ -61,6 +61,7 @@ TOL_EXISTS = 2
 @dataclass
 class BatchDims:
     table_rows: int = 16   # U — distinct signatures (grows by doubling)
+    images_per_pod: int = 8  # IC — container images per pod
     sel_terms: int = 4     # T — required node affinity terms
     sel_reqs: int = 6      # Q — requirements per term (incl. nodeSelector merge)
     sel_vals: int = 8      # V — values per requirement
@@ -94,6 +95,8 @@ class PodTable(NamedTuple):
     pref_val: object         # i32 [U, PT, Q, V]
     port_ids: object         # i32 [U, P]
     skip_balanced: object    # bool [U]
+    img_ids: object          # i32 [U, IC] — interned container images (0 = pad)
+    img_containers: object   # i32 [U] — container count (score threshold)
 
 
 class PodBatch(NamedTuple):
@@ -115,7 +118,6 @@ class BatchBuilder:
         from ..ops.groups import GroupManager
         self.state = state
         self.dims = dims or BatchDims()
-        self._cluster_has_images = False
         # signature key → ("row", sig_id, tidx) | ("fallback", reason)
         self._sig_cache: dict[tuple, tuple] = {}
         self._next_sig = 1
@@ -158,23 +160,12 @@ class BatchBuilder:
         B = pow2_at_least(max(len(pods), pad_to))
         if self.table.req.shape[1] != self.state.dims.resources:
             self._reset_table()  # resource table grew: row widths changed
-        arrays = self.state.arrays
-        self._cluster_has_images = bool(
-            arrays is not None and arrays.image_id.any())
-
         valid = np.zeros((B,), bool)
         fallback = np.zeros((B,), bool)
         sig = np.zeros((B,), np.int32)
         tidx = np.zeros((B,), np.int32)
         last = -1
         for i, pod in enumerate(pods):
-            if self._cluster_has_images and any(
-                    c.image for c in pod.spec.containers
-                    + pod.spec.init_containers):
-                # ImageLocality scoring has no tensor form yet: any pod with
-                # images in an image-reporting cluster keeps host semantics
-                fallback[i] = True
-                continue
             ent = self._lookup(pod)
             if ent[0] == "fallback":
                 fallback[i] = True
@@ -247,6 +238,8 @@ class BatchBuilder:
                          if p.host_port > 0)),
             tuple(spec.topology_spread_constraints),
             (aff.pod_affinity, aff.pod_anti_affinity) if aff else None,
+            tuple(c.image for c in (list(spec.init_containers)
+                                    + list(spec.containers))),
         )
 
     # -- row compilation ------------------------------------------------------
@@ -318,6 +311,17 @@ class BatchBuilder:
             raise BatchCapacityError("too many host ports")
         for q, (proto, port, _ip) in enumerate(ports):
             b.port_ids[i, q] = intr.port_id(proto, port)
+        # container images (ImageLocality device kernel; init containers
+        # score too, image_locality.go:95)
+        from ..plugins.imagelocality import normalized_image_name
+        containers = (list(pod.spec.init_containers)
+                      + list(pod.spec.containers))
+        imgs = [normalized_image_name(c.image) for c in containers if c.image]
+        if imgs and len(imgs) > d.images_per_pod:
+            raise BatchCapacityError("too many container images")
+        b.img_containers[i] = len(containers) if imgs else 0
+        for q, img in enumerate(imgs):
+            b.img_ids[i, q] = intr.image.intern(img)
 
     def _fill_term(self, term: NodeSelectorTerm, key_row, op_row, num_row, val_row) -> None:
         d = self.dims
@@ -395,4 +399,6 @@ def _zero_table(U: int, R: int, d: BatchDims) -> PodTable:
         pref_val=np.zeros((U, d.pref_terms, d.sel_reqs, d.sel_vals), np.int32),
         port_ids=np.zeros((U, d.ports), np.int32),
         skip_balanced=np.zeros((U,), bool),
+        img_ids=np.zeros((U, d.images_per_pod), np.int32),
+        img_containers=np.zeros((U,), np.int32),
     )

@@ -231,11 +231,33 @@ class ClusterState:
         a.ports[idx] = 0
         a.ports[idx, :len(port_ids)] = port_ids
         # images
+        if len(ni.image_sizes) > d.images:
+            # grow rather than truncate: the ImageLocality device kernel is
+            # authoritative now (no host fallback), so a dropped image row
+            # would silently corrupt scores
+            self._grow_images(len(ni.image_sizes))
+            a = self.arrays
         a.image_id[idx] = 0
         a.image_size[idx] = 0
-        for i, (img, size) in enumerate(sorted(ni.image_sizes.items())[:d.images]):
+        for i, (img, size) in enumerate(sorted(ni.image_sizes.items())):
             a.image_id[idx, i] = self.interner.image.intern(img)
             a.image_size[idx, i] = size
+
+    def _grow_images(self, needed: int) -> None:
+        self.dims.images = pow2_at_least(needed)
+        if self.arrays is not None:
+            a = self.arrays
+
+            def pad(x):
+                extra = self.dims.images - x.shape[1]
+                if extra <= 0:
+                    return x
+                return np.concatenate(
+                    [x, np.zeros((x.shape[0], extra), x.dtype)], axis=1)
+
+            self.arrays = a._replace(image_id=pad(a.image_id),
+                                     image_size=pad(a.image_size))
+        self._device_dirty = True
 
     def _grow_resources(self) -> None:
         self.dims.resources = self.rtable.width

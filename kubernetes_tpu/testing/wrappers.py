@@ -219,6 +219,12 @@ class NodeWrapper:
         self.node_obj.spec.unschedulable = v
         return self
 
+    def image(self, name: str, size_bytes: int) -> "NodeWrapper":
+        from ..api.types import ContainerImage
+        self.node_obj.status.images.append(
+            ContainerImage(names=(name,), size_bytes=size_bytes))
+        return self
+
     def zone(self, zone: str) -> "NodeWrapper":
         return self.label("topology.kubernetes.io/zone", zone)
 

@@ -38,3 +38,83 @@ def test_score_prefers_node_with_image():
     s_with, _ = pl.score(state, pod, ni_with)
     s_without, _ = pl.score(state, pod, ni_without)
     assert s_with > s_without == 0
+
+
+MBs = 1024 * 1024
+
+
+class TestDeviceParity:
+    """The tensor form (ops/program.py image_locality_score) must agree
+    with the host plugin on the same cluster — image-bearing pods no
+    longer fall back to the host oracle."""
+
+    def test_device_pod_prefers_image_node(self):
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(4):
+            n = make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 110})
+            if i == 2:
+                n = n.image("ml-train:latest", 900 * MBs)
+            api.create_node(n.obj())
+        for i in range(3):
+            pod = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj()
+            pod.spec.containers[0].image = "ml-train"
+            api.create_pod(pod)
+        assert sched.schedule_pending() == 3
+        # no host fallback: the batch stayed on device
+        assert sched.host_scheduled == 0
+        # the image node wins until resource scores outweigh it
+        assert api.pods["default/p0"].spec.node_name == "n2"
+
+    def test_device_matches_oracle_with_images(self):
+        import numpy as np
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.framework.runtime import schedule_pod
+        from kubernetes_tpu.scheduler import Scheduler
+        # two clusters, one scheduled by device, one by the host oracle
+        def build(run_min):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            sched.UNIFORM_RUN_MIN = run_min
+            for i in range(5):
+                n = make_node(f"n{i}").capacity(
+                    {"cpu": 16, "memory": "32Gi", "pods": 110})
+                if i % 2 == 0:
+                    n = n.image("app:v1", (300 + 100 * i) * MBs)
+                api.create_node(n.obj())
+            for i in range(12):
+                pod = make_pod(f"p{i}").req(
+                    {"cpu": "2", "memory": "1Gi"}).obj()
+                pod.spec.containers[0].image = "app:v1"
+                api.create_pod(pod)
+            assert sched.schedule_pending() == 12
+            return {p.name: p.spec.node_name for p in api.pods.values()}
+        fast = build(16)        # closed-form path
+        scan = build(10 ** 9)   # scan path
+        assert fast == scan
+
+    def test_many_images_grow_instead_of_truncate(self):
+        """A node holding more images than the padded dim must grow the
+        arrays — truncation would silently drop the pod's image and pick
+        the wrong node (reproduced in review)."""
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(3):
+            n = make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 110})
+            if i == 2:
+                for j in range(10):   # zz images sort past the default dim
+                    n = n.image(f"aa-filler-{j:02d}:latest", 50 * MBs)
+                n = n.image("zz-wanted:latest", 900 * MBs)
+            api.create_node(n.obj())
+        pod = make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj()
+        pod.spec.containers[0].image = "zz-wanted"
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 1
+        assert sched.host_scheduled == 0
+        assert api.pods["default/p"].spec.node_name == "n2"

@@ -170,3 +170,42 @@ def test_sharded_group_kernels_match_single_device(n_devices):
         np.testing.assert_array_equal(
             np.asarray(getattr(single_carry.groups, name)),
             np.asarray(getattr(sh_carry.groups, name)), err_msg=name)
+
+
+@pytest.mark.parametrize("n_devices", [4])
+def test_sharded_image_locality_matches_single_device(n_devices):
+    """Image spread ratios are cluster-wide: images clustered on ONE shard
+    must still produce the single-device assignment (the num_with/total
+    reduction needs a psum, not a shard-local sum)."""
+    if len(jax.devices()) < n_devices:
+        pytest.skip("not enough virtual devices")
+    MB = 1024 * 1024
+    cache = Cache()
+    for i in range(16):
+        w = make_node(f"n{i}").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110})
+        if i < 4:  # all images land on the first shard
+            w = w.image("app:v1", 700 * MB)
+        cache.add_node(w.obj())
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    pods = []
+    for i in range(8):
+        p = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj()
+        p.spec.containers[0].image = "app:v1"
+        pods.append(p)
+    batch = builder.build(pods)
+    assert not batch.host_fallback.any()
+    xs, table = pod_rows_from_batch(batch)
+    cfg = ScoreConfig()
+    na = state.device_arrays()
+    _, single_assign = run_batch(cfg, na, initial_carry(na), xs, table)
+    mesh = make_mesh(n_devices)
+    na_sh = shard_node_arrays(mesh, na)
+    _, sh_assign = run_batch_sharded(cfg, mesh, na_sh,
+                                     initial_carry(na_sh), xs, table)
+    np.testing.assert_array_equal(np.asarray(single_assign),
+                                  np.asarray(sh_assign))
