@@ -341,7 +341,10 @@ class Pod:
     def clone(self) -> "Pod":
         # hot path (2 clones per scheduled pod): raw __dict__ copies — both
         # copy.copy (reduce protocol) and dataclasses.replace (re-runs
-        # __init__) are several times slower
+        # __init__) are several times slower.
+        # ALIASING CONTRACT: containers (and their request dicts) are
+        # SHARED with the original — treat Container/requests as immutable
+        # after creation; any mutation must replace, not update in place.
         p = _shallow(self)
         p.metadata = _shallow(self.metadata)
         p.metadata.labels = dict(self.metadata.labels)

@@ -178,7 +178,8 @@ def build_profiles(cfg: KubeSchedulerConfiguration, client=None):
             w_spread=weights.get("PodTopologySpread", 2),
             w_ipa=weights.get("InterPodAffinity", 2),
             w_fit=weights.get("NodeResourcesFit", 1),
-            w_balanced=weights.get("NodeResourcesBalancedAllocation", 1))
+            w_balanced=weights.get("NodeResourcesBalancedAllocation", 1),
+            w_image=weights.get("ImageLocality", 1))
         out.append(Profile(name=p.scheduler_name, framework=fwk,
                            score_config=score_cfg,
                            disabled_plugins=tuple(p.plugins.disabled)))

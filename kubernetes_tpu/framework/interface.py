@@ -190,8 +190,3 @@ class EnqueueExtensions(Protocol):
     def events_to_register(self) -> list: ...
 
 
-class SignPlugin(Protocol):
-    """Reference: interface.go:668 — contribute a fragment to the pod
-    signature used to group identical-constraint pods into one batch."""
-
-    def sign(self, pod) -> tuple: ...

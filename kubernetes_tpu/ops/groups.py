@@ -192,6 +192,10 @@ def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None,
         tv_all = jnp.all(~ra_act[:, None] | (ra_tv != 0), axis=0)
         pods_exist = jnp.all(~ra_act[:, None] | (gc.ipa_a_cnt[tidx] > 0),
                              axis=0)
+        # sum==0 <=> len==0 for the reference's affinityCounts map: seed
+        # entries are built by counting (strictly positive) and the device
+        # path only ever increments — if a RemovePod-style decrement is
+        # ever added, this test must switch to an explicit entry count
         escape = (gc.ipa_a_total[tidx] == 0) & gd.ipa_self_all[tidx]
         mask &= jnp.where(jnp.any(ra_act), tv_all & (pods_exist | escape),
                           True)
@@ -446,22 +450,25 @@ class GroupManager:
         self.w_stc = np.zeros((U, U, d.ipa_cons_terms), np.int64)
         self.w_stp = np.zeros((U, U, d.ipa_plcd_terms), np.int64)
 
+    # pairwise [U, U, ...] matrices vs per-row [U, ...] arrays: classified
+    # by NAME, never by shape — a table_rows value that coincides with a
+    # term dimension must not flip a per-row array into the pairwise path
+    # (cf. sharding.py's _GD_NODE_FIELDS approach)
+    _PAIRWISE_FIELDS = frozenset(
+        {"m_spr_f", "m_spr_s", "m_ipa_a", "m_ipa_aa", "m_ipa_exist",
+         "w_stc", "w_stp"})
+    _ROW_FIELDS = ("spr_f_active", "spr_f_max_skew", "spr_f_self",
+                   "spr_s_active", "spr_s_max_skew", "spr_s_is_host",
+                   "ipa_ra_active", "ipa_raa_active", "ipa_self_all")
+
     def grow(self, U: int) -> None:
-        old = (self.spr_f_active, self.spr_f_max_skew, self.spr_f_self,
-               self.spr_s_active, self.spr_s_max_skew, self.spr_s_is_host,
-               self.ipa_ra_active, self.ipa_raa_active, self.ipa_self_all,
-               self.m_spr_f, self.m_spr_s, self.m_ipa_a, self.m_ipa_aa,
-               self.m_ipa_exist, self.w_stc, self.w_stp)
+        names = self._ROW_FIELDS + tuple(self._PAIRWISE_FIELDS)
+        old = {name: getattr(self, name) for name in names}
         u0 = len(self.rows)
         self._alloc(U)
-        names = ("spr_f_active", "spr_f_max_skew", "spr_f_self",
-                 "spr_s_active", "spr_s_max_skew", "spr_s_is_host",
-                 "ipa_ra_active", "ipa_raa_active", "ipa_self_all",
-                 "m_spr_f", "m_spr_s", "m_ipa_a", "m_ipa_aa",
-                 "m_ipa_exist", "w_stc", "w_stp")
-        for name, arr in zip(names, old):
+        for name, arr in old.items():
             new = getattr(self, name)
-            if arr.ndim >= 2 and arr.shape[1] == arr.shape[0]:  # [U, U, ...]
+            if name in self._PAIRWISE_FIELDS:
                 new[:u0, :u0] = arr[:u0, :u0]
             else:
                 new[:u0] = arr[:u0]
@@ -611,16 +618,77 @@ class GroupManager:
         nis = [(st.node_index.get(ni.name), ni)
                for ni in snapshot.node_info_list]
         nis = [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+        order_idx = np.array([idx for idx, _ in nis], np.int64)
 
-        def tv_fill(arr_row, key):
-            kid = {}
+        # per-CALL memos shared across every row and constraint: a topology
+        # key's interned tv vector is a property of the node set, not of
+        # the row, so the O(N) label walk runs once per distinct key
+        # instead of once per (row × constraint × term) — the reseed-cliff
+        # fix for many rows sharing zone/hostname keys.
+        tv_cache: dict[str, np.ndarray] = {}
+
+        def tv_vec(key: str) -> np.ndarray:
+            v = tv_cache.get(key)
+            if v is None:
+                v = np.zeros((N,), np.int32)
+                kid: dict[str, int] = {}
+                for idx, ni in nis:
+                    val = ni.node.metadata.labels.get(key)
+                    if val is not None:
+                        t = kid.get(val)
+                        if t is None:
+                            t = kid[val] = st.interner.label_kv(key, val)
+                        v[idx] = t
+                tv_cache[key] = v
+            return v
+
+        def keys_ok_vec(keys: list[str]) -> np.ndarray:
+            ok = np.zeros((N,), bool)
+            ok[order_idx] = True
+            for k in keys:
+                ok &= tv_vec(k) != 0        # interned ids start at 1
+            return ok
+
+        def dom_vec(tvv: np.ndarray) -> np.ndarray:
+            """Dense domain id = row index of the FIRST node (in snapshot
+            order) sharing the tv — vectorized equivalent of the previous
+            per-node setdefault walk."""
+            dom = np.zeros((N,), np.int32)
+            if len(order_idx) == 0:
+                return dom
+            sub = tvv[order_idx]
+            uniq, first_pos = np.unique(sub, return_index=True)
+            first_row = order_idx[first_pos]
+            dom[order_idx] = first_row[np.searchsorted(uniq, sub)]
+            return dom
+
+        def elig_vec(c, pod, keys: list[str]) -> np.ndarray:
+            """Count-eligibility per node (common.go:43-57). The common
+            case — no required node affinity on the pod, taints policy
+            Ignore — is pure vector math; only HONOR policies walk nodes."""
+            ok = keys_ok_vec(keys)
+            trivial_affinity = (
+                c.node_affinity_policy != HONOR
+                or (not pod.spec.node_selector
+                    and not (pod.spec.affinity
+                             and pod.spec.affinity.node_affinity
+                             and pod.spec.affinity.node_affinity.required)))
+            if trivial_affinity and c.node_taints_policy != HONOR:
+                return ok
             for idx, ni in nis:
-                v = ni.node.metadata.labels.get(key)
-                if v is not None:
-                    t = kid.get(v)
-                    if t is None:
-                        t = kid[v] = st.interner.label_kv(key, v)
-                    arr_row[idx] = t
+                if not ok[idx]:
+                    continue
+                labels = ni.node.metadata.labels
+                good = True
+                if c.node_affinity_policy == HONOR and not trivial_affinity:
+                    good = required_node_affinity_matches(pod, labels,
+                                                          ni.name)
+                if good and c.node_taints_policy == HONOR:
+                    good = find_matching_untolerated_taint(
+                        ni.node.spec.taints, pod.spec.tolerations,
+                        ("NoSchedule", "NoExecute")) is None
+                ok[idx] = good
+            return ok
 
         for r, u in enumerate(rows):
             info = self.rows[u] if u < len(self.rows) else None
@@ -631,55 +699,26 @@ class GroupManager:
             if info.f_constraints:
                 keys = [c.topology_key for c in info.f_constraints]
                 for j, c in enumerate(info.f_constraints):
-                    tv_fill(out["spr_f_tv"][r, j], c.topology_key)
-                for idx, ni in nis:
-                    labels = ni.node.metadata.labels
-                    if not all(k in labels for k in keys):
-                        continue
-                    for j, c in enumerate(info.f_constraints):
-                        ok = True
-                        if c.node_affinity_policy == HONOR:
-                            ok = required_node_affinity_matches(
-                                pod, labels, ni.name)
-                        if ok and c.node_taints_policy == HONOR:
-                            ok = find_matching_untolerated_taint(
-                                ni.node.spec.taints, pod.spec.tolerations,
-                                ("NoSchedule", "NoExecute")) is None
-                        out["spr_f_elig"][r, j, idx] = ok
+                    out["spr_f_tv"][r, j] = tv_vec(c.topology_key)
+                    out["spr_f_elig"][r, j] = elig_vec(c, pod, keys)
             # spread score
             if info.s_constraints:
                 keys = [c.topology_key for c in info.s_constraints]
+                out["spr_s_keys_ok"][r] = keys_ok_vec(keys)
                 for j, c in enumerate(info.s_constraints):
-                    tv_fill(out["spr_s_tv"][r, j], c.topology_key)
-                first_idx: list[dict] = [dict() for _ in info.s_constraints]
-                for idx, ni in nis:
-                    labels = ni.node.metadata.labels
-                    keys_ok = all(k in labels for k in keys)
-                    out["spr_s_keys_ok"][r, idx] = keys_ok
-                    for j, c in enumerate(info.s_constraints):
-                        tv = out["spr_s_tv"][r, j, idx]
-                        dom = first_idx[j].setdefault(int(tv), idx)
-                        out["spr_s_dom"][r, j, idx] = dom
-                        if not keys_ok:
-                            continue
-                        ok = True
-                        if c.node_affinity_policy == HONOR:
-                            ok = required_node_affinity_matches(
-                                pod, labels, ni.name)
-                        if ok and c.node_taints_policy == HONOR:
-                            ok = find_matching_untolerated_taint(
-                                ni.node.spec.taints, pod.spec.tolerations,
-                                ("NoSchedule", "NoExecute")) is None
-                        out["spr_s_elig"][r, j, idx] = ok
+                    tvv = tv_vec(c.topology_key)
+                    out["spr_s_tv"][r, j] = tvv
+                    out["spr_s_dom"][r, j] = dom_vec(tvv)
+                    out["spr_s_elig"][r, j] = elig_vec(c, pod, keys)
             # inter-pod affinity term topology values
             for t, term in enumerate(info.req_a):
-                tv_fill(out["ipa_ra_tv"][r, t], term.topology_key)
+                out["ipa_ra_tv"][r, t] = tv_vec(term.topology_key)
             for t, term in enumerate(info.req_aa):
-                tv_fill(out["ipa_raa_tv"][r, t], term.topology_key)
+                out["ipa_raa_tv"][r, t] = tv_vec(term.topology_key)
             for t, (term, _w) in enumerate(info.stc_terms):
-                tv_fill(out["ipa_stc_tv"][r, t], term.topology_key)
+                out["ipa_stc_tv"][r, t] = tv_vec(term.topology_key)
             for t, (term, _w) in enumerate(info.stp_terms):
-                tv_fill(out["ipa_stp_tv"][r, t], term.topology_key)
+                out["ipa_stp_tv"][r, t] = tv_vec(term.topology_key)
         return out
 
     # -- count seeding --------------------------------------------------------

@@ -228,7 +228,10 @@ class WorkloadRunner:
                 deadline = time.time() + float(op.get("timeoutSeconds", 60))
                 while len(sched.queue) and time.time() < deadline:
                     sched.flush_queues()
-                    sched.schedule_pending()
+                    if sched.schedule_pending() == 0:
+                        # nothing schedulable right now: wait for backoffs
+                        # instead of spinning the drain loop
+                        time.sleep(0.05)
             elif code == "churn":
                 # churn mode "recreate" (scheduler_perf.go:870): create and
                 # delete pods/nodes repeatedly to exercise event handling

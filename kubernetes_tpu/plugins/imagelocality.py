@@ -82,7 +82,3 @@ class ImageLocality:
     def normalize_scores(self, state, pod, scores, node_names=None) -> Status:
         return Status.success()
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("images", tuple(normalized_image_name(c.image)
-                                for c in (list(pod.spec.init_containers)
-                                          + list(pod.spec.containers))))

@@ -483,14 +483,6 @@ class InterPodAffinity:
             scores[i] = int(f)
         return Status.success()
 
-    # -- signature ------------------------------------------------------------
-
-    def sign(self, pod: Pod) -> tuple:
-        aff = pod.spec.affinity
-        return ("interpodaffinity", pod.namespace,
-                tuple(sorted(pod.metadata.labels.items())),
-                (aff.pod_affinity, aff.pod_anti_affinity) if aff else None)
-
 
 def _required_anti_affinity_terms_of(pi: PodInfo) -> list[ParsedTerm]:
     """Parsed required anti-affinity terms of an existing pod, cached on the

@@ -58,8 +58,6 @@ class NodeName:
 
         return [CEWH(CE(ER.NODE, AT.ADD), after_node_add)]
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("nodename", pod.spec.node_name)
 
 
 class NodeUnschedulable:
@@ -98,11 +96,6 @@ class NodeUnschedulable:
 
         return [CEWH(CE(ER.NODE, AT.ADD | AT.UPDATE_NODE_TAINT),
                      after_node_change)]
-
-    def sign(self, pod: Pod) -> tuple:
-        return ("tolerations:unschedulable",
-                any(t.tolerates(self.TAINT) for t in pod.spec.tolerations))
-
 
 def find_matching_untolerated_taint(taints: list[Taint], tolerations: list[Toleration],
                                     effects: tuple[str, ...]) -> Optional[Taint]:
@@ -176,8 +169,6 @@ class TaintToleration:
         return [CEWH(CE(ER.NODE, AT.ADD | AT.UPDATE_NODE_TAINT),
                      after_node_change)]
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("tolerations", tuple(pod.spec.tolerations))
 
 
 class NodePorts:
@@ -226,9 +217,6 @@ class NodePorts:
         return [CEWH(CE(ER.NODE, AT.ADD), None),
                 CEWH(CE(ER.ASSIGNED_POD, AT.DELETE), after_pod_delete)]
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("hostports", tuple((p.protocol, p.host_port, p.host_ip)
-                                   for p in self._container_ports(pod)))
 
 
 class SchedulingGates:

@@ -163,8 +163,3 @@ class NodeAffinity:
                          ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
             after_node_change)]
 
-    def sign(self, pod: Pod) -> tuple:
-        aff = pod.spec.affinity
-        return ("nodeaffinity",
-                tuple(sorted(pod.spec.node_selector.items())),
-                aff.node_affinity if aff else None)

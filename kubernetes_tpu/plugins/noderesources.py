@@ -305,10 +305,6 @@ class Fit:
                 after_pod_event),
         ]
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("resources", tuple(sorted(res.pod_requests(pod).items())))
-
-
 def insufficient_resources(pod_request: dict[str, int], node_info: NodeInfo,
                            ignored: frozenset[str] = frozenset(),
                            ignored_groups: frozenset[str] = frozenset(),
@@ -386,5 +382,3 @@ class BalancedAllocation:
     def normalize_scores(self, state, pod, scores, node_names=None) -> Status:
         return Status.success()
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("resources", tuple(sorted(res.pod_requests(pod).items())))

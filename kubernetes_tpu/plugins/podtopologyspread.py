@@ -462,9 +462,3 @@ class PodTopologySpread:
 
     # -- signature ------------------------------------------------------------
 
-    def sign(self, pod: Pod) -> tuple:
-        return ("topologyspread",
-                tuple((c.max_skew, c.topology_key, c.when_unsatisfiable,
-                       c.label_selector, c.match_label_keys)
-                      for c in pod.spec.topology_spread_constraints),
-                tuple(sorted(pod.metadata.labels.items())))

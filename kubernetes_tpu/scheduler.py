@@ -192,6 +192,15 @@ class Scheduler:
                 pod_initial_backoff=config.pod_initial_backoff_seconds,
                 pod_max_backoff=config.pod_max_backoff_seconds)
         self.batch_size = 512 if batch_size is None else batch_size
+        # Compatibility knob (types.go:62): the reference samples nodes to
+        # bound filter cost; the TPU program filters ALL nodes in one
+        # vectorized pass, so 100 is both the default and the fast path.
+        # Values < 100 are accepted for config parity and treated as 100 —
+        # SURVEY §7: adaptive sampling is deliberately dropped because the
+        # full filter is cheaper than the bookkeeping it would save.
+        self.percentage_of_nodes_to_score = (
+            100 if percentage_of_nodes_to_score is None
+            else percentage_of_nodes_to_score)
         if profiles is None:
             fwk = Framework(DEFAULT_SCHEDULER_NAME, default_plugins(client),
                             weights=dict(DEFAULT_WEIGHTS))
@@ -274,6 +283,7 @@ class Scheduler:
         # budget). Any external mutation invalidates it; the next device
         # segment reseeds from the host snapshot.
         self._device_carry = None
+        self._carry_profile = None   # profile whose cfg filled the sig cache
         # group (spread / inter-pod affinity) device state lifecycle
         self._gd_dev = None          # GroupsDev (jnp) for the current carry
         self._gd_fam = None          # static active-family mask (jit key)
@@ -493,13 +503,36 @@ class Scheduler:
             # over until they resolve — nominations are short-lived (victim
             # deletes flush at the end of the previous cycle)
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
+        # route per profile (profile.go:46 Map lookup): a drain can mix
+        # schedulerNames; each maximal same-profile stretch runs with ITS
+        # weights/strategy, in queue order
+        bound = 0
+        i = 0
+        while i < len(qpis):
+            name = qpis[i].pod.spec.scheduler_name
+            j = i + 1
+            while (j < len(qpis)
+                   and qpis[j].pod.spec.scheduler_name == name):
+                j += 1
+            profile = self.profiles.get(name)
+            if profile is None:
+                for q in qpis[i:j]:
+                    self._schedule_one_host(q)  # drops unowned pods
+            else:
+                bound += self._schedule_profile_batch(qpis[i:j], profile)
+            i = j
+        return bound
+
+    def _schedule_profile_batch(self, qpis: list[QueuedPodInfo],
+                                profile: Profile) -> int:
         pods = [q.pod for q in qpis]
         self.cache.update_snapshot(self.snapshot)
         batch = self.builder.build(pods, snapshot=self.snapshot,
                                    pad_to=self.batch_size)
         if not batch.host_fallback.any():
             # common case: whole drain is device-eligible; reuse this build
-            return self._schedule_device_segment(qpis, prebuilt=batch)
+            return self._schedule_device_segment(qpis, profile,
+                                                 prebuilt=batch)
         fallback = batch.host_fallback
         bound = 0
         i = 0
@@ -512,16 +545,22 @@ class Scheduler:
             j = i + 1
             while j < len(qpis) and not fallback[j]:
                 j += 1
-            bound += self._schedule_device_segment(qpis[i:j])
+            bound += self._schedule_device_segment(qpis[i:j], profile)
             i = j
         return bound
 
     def _schedule_device_segment(self, qpis: list[QueuedPodInfo],
-                                 prebuilt=None) -> int:
+                                 profile: Profile, prebuilt=None) -> int:
         from .ops.groups import scatter_new_rows, to_device
 
-        profile = next(iter(self.profiles.values()))
         carry = self._device_carry
+        if carry is not None and self._carry_profile != profile.name:
+            # the signature cache's s_fit/s_bal were computed under another
+            # profile's ScoreConfig: invalidate (sig 0 never matches)
+            import jax.numpy as _jnp
+            carry = carry._replace(
+                cache=carry.cache._replace(sig=_jnp.int32(0)))
+        self._carry_profile = profile.name
         if carry is None:
             # reseed device state from the host snapshot (first batch, or an
             # external event invalidated the resident carry)

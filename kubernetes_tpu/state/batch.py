@@ -219,7 +219,15 @@ class BatchBuilder:
         """Canonical content key. Namespace + labels are part of it because
         spread/affinity matching is SYMMETRIC: a pod's labels determine how
         it feeds other pods' selectors (signers.go includes labels for the
-        same reason)."""
+        same reason).
+
+        Cardinality caveat: per-pod-unique labels (statefulset pod-name,
+        controller hashes) mint one row each, and every new row costs O(U)
+        host selector matching plus a possible table doubling (carry
+        reseed). A conditional key (labels only when groups are active) is
+        NOT safe — rows persist across the groups on/off transition — so
+        high-churn unique-label workloads should bound table growth
+        instead; see PodTable growth handling."""
         spec = pod.spec
         aff = spec.affinity
         na = aff.node_affinity if aff else None

@@ -178,3 +178,45 @@ class TestSchedulerMetrics:
         assert "default/low" in api.pods
         assert api.pods["default/vip"].status.nominated_node_name == ""
         assert sched.preemption_attempts == 0
+
+
+class TestMultiProfile:
+    def test_two_profiles_route_by_scheduler_name(self):
+        """profile.go:46: a drain mixing schedulerNames must run each pod
+        under ITS profile's strategy — spread pods via LeastAllocated,
+        binpack pods via MostAllocated — on the device path."""
+        cfg = KubeSchedulerConfiguration(profiles=[
+            KubeSchedulerProfile(scheduler_name="default-scheduler"),
+            KubeSchedulerProfile(scheduler_name="binpack",
+                                 scoring_strategy="MostAllocated"),
+        ])
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 16, "memory": "32Gi", "pods": 100}).obj())
+        for i in range(4):
+            api.create_pod(make_pod(f"spread{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        for i in range(4):
+            p = make_pod(f"pack{i}").req({"cpu": "1", "memory": "1Gi"}).obj()
+            p.spec.scheduler_name = "binpack"
+            api.create_pod(p)
+        assert sched.schedule_pending() == 8
+        spread_nodes = {api.pods[f"default/spread{i}"].spec.node_name
+                        for i in range(4)}
+        pack_nodes = {api.pods[f"default/pack{i}"].spec.node_name
+                      for i in range(4)}
+        assert len(spread_nodes) == 4   # LeastAllocated round-robins
+        assert len(pack_nodes) == 1     # MostAllocated bin-packs
+
+    def test_unowned_scheduler_name_is_dropped(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        p = make_pod("alien").req({"cpu": "1", "memory": "1Gi"}).obj()
+        p.spec.scheduler_name = "someone-else"
+        api.create_pod(p)
+        assert sched.schedule_pending() == 0
+        assert api.pods["default/alien"].spec.node_name == ""
