@@ -291,6 +291,81 @@ class PodSchedulingGate:
 DEFAULT_SCHEDULER_NAME = "default-scheduler"  # reference: v1.DefaultSchedulerName
 
 
+# ---------------------------------------------------------------------------
+# storage (reference: core/v1 PersistentVolume[Claim], storage/v1 StorageClass
+# — the subset the scheduler's volume plugins consume)
+
+
+@dataclass
+class Volume:
+    """core/v1 Volume, reduced to the sources the scheduler inspects."""
+
+    name: str = ""
+    # persistentVolumeClaim.claimName ("" = not a PVC-backed volume)
+    claim_name: str = ""
+    # csi driver for inline CSI volumes (nodevolumelimits counting)
+    csi_driver: str = ""
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: str = ""
+    volume_name: str = ""                  # bound PV ("" = unbound)
+    # requested storage bytes (resources.requests["storage"])
+    requested_bytes: int = 0
+    access_modes: tuple[str, ...] = ("ReadWriteOnce",)
+    phase: str = "Pending"                 # Pending | Bound
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def is_bound(self) -> bool:
+        return bool(self.volume_name)
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity_bytes: int = 0
+    storage_class_name: str = ""
+    # claim currently bound to this PV ("" = Available)
+    claim_ref: str = ""                    # "<namespace>/<pvc name>"
+    access_modes: tuple[str, ...] = ("ReadWriteOnce",)
+    # volume.node_affinity.required (PV topology; local volumes / zonal disks)
+    node_affinity: Optional[NodeSelector] = None
+    csi_driver: str = ""                   # attachable-volume counting
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# storage/v1 VolumeBindingMode
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = BINDING_IMMEDIATE
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
 @dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
@@ -307,6 +382,8 @@ class PodSpec:
     host_network: bool = False
     # PreemptLowerPriority (default) | Never (core/v1 PreemptionPolicy)
     preemption_policy: str = "PreemptLowerPriority"
+    # volumes the scheduler inspects (PVC refs + inline CSI)
+    volumes: list[Volume] = field(default_factory=list)
     # gang scheduling: name of the Workload/pod-group this pod belongs to
     # (reference: scheduling/v1alpha1.Workload via pod labels; we model it as
     # a direct field + the label fallback used by workloadmanager).

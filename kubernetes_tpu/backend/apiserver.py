@@ -15,7 +15,8 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..api.types import Node, Pod, Workload
+from ..api.types import (Node, PersistentVolume, PersistentVolumeClaim,
+                         Pod, StorageClass, Workload)
 
 
 class Conflict(Exception):
@@ -42,10 +43,15 @@ class APIServer:
     pods: dict[str, Pod] = field(default_factory=dict)
     nodes: dict[str, Node] = field(default_factory=dict)
     workloads: dict[str, Workload] = field(default_factory=dict)
+    pvcs: dict[str, PersistentVolumeClaim] = field(default_factory=dict)
+    pvs: dict[str, PersistentVolume] = field(default_factory=dict)
+    storage_classes: dict[str, StorageClass] = field(default_factory=dict)
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
     workload_handlers: list[WatchHandlers] = field(default_factory=list)
+    pvc_handlers: list[WatchHandlers] = field(default_factory=list)
+    pv_handlers: list[WatchHandlers] = field(default_factory=list)
     binding_count: int = 0
 
     # -- watch registration ---------------------------------------------------
@@ -58,6 +64,12 @@ class APIServer:
 
     def watch_workloads(self, h: WatchHandlers) -> None:
         self.workload_handlers.append(h)
+
+    def watch_pvcs(self, h: WatchHandlers) -> None:
+        self.pvc_handlers.append(h)
+
+    def watch_pvs(self, h: WatchHandlers) -> None:
+        self.pv_handlers.append(h)
 
     # -- pods -----------------------------------------------------------------
 
@@ -171,3 +183,49 @@ class APIServer:
 
     def get_workload(self, name: str) -> Optional[Workload]:
         return self.workloads.get(name)
+
+    # -- storage (PVC / PV / StorageClass) ------------------------------------
+
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        self.pvcs[pvc.uid] = pvc
+        for h in self.pvc_handlers:
+            if h.on_add:
+                h.on_add(pvc)
+        return pvc
+
+    def get_pvc(self, namespace: str, name: str
+                ) -> Optional[PersistentVolumeClaim]:
+        return self.pvcs.get(f"{namespace}/{name}")
+
+    def bind_pvc(self, pvc: PersistentVolumeClaim,
+                 pv: PersistentVolume) -> None:
+        """PV controller's bind (the scheduler's PreBind triggers it):
+        claimRef + volumeName + phases flip atomically in this in-memory
+        model (pv_controller.go bind semantics)."""
+        old = dataclasses.replace(pvc)
+        pvc.volume_name = pv.name
+        pvc.phase = "Bound"
+        pv.claim_ref = pvc.uid
+        for h in self.pvc_handlers:
+            if h.on_update:
+                h.on_update(old, pvc)
+
+    def create_pv(self, pv: PersistentVolume) -> PersistentVolume:
+        self.pvs[pv.name] = pv
+        for h in self.pv_handlers:
+            if h.on_add:
+                h.on_add(pv)
+        return pv
+
+    def get_pv(self, name: str) -> Optional[PersistentVolume]:
+        return self.pvs.get(name)
+
+    def list_pvs(self) -> list[PersistentVolume]:
+        return list(self.pvs.values())
+
+    def create_storage_class(self, sc: StorageClass) -> StorageClass:
+        self.storage_classes[sc.name] = sc
+        return sc
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        return self.storage_classes.get(name)

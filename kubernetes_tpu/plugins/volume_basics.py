@@ -1,0 +1,211 @@
+"""NodeVolumeLimits (CSI), VolumeRestrictions, VolumeZone.
+
+Mirrors pkg/scheduler/framework/plugins/{nodevolumelimits,volumerestrictions,
+volumezone}:
+
+- NodeVolumeLimits (csi.go): count the node's attached CSI volumes per
+  driver (existing pods' PVC→PV→driver plus inline CSI volumes) and reject
+  when adding the pod's volumes would exceed the node's advertised
+  `attachable-volumes-csi-<driver>` allocatable. The reference resolves
+  limits through CSINode objects; our node model advertises the same
+  quantity directly in allocatable, which is where CSINode mirrors it from.
+- VolumeRestrictions (volume_restrictions.go): a ReadWriteOnce /
+  ReadWriteOncePod claim already mounted by a pod on ANOTHER node vetoes
+  this node set except the holder's (accessMode exclusivity); two pods on
+  the same node may share RWO (node-scoped mode).
+- VolumeZone (volume_zone.go): a bound PV carrying zone/region labels
+  restricts the pod to nodes whose matching topology labels agree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.types import Pod
+from ..framework.interface import CycleState, Status
+from ..framework.types import NodeInfo
+from .volumebinding import pod_pvc_names
+
+NODE_VOLUME_LIMITS = "NodeVolumeLimitsCSI"
+VOLUME_RESTRICTIONS = "VolumeRestrictions"
+VOLUME_ZONE = "VolumeZone"
+
+CSI_LIMIT_PREFIX = "attachable-volumes-csi-"
+
+# volume_zone.go topologyLabels
+ZONE_LABELS = ("topology.kubernetes.io/zone", "topology.kubernetes.io/region",
+               "failure-domain.beta.kubernetes.io/zone",
+               "failure-domain.beta.kubernetes.io/region")
+
+RWO = "ReadWriteOnce"
+RWOP = "ReadWriteOncePod"
+
+
+def _volume_driver(v, namespace: str, client) -> Optional[str]:
+    """The attachable volume's CSI driver (inline, or PVC→PV→driver)."""
+    if v.csi_driver:
+        return v.csi_driver
+    if v.claim_name and client is not None:
+        pvc = client.get_pvc(namespace, v.claim_name)
+        if pvc is not None and pvc.volume_name:
+            pv = client.get_pv(pvc.volume_name)
+            if pv is not None and pv.csi_driver:
+                return pv.csi_driver
+    return None
+
+
+def _attachment_key(v, namespace: str, pod_uid: str) -> str:
+    """A claim attaches once per node no matter how many pods mount it;
+    inline volumes attach per pod (csi.go uniqueVolumeName)."""
+    return (f"{namespace}/{v.claim_name}" if v.claim_name
+            else f"{pod_uid}/{v.name}")
+
+
+class NodeVolumeLimits:
+    """PF, F, EE — nodevolumelimits/csi.go."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def name(self) -> str:
+        return NODE_VOLUME_LIMITS
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        wanted = [v for v in pod.spec.volumes
+                  if _volume_driver(v, pod.namespace, self.client)]
+        if not wanted:
+            return Status.success()
+        limits = {k[len(CSI_LIMIT_PREFIX):]: v
+                  for k, v in node_info.allocatable.items()
+                  if k.startswith(CSI_LIMIT_PREFIX)}
+        if not limits:
+            return Status.success()
+        # unique attachments already on the node: attachment key → driver
+        # (a claim shared by several pods attaches exactly once)
+        attached: dict[str, str] = {}
+        for pi in node_info.pods:
+            for v in pi.pod.spec.volumes:
+                drv = _volume_driver(v, pi.pod.namespace, self.client)
+                if drv is not None:
+                    attached[_attachment_key(v, pi.pod.namespace,
+                                             pi.pod.uid)] = drv
+        counts: dict[str, int] = {}
+        for drv in attached.values():
+            counts[drv] = counts.get(drv, 0) + 1
+        # the pod's volumes add attachments only when not already attached
+        for v in wanted:
+            key = _attachment_key(v, pod.namespace, pod.uid)
+            if key in attached:
+                continue
+            drv = _volume_driver(v, pod.namespace, self.client)
+            attached[key] = drv
+            counts[drv] = counts.get(drv, 0) + 1
+            limit = limits.get(drv)
+            if limit is not None and counts[drv] > limit:
+                return Status.unschedulable(
+                    "node(s) exceed max volume count", plugin=self.name())
+        return Status.success()
+
+
+_VR_STATE_KEY = "PreFilter" + VOLUME_RESTRICTIONS
+
+
+class VolumeRestrictions:
+    """PF, F, EE — volumerestrictions/volume_restrictions.go. The
+    cross-cluster holder scan runs ONCE in PreFilter (the reference does
+    the same); Filter is a set lookup per node."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def name(self) -> str:
+        return VOLUME_RESTRICTIONS
+
+    def _exclusive_claims(self, pod: Pod) -> set[str]:
+        out = set()
+        for name in pod_pvc_names(pod):
+            pvc = (self.client.get_pvc(pod.namespace, name)
+                   if self.client else None)
+            if pvc is None:
+                continue
+            modes = set(pvc.access_modes)
+            if RWO in modes or RWOP in modes:
+                out.add(f"{pod.namespace}/{name}")
+        return out
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes
+                   ) -> tuple[Optional[object], Status]:
+        claims = self._exclusive_claims(pod)
+        if not claims:
+            return None, Status.skip()
+        holder_nodes: set[str] = set()
+        for ni in nodes:
+            for pi in ni.pods:
+                if pi.pod.uid == pod.uid:
+                    continue
+                for v in pi.pod.spec.volumes:
+                    if not v.claim_name:
+                        continue
+                    key = f"{pi.pod.namespace}/{v.claim_name}"
+                    if key not in claims:
+                        continue
+                    pvc = self.client.get_pvc(pi.pod.namespace,
+                                              v.claim_name)
+                    modes = set(pvc.access_modes) if pvc else set()
+                    if RWOP in modes:
+                        # ReadWriteOncePod: exclusive across ALL pods
+                        return None, Status.unschedulable(
+                            "pod uses a ReadWriteOncePod volume already "
+                            "in use", plugin=self.name())
+                    holder_nodes.add(ni.name)
+        state.write(_VR_STATE_KEY, holder_nodes)
+        return None, Status.success()
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        holder_nodes = state.read_or_none(_VR_STATE_KEY)
+        if not holder_nodes:
+            return Status.success()
+        if node_info.name not in holder_nodes:
+            # RWO: node-exclusive — only a holder's node works
+            return Status.unschedulable(
+                "volume is already attached to another node",
+                plugin=self.name())
+        return Status.success()
+
+
+class VolumeZone:
+    """F, EE — volumezone/volume_zone.go: bound PVs' zone labels must match
+    the node's topology labels."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def name(self) -> str:
+        return VOLUME_ZONE
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        if self.client is None:
+            return Status.success()
+        node_labels = node_info.node.metadata.labels
+        for name in pod_pvc_names(pod):
+            pvc = self.client.get_pvc(pod.namespace, name)
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = self.client.get_pv(pvc.volume_name)
+            if pv is None:
+                continue
+            for key in ZONE_LABELS:
+                want = pv.metadata.labels.get(key)
+                if want is None:
+                    continue
+                # reference allows the label value to be a __-separated set
+                allowed = set(want.split("__"))
+                have = node_labels.get(key)
+                if have is None or have not in allowed:
+                    return Status.unresolvable(
+                        "node(s) had no available volume zone",
+                        plugin=self.name())
+        return Status.success()

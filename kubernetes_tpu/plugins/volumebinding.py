@@ -1,0 +1,276 @@
+"""VolumeBinding: the PVC↔PV binding state machine.
+
+Mirrors pkg/scheduler/framework/plugins/volumebinding/ (volume_binding.go +
+binder.go, 2,472 LoC) reduced to the in-memory API model:
+
+- PreFilter (volume_binding.go:203): resolve the pod's PVCs; a missing PVC
+  is UnschedulableAndUnresolvable; a pod with no PVC-backed volumes Skips.
+- Filter (:268 → binder.FindPodVolumes, binder.go:285): per node, three
+  answers — bound PVCs' PVs must reach the node (PV nodeAffinity);
+  unbound WaitForFirstConsumer PVCs must find a matching Available PV
+  (findMatchingVolumes: class + access modes + capacity + nodeAffinity,
+  smallest-fitting-PV-first) or a provisioner (static binding falls back to
+  dynamic provisioning eligibility); unbound Immediate-class PVCs mean the
+  PV controller hasn't caught up — UnschedulableAndUnresolvable.
+- Reserve (:312 → AssumePodVolumes, binder.go:406): the chosen node's
+  matches are held in CycleState as assumed bindings (in-memory
+  AssumeCache analog — the same PV can't be matched twice in one cycle
+  thanks to the reserved set).
+- Unreserve (:341): drop assumed bindings, release reserved PVs.
+- PreBind (:327 → BindPodVolumes, binder.go:479): issue the API binds
+  (claimRef + volumeName); provisioning-bound claims mark the PVC Bound to
+  a synthesized provisioned PV (the in-memory PV controller).
+
+Scoring (scorer.go capacity-ratio shaping) is omitted — the Filter-side
+availability mask is what placement correctness needs; pods with volumes
+run on the host oracle path (no tensor form, by design: the state machine
+is API-coupled, SURVEY §2.4)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import (BINDING_WAIT_FOR_FIRST_CONSUMER, ObjectMeta,
+                         PersistentVolume, PersistentVolumeClaim, Pod)
+from ..framework.interface import CycleState, Status
+from ..framework.types import NodeInfo
+from .nodeaffinity import node_selector_matches
+
+NAME = "VolumeBinding"
+
+_STATE_KEY = "PreFilter" + NAME
+
+ERR_UNBOUND_IMMEDIATE = "pod has unbound immediate PersistentVolumeClaims"
+ERR_NO_MATCH = "node(s) didn't find available persistent volumes to bind"
+ERR_CONFLICT = "node(s) had volume node affinity conflict"
+
+
+def pod_pvc_names(pod: Pod) -> list[str]:
+    return [v.claim_name for v in pod.spec.volumes if v.claim_name]
+
+
+def pv_reaches_node(pv: PersistentVolume, node_info: NodeInfo) -> bool:
+    """CheckVolumeNodeAffinity (component-helpers volume/nodeaffinity)."""
+    if pv.node_affinity is None:
+        return True
+    return node_selector_matches(pv.node_affinity,
+                                 node_info.node.metadata.labels,
+                                 node_info.name)
+
+
+@dataclass
+class _PodVolumeState:
+    """binder.go PodVolumeClaims + per-node PodVolumes."""
+
+    bound_claims: list[PersistentVolumeClaim] = field(default_factory=list)
+    unbound_wffc: list[PersistentVolumeClaim] = field(default_factory=list)
+    # per-node: pvc uid → matched PV name (static binding candidates)
+    node_matches: dict[str, dict[str, str]] = field(default_factory=dict)
+    # per-node: pvc uids needing dynamic provisioning
+    node_provisions: dict[str, list[str]] = field(default_factory=dict)
+    # Reserve output: the chosen node's decisions
+    assumed_bindings: dict[str, str] = field(default_factory=dict)
+    assumed_provisions: list[str] = field(default_factory=list)
+
+    def clone(self) -> "_PodVolumeState":
+        return _PodVolumeState(
+            bound_claims=list(self.bound_claims),
+            unbound_wffc=list(self.unbound_wffc),
+            node_matches={k: dict(v) for k, v in self.node_matches.items()},
+            node_provisions={k: list(v)
+                             for k, v in self.node_provisions.items()},
+            assumed_bindings=dict(self.assumed_bindings),
+            assumed_provisions=list(self.assumed_provisions))
+
+
+class VolumeBinding:
+    """PF, F, R, PB, EE — reference volume_binding.go."""
+
+    def __init__(self, client=None):
+        self.client = client
+        # PVs reserved by assumed (not yet API-bound) pods: AssumeCache
+        # analog — a second pod in the same drain must not match them
+        self._reserved_pvs: dict[str, str] = {}   # pv name → pod uid
+
+    def name(self) -> str:
+        return NAME
+
+    # -- PreFilter (volume_binding.go:203) ------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes
+                   ) -> tuple[Optional[object], Status]:
+        claims = pod_pvc_names(pod)
+        if not claims:
+            return None, Status.skip()
+        if self.client is None:
+            return None, Status.error("volume binding needs a client",
+                                      plugin=NAME)
+        s = _PodVolumeState()
+        for name in claims:
+            pvc = self.client.get_pvc(pod.namespace, name)
+            if pvc is None:
+                return None, Status.unresolvable(
+                    f'persistentvolumeclaim "{name}" not found', plugin=NAME)
+            if pvc.is_bound():
+                s.bound_claims.append(pvc)
+                continue
+            sc = self.client.get_storage_class(pvc.storage_class_name)
+            mode = sc.volume_binding_mode if sc else None
+            if mode == BINDING_WAIT_FOR_FIRST_CONSUMER:
+                s.unbound_wffc.append(pvc)
+            else:
+                # Immediate (or unknown class): the PV controller owns the
+                # bind; until then the pod cannot schedule anywhere
+                return None, Status.unresolvable(ERR_UNBOUND_IMMEDIATE,
+                                                 plugin=NAME)
+        state.write(_STATE_KEY, s)
+        return None, Status.success()
+
+    # -- Filter (binder.go:285 FindPodVolumes) --------------------------------
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        s: Optional[_PodVolumeState] = state.read_or_none(_STATE_KEY)
+        if s is None:
+            return Status.success()
+        for pvc in s.bound_claims:
+            pv = self.client.get_pv(pvc.volume_name)
+            if pv is None or not pv_reaches_node(pv, node_info):
+                return Status.unschedulable(ERR_CONFLICT, plugin=NAME)
+        if not s.unbound_wffc:
+            return Status.success()
+        matches: dict[str, str] = {}
+        provisions: list[str] = []
+        used: set[str] = set(self._reserved_pvs)
+        for pvc in s.unbound_wffc:
+            pv = self._find_matching_pv(pvc, node_info, used)
+            if pv is not None:
+                matches[pvc.uid] = pv.name
+                used.add(pv.name)
+                continue
+            sc = self.client.get_storage_class(pvc.storage_class_name)
+            if sc is not None and sc.provisioner:
+                provisions.append(pvc.uid)
+                continue
+            return Status.unschedulable(ERR_NO_MATCH, plugin=NAME)
+        s.node_matches[node_info.name] = matches
+        s.node_provisions[node_info.name] = provisions
+        return Status.success()
+
+    def _find_matching_pv(self, pvc: PersistentVolumeClaim,
+                          node_info: NodeInfo,
+                          used: set[str]) -> Optional[PersistentVolume]:
+        """findMatchingVolume (pv/util.go): same class, access modes a
+        superset, enough capacity, reaches the node; the SMALLEST fitting
+        PV wins (waste minimization)."""
+        best: Optional[PersistentVolume] = None
+        for pv in self.client.list_pvs():
+            if pv.claim_ref or pv.name in used:
+                continue
+            if pv.storage_class_name != pvc.storage_class_name:
+                continue
+            if not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            if pv.capacity_bytes < pvc.requested_bytes:
+                continue
+            if not pv_reaches_node(pv, node_info):
+                continue
+            if best is None or pv.capacity_bytes < best.capacity_bytes:
+                best = pv
+        return best
+
+    # -- Reserve / Unreserve (binder.go:406/470) -------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        s: Optional[_PodVolumeState] = state.read_or_none(_STATE_KEY)
+        if s is None:
+            return Status.success()
+        s.assumed_bindings = dict(s.node_matches.get(node_name, {}))
+        s.assumed_provisions = list(s.node_provisions.get(node_name, []))
+        for pv_name in s.assumed_bindings.values():
+            self._reserved_pvs[pv_name] = pod.uid
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        s: Optional[_PodVolumeState] = state.read_or_none(_STATE_KEY)
+        if s is None:
+            return
+        for pv_name in s.assumed_bindings.values():
+            if self._reserved_pvs.get(pv_name) == pod.uid:
+                del self._reserved_pvs[pv_name]
+        s.assumed_bindings = {}
+        s.assumed_provisions = []
+
+    # -- PreBind (binder.go:479 BindPodVolumes) --------------------------------
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        s: Optional[_PodVolumeState] = state.read_or_none(_STATE_KEY)
+        if s is None:
+            return Status.success()
+        for pvc_uid, pv_name in s.assumed_bindings.items():
+            pvc = self.client.pvcs.get(pvc_uid)
+            pv = self.client.get_pv(pv_name)
+            if pvc is None or pv is None:
+                return Status.error(f"assumed binding vanished: {pvc_uid}",
+                                    plugin=NAME)
+            self.client.bind_pvc(pvc, pv)
+            self._reserved_pvs.pop(pv_name, None)
+        for pvc_uid in s.assumed_provisions:
+            pvc = self.client.pvcs.get(pvc_uid)
+            if pvc is None:
+                return Status.error(f"claim to provision vanished: {pvc_uid}",
+                                    plugin=NAME)
+            # in-memory provisioner: synthesize a node-pinned PV and bind it
+            # (the reference waits for the external provisioner; checkBindings
+            # polls — our API model completes synchronously)
+            from ..api.types import (LabelSelectorRequirement, NodeSelector,
+                                     NodeSelectorTerm)
+            pv = PersistentVolume(
+                metadata=ObjectMeta(name=f"pvc-{pvc.namespace}-{pvc.name}"),
+                capacity_bytes=pvc.requested_bytes,
+                storage_class_name=pvc.storage_class_name,
+                access_modes=pvc.access_modes,
+                node_affinity=NodeSelector(terms=(NodeSelectorTerm(
+                    match_fields=(LabelSelectorRequirement(
+                        key="metadata.name", operator="In",
+                        values=(node_name,)),)),)))
+            self.client.create_pv(pv)
+            self.client.bind_pvc(pvc, pv)
+        return Status.success()
+
+    # -- queueing hints --------------------------------------------------------
+
+    def events_to_register(self):
+        from ..backend.queue import ClusterEventWithHint
+        from ..framework.types import (ActionType, ClusterEvent,
+                                       EventResource, QueueingHint)
+
+        def after_pvc_change(pod: Pod, old, new):
+            obj = new if new is not None else old
+            if obj is None:
+                return QueueingHint.QUEUE
+            mine = set(pod_pvc_names(pod))
+            if (getattr(obj, "namespace", "") == pod.namespace
+                    and getattr(obj, "name", "") in mine):
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        def after_pv_add(pod: Pod, old, new):
+            # a new PV can only help pods that still have unbound claims
+            for name in pod_pvc_names(pod):
+                pvc = (self.client.get_pvc(pod.namespace, name)
+                       if self.client else None)
+                if pvc is not None and not pvc.is_bound():
+                    return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.PVC,
+                             ActionType.ADD | ActionType.UPDATE),
+                after_pvc_change),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.PV, ActionType.ADD),
+                after_pv_add),
+        ]

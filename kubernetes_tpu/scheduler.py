@@ -108,10 +108,16 @@ DEFAULT_WEIGHTS = {
 def default_plugins(client=None, ns_lister=None) -> list:
     from .plugins.defaultbinder import DefaultBinder
     from .plugins.gangscheduling import GangScheduling
+    from .plugins.volume_basics import (NodeVolumeLimits, VolumeRestrictions,
+                                        VolumeZone)
+    from .plugins.volumebinding import VolumeBinding
+    # filter order mirrors apis/config/v1/default_plugins.go:30
     plugins = [
         SchedulingGates(), GangScheduling(), PrioritySort(),
         NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
-        NodePorts(), nr.Fit(), nr.BalancedAllocation(), PodTopologySpread(),
+        NodePorts(), nr.Fit(), VolumeRestrictions(client),
+        NodeVolumeLimits(client), VolumeBinding(client), VolumeZone(client),
+        nr.BalancedAllocation(), PodTopologySpread(),
         InterPodAffinity(ns_lister=ns_lister), ImageLocality(),
     ]
     if client is not None:
@@ -129,6 +135,9 @@ class Profile:
     gang_only_hooks: bool = False
     # plugin names the config disabled (auto-wiring must not re-add them)
     disabled_plugins: tuple = ()
+    # True when VolumeBinding is the only PreBind plugin: volume-free pods
+    # can then skip the PreBind phase entirely (hot path)
+    volume_only_pre_bind: bool = False
 
 
 @dataclass
@@ -240,10 +249,16 @@ class Scheduler:
             for p in prof.framework.plugins:
                 if isinstance(p, GangScheduling):
                     p.handle = self
+            from .plugins.volumebinding import VolumeBinding
+            # "gang_only": every reserve/permit plugin is scoped to gang or
+            # volume pods, so a pod with neither skips the hook chain
             prof.gang_only_hooks = all(
-                isinstance(p, GangScheduling)
+                isinstance(p, (GangScheduling, VolumeBinding))
                 for p in (prof.framework.reserve_plugins
                           + prof.framework.permit_plugins))
+            prof.volume_only_pre_bind = all(
+                isinstance(p, VolumeBinding)
+                for p in prof.framework.pre_bind_plugins)
 
         # wire preemption (PostFilter) into every profile: the Evaluator
         # needs live handles (dispatcher, nominator, snapshot) that exist
@@ -333,6 +348,11 @@ class Scheduler:
             return
         self.metrics.permit_wait_duration.observe(
             max(self.clock() - rec.parked_at, 0.0), "allowed")
+        profile = self.profiles.get(rec.qpi.pod.spec.scheduler_name)
+        if profile is not None and not self._run_pre_bind(
+                profile, rec.cycle_state, rec.qpi, rec.assumed,
+                rec.node_name):
+            return
         self.cache.finish_binding(rec.assumed)
         self.dispatcher.add(APICall(CallType.BIND, rec.assumed,
                                     node_name=rec.node_name))
@@ -380,6 +400,11 @@ class Scheduler:
         if hasattr(self.client, "watch_workloads"):
             self.client.watch_workloads(WatchHandlers(
                 on_add=self._on_workload_add))
+        if hasattr(self.client, "watch_pvcs"):
+            self.client.watch_pvcs(WatchHandlers(
+                on_add=self._on_pvc_change, on_update=self._on_pvc_change))
+        if hasattr(self.client, "watch_pvs"):
+            self.client.watch_pvs(WatchHandlers(on_add=self._on_pv_add))
 
     def _responsible(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.profiles
@@ -451,6 +476,20 @@ class Scheduler:
                 EVENT_ASSIGNED_POD_DELETE, pod, None)
         else:
             self.queue.delete(pod)
+
+    def _on_pvc_change(self, *args) -> None:
+        """PVC add/update can unblock VolumeBinding rejects
+        (volume_binding.go EventsToRegister)."""
+        old, new = (args[0], args[1]) if len(args) == 2 else (None, args[0])
+        self.queue.move_all_to_active_or_backoff_queue(
+            ClusterEvent(EventResource.PVC, ActionType.ADD | ActionType.UPDATE),
+            old, new)
+
+    def _on_pv_add(self, pv) -> None:
+        """A new PV can satisfy a WFFC claim that had no match
+        (volume_binding.go EventsToRegister: PV Add)."""
+        self.queue.move_all_to_active_or_backoff_queue(
+            ClusterEvent(EventResource.PV, ActionType.ADD), None, pv)
 
     def _on_workload_add(self, workload) -> None:
         """A Workload's arrival can un-gate its gang's pods (PreEnqueue)
@@ -934,10 +973,14 @@ class Scheduler:
         self.queue.nominator.delete(pod)
         profile = self.profiles.get(pod.spec.scheduler_name)
         fwk = profile.framework
+        cs = state or CycleState()
+        # volume-free pods under gang-only hooks skip reserve/permit; a pod
+        # with PVC volumes always runs the full chain (VolumeBinding holds
+        # its per-node decisions in the CycleState from the host filter)
         run_hooks = (fwk.reserve_plugins or fwk.permit_plugins) and (
-            pod.spec.workload_ref or not profile.gang_only_hooks)
+            pod.spec.workload_ref or pod.spec.volumes
+            or not profile.gang_only_hooks)
         if run_hooks:
-            cs = state or CycleState()
             status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
             if not status.is_success():
                 fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
@@ -977,6 +1020,8 @@ class Scheduler:
                     cycle_state=cs, deadline=now + wait_timeout,
                     parked_at=now, wait_plugin=status.plugin)
                 return
+        if not self._run_pre_bind(profile, cs, qpi, assumed, node_name):
+            return
         self.queue.done(pod.uid)
         self.cache.finish_binding(assumed)
         self.dispatcher.add(APICall(CallType.BIND, assumed, node_name=node_name))
@@ -989,6 +1034,31 @@ class Scheduler:
             max(self.clock() - start, 0.0), str(qpi.attempts or 1))
         qpi.unschedulable_plugins = set()
         qpi.consecutive_errors_count = 0
+
+    def _run_pre_bind(self, profile: Profile, cs: CycleState,
+                      qpi: QueuedPodInfo, assumed: Pod,
+                      node_name: str) -> bool:
+        """PreBind (schedule_one.go:327): VolumeBinding's API writes.
+        Volume-free pods skip it when VolumeBinding is the only PreBind
+        plugin. On failure: unreserve, release the assumed resources,
+        requeue — returns False so the caller aborts the bind."""
+        fwk = profile.framework
+        pod = qpi.pod
+        if not fwk.pre_bind_plugins or (profile.volume_only_pre_bind
+                                        and not pod.spec.volumes):
+            return True
+        status = fwk.run_pre_bind_plugins(cs, assumed, node_name)
+        if status.is_success():
+            return True
+        fwk.run_reserve_plugins_unreserve(cs, assumed, node_name)
+        try:
+            self.cache.forget_pod(assumed)
+        except (KeyError, ValueError):
+            pass
+        self._invalidate_device_state()
+        self.error_count += 1
+        self._handle_failure(qpi, FitError(pod, 0), try_preempt=False)
+        return False
 
     def _on_bind_error(self, pod: Pod, node_name: str, err: Exception) -> None:
         """schedule_one.go:361-393: forget + requeue via the failure handler.

@@ -248,6 +248,8 @@ class BatchBuilder:
             (aff.pod_affinity, aff.pod_anti_affinity) if aff else None,
             tuple(c.image for c in (list(spec.init_containers)
                                     + list(spec.containers))),
+            tuple((v.name, v.claim_name, v.csi_driver)
+                  for v in spec.volumes),
         )
 
     # -- row compilation ------------------------------------------------------
@@ -256,6 +258,10 @@ class BatchBuilder:
         d = self.dims
         intr = self.state.interner
         aff = pod.spec.affinity
+        if pod.spec.volumes:
+            # the PVC/PV binding state machine is API-coupled (SURVEY §2.4
+            # volumebinding): volume-bearing pods keep host semantics
+            raise BatchCapacityError("pod has volumes")
         # resources
         reqs = res.pod_requests(pod)
         row = self.state.rtable.vector(reqs)

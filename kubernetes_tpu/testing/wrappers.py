@@ -108,6 +108,19 @@ class PodWrapper:
         self.pod.spec.scheduling_gates.append(PodSchedulingGate(name))
         return self
 
+    def pvc(self, claim_name: str, volume_name: str = "") -> "PodWrapper":
+        from ..api.types import Volume
+        self.pod.spec.volumes.append(Volume(
+            name=volume_name or f"vol-{len(self.pod.spec.volumes)}",
+            claim_name=claim_name))
+        return self
+
+    def csi_volume(self, driver: str) -> "PodWrapper":
+        from ..api.types import Volume
+        self.pod.spec.volumes.append(Volume(
+            name=f"vol-{len(self.pod.spec.volumes)}", csi_driver=driver))
+        return self
+
     def workload(self, ref: str) -> "PodWrapper":
         self.pod.spec.workload_ref = ref
         return self

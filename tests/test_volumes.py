@@ -1,0 +1,225 @@
+"""Volume plugin family: binding state machine + limits + restrictions + zone.
+
+Mirrors the reference behaviors (volumebinding/binder.go:285,406,479;
+nodevolumelimits/csi.go; volumerestrictions; volumezone): WaitForFirstConsumer
+end-to-end (filter → reserve → prebind → PVC bound), unbound-immediate
+rejection, PV node-affinity routing, smallest-fitting-PV selection, dynamic
+provisioning, CSI attach limits, RWO cross-node exclusivity, and zone labels.
+"""
+
+from kubernetes_tpu.api.types import (BINDING_IMMEDIATE,
+                                      BINDING_WAIT_FOR_FIRST_CONSUMER,
+                                      LabelSelectorRequirement, NodeSelector,
+                                      NodeSelectorTerm, ObjectMeta,
+                                      PersistentVolume, PersistentVolumeClaim,
+                                      StorageClass)
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+GB = 1024 ** 3
+
+
+def _cluster(n_nodes=3, **caps):
+    api = APIServer()
+    sched = Scheduler(api, batch_size=64)
+    caps = caps or {"cpu": 8, "memory": "16Gi", "pods": 110}
+    for i in range(n_nodes):
+        api.create_node(make_node(f"n{i}").capacity(caps)
+                        .zone(f"z{i}").obj())
+    return api, sched
+
+
+def _sc(api, name="fast", mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+        provisioner=""):
+    api.create_storage_class(StorageClass(
+        metadata=ObjectMeta(name=name), provisioner=provisioner,
+        volume_binding_mode=mode))
+
+
+def _pv(api, name, size_gb, sc="fast", node=None, zone=None, driver="",
+        labels=None):
+    affinity = None
+    if node is not None:
+        affinity = NodeSelector(terms=(NodeSelectorTerm(
+            match_fields=(LabelSelectorRequirement(
+                key="metadata.name", operator="In", values=(node,)),)),))
+    pv = PersistentVolume(metadata=ObjectMeta(name=name,
+                                              labels=dict(labels or {})),
+                          capacity_bytes=size_gb * GB,
+                          storage_class_name=sc, node_affinity=affinity,
+                          csi_driver=driver)
+    if zone is not None:
+        pv.metadata.labels["topology.kubernetes.io/zone"] = zone
+    api.create_pv(pv)
+    return pv
+
+
+def _pvc(api, name, size_gb=1, sc="fast", ns="default"):
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        storage_class_name=sc, requested_bytes=size_gb * GB)
+    api.create_pvc(pvc)
+    return pvc
+
+
+class TestVolumeBinding:
+    def test_wait_for_first_consumer_end_to_end(self):
+        """WFFC: pod lands on the PV's node; PreBind binds the claim."""
+        api, sched = _cluster()
+        _sc(api)
+        _pv(api, "pv-local", 10, node="n2")
+        pvc = _pvc(api, "data")
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/db"].spec.node_name == "n2"
+        assert pvc.is_bound() and pvc.volume_name == "pv-local"
+        assert api.get_pv("pv-local").claim_ref == pvc.uid
+
+    def test_unbound_immediate_is_unresolvable(self):
+        api, sched = _cluster()
+        _sc(api, mode=BINDING_IMMEDIATE)
+        _pvc(api, "data")
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 0
+        qpi = sched.queue.unschedulable_pods["default/db"]
+        assert "VolumeBinding" in qpi.unschedulable_plugins
+
+    def test_bound_claim_routes_to_pv_node(self):
+        api, sched = _cluster()
+        _sc(api)
+        pv = _pv(api, "pv0", 10, node="n1")
+        pvc = _pvc(api, "data")
+        api.bind_pvc(pvc, pv)
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/db"].spec.node_name == "n1"
+
+    def test_smallest_fitting_pv_wins(self):
+        api, sched = _cluster(n_nodes=1)
+        _sc(api)
+        _pv(api, "pv-big", 100, node="n0")
+        _pv(api, "pv-small", 2, node="n0")
+        pvc = _pvc(api, "data", size_gb=1)
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 1
+        assert pvc.volume_name == "pv-small"
+
+    def test_no_matching_pv_no_provisioner_unschedulable(self):
+        api, sched = _cluster()
+        _sc(api)
+        _pvc(api, "data", size_gb=50)
+        _pv(api, "pv-small", 1, node="n0")   # too small
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 0
+
+    def test_dynamic_provisioning(self):
+        api, sched = _cluster()
+        _sc(api, provisioner="csi.example.com")
+        pvc = _pvc(api, "data", size_gb=5)
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 1
+        assert pvc.is_bound()
+        pv = api.get_pv(pvc.volume_name)
+        assert pv.capacity_bytes == 5 * GB
+        node = api.pods["default/db"].spec.node_name
+        # the provisioned PV is pinned to the chosen node
+        from kubernetes_tpu.plugins.volumebinding import pv_reaches_node
+        from kubernetes_tpu.framework.types import NodeInfo
+        ni = NodeInfo(node=api.nodes[node])
+        assert pv_reaches_node(pv, ni)
+
+    def test_two_pods_cannot_share_one_available_pv(self):
+        """The reserved-PV set (AssumeCache analog) must keep a second pod
+        in the same drain from matching an already-claimed PV."""
+        api, sched = _cluster(n_nodes=2)
+        _sc(api)
+        _pv(api, "pv0", 10, node="n0")
+        _pvc(api, "data-a")
+        _pvc(api, "data-b")
+        api.create_pod(make_pod("a").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data-a").obj())
+        api.create_pod(make_pod("b").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data-b").obj())
+        assert sched.schedule_pending() == 1   # only one claim can bind
+        bound = [n for n in ("default/a", "default/b")
+                 if api.pods[n].spec.node_name]
+        assert len(bound) == 1
+
+    def test_missing_pvc_is_unresolvable(self):
+        api, sched = _cluster()
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("ghost").obj())
+        assert sched.schedule_pending() == 0
+
+
+class TestNodeVolumeLimits:
+    def test_csi_attach_limit(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110,
+             "attachable-volumes-csi-ebs.csi.aws.com": 2}).obj())
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"})
+                .csi_volume("ebs.csi.aws.com").obj())
+        assert sched.schedule_pending() == 2   # third exceeds the limit
+        pending = (list(sched.queue.unschedulable_pods.values())
+                   or [sched.queue.backoff_q.get(u)
+                       for u in sched.queue.backoff_q._items])
+        assert pending and "NodeVolumeLimitsCSI" in pending[0].unschedulable_plugins
+
+
+class TestVolumeRestrictions:
+    def test_rwo_is_node_exclusive(self):
+        api, sched = _cluster(n_nodes=2, cpu=2, memory="4Gi", pods=10)
+        _sc(api)
+        pv = _pv(api, "pv0", 10, node=None)   # reachable anywhere
+        pvc = _pvc(api, "shared")
+        api.bind_pvc(pvc, pv)
+        # holder lands somewhere; a second RWO user must co-locate — here
+        # the holder's node is FULL, so the second pod stays pending
+        api.create_pod(make_pod("holder").req({"cpu": "2", "memory": "1Gi"})
+                       .pvc("shared").obj())
+        assert sched.schedule_pending() == 1
+        holder_node = api.pods["default/holder"].spec.node_name
+        api.create_pod(make_pod("second").req({"cpu": "2", "memory": "1Gi"})
+                       .pvc("shared").obj())
+        assert sched.schedule_pending() == 0   # other node vetoed; holder full
+        qpi = sched.queue.unschedulable_pods["default/second"]
+        assert "VolumeRestrictions" in qpi.unschedulable_plugins
+
+    def test_rwo_same_node_allowed(self):
+        api, sched = _cluster(n_nodes=2)
+        _sc(api)
+        pv = _pv(api, "pv0", 10, node=None)
+        pvc = _pvc(api, "shared")
+        api.bind_pvc(pvc, pv)
+        api.create_pod(make_pod("holder").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("shared").obj())
+        assert sched.schedule_pending() == 1
+        api.create_pod(make_pod("second").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("shared").obj())
+        assert sched.schedule_pending() == 1
+        assert (api.pods["default/second"].spec.node_name
+                == api.pods["default/holder"].spec.node_name)
+
+
+class TestVolumeZone:
+    def test_pv_zone_restricts_nodes(self):
+        api, sched = _cluster(n_nodes=3)   # zones z0 z1 z2
+        _sc(api)
+        pv = _pv(api, "pv0", 10, zone="z1")
+        pvc = _pvc(api, "data")
+        api.bind_pvc(pvc, pv)
+        api.create_pod(make_pod("db").req({"cpu": "1", "memory": "1Gi"})
+                       .pvc("data").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/db"].spec.node_name == "n1"
