@@ -181,7 +181,8 @@ class Scheduler:
                  clock: Callable[[], float] = _time.monotonic,
                  percentage_of_nodes_to_score: Optional[int] = None,
                  config=None,
-                 metrics=None):
+                 metrics=None,
+                 tracer=None):
         """`config` is a config.KubeSchedulerConfiguration — when given it
         supplies profiles, batch size, backoffs and sampling percentage;
         explicitly passed arguments win over the config's values."""
@@ -239,6 +240,8 @@ class Scheduler:
         self.metrics = metrics or SchedulerMetrics(
             queue_depths=self._queue_depths)
         self.dispatcher.metrics = self.metrics
+        from .utils.tracing import NOOP_TRACER
+        self.tracer = tracer or NOOP_TRACER
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -527,8 +530,13 @@ class Scheduler:
             qpis = self.queue.drain(self.batch_size)
             if not qpis:
                 break
-            self._schedule_batch(qpis)
-            self.dispatcher.flush()
+            with self.tracer.span("scheduling_cycle",
+                                  pods=len(qpis)) as cycle:
+                with self.tracer.span("schedule_batch"):
+                    bound = self._schedule_batch(qpis)
+                with self.tracer.span("dispatcher_flush"):
+                    self.dispatcher.flush()
+                cycle.set(bound=bound)
             batches += 1
             if max_batches and batches >= max_batches:
                 break
@@ -660,9 +668,11 @@ class Scheduler:
             self._seeded_rows = self.builder.table_used
         table = table_from_batch(segment_batch)
         t0 = _time.perf_counter()
-        carry, assignments = self._run_device_program(
-            profile.score_config, na, carry, segment_batch, table,
-            len(qpis), groups_needed)
+        with self.tracer.span("device_program", pods=len(qpis),
+                              groups=groups_needed):
+            carry, assignments = self._run_device_program(
+                profile.score_config, na, carry, segment_batch, table,
+                len(qpis), groups_needed)
         batch_dt = _time.perf_counter() - t0
         self.metrics.device_batch_duration.observe(batch_dt)
         self.metrics.device_batch_size.observe(len(qpis))

@@ -1,0 +1,186 @@
+"""Serving surface: /healthz /readyz /metrics + leader election.
+
+Mirrors cmd/kube-scheduler/app/server.go's operational endpoints (:190-211
+healthz/readyz with handler-sync checks, :358-366 /metrics) and the
+client-go leaderelection loop (:221-332), reduced to this framework's
+in-process model:
+
+- `SchedulerServer` runs a stdlib ThreadingHTTPServer on a background
+  thread. /healthz is liveness (process up); /readyz additionally requires
+  the informer handlers to be registered (the reference's
+  WaitForHandlersSync analog) and — when leader election is on — this
+  instance to hold the lease; /metrics serves the Prometheus exposition.
+- `LeaderElector` drives a Lease object stored in the APIServer
+  (coordination.k8s.io/Lease semantics: acquire when unheld or expired,
+  renew while holding, release on stop). Multiple scheduler instances
+  sharing one APIServer elect exactly one active scheduler; standbys call
+  `tick()` and take over when the holder stops renewing — the
+  active/passive HA pattern of the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+LEASE_NAME = "kube-scheduler"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease (consumed subset)."""
+
+    name: str = LEASE_NAME
+    holder_identity: str = ""
+    lease_duration_s: float = 15.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+class LeaderElector:
+    """client-go leaderelection.LeaderElector (tools/leaderelection):
+    acquire/renew/release against a shared Lease store."""
+
+    def __init__(self, client, identity: str,
+                 lease_duration_s: float = 15.0,
+                 clock: Callable[[], float] = _time.monotonic,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.client = client
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+
+    def _lease(self) -> Lease:
+        lease = getattr(self.client, "leases", None)
+        if lease is None:
+            self.client.leases = {}
+        return self.client.leases.setdefault(LEASE_NAME, Lease(
+            lease_duration_s=self.lease_duration_s))
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def tick(self) -> bool:
+        """One acquire-or-renew round; returns leadership after the round.
+        The reference loops this on RetryPeriod; callers here invoke it
+        from their own control loop."""
+        lease = self._lease()
+        now = self.clock()
+        expired = (not lease.holder_identity
+                   or now - lease.renew_time > lease.lease_duration_s)
+        if lease.holder_identity == self.identity:
+            lease.renew_time = now
+            if not self._leading:
+                # e.g. an elector re-created after restart while its lease
+                # is still valid: it IS the holder — reflect that
+                self._leading = True
+                if self.on_started_leading:
+                    self.on_started_leading()
+            return True
+        if expired:
+            if lease.holder_identity and lease.holder_identity != self.identity:
+                lease.lease_transitions += 1
+            lease.holder_identity = self.identity
+            lease.renew_time = now
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+            return True
+        if self._leading:
+            # lost the lease (another holder renewed)
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+        return False
+
+    def release(self) -> None:
+        lease = self._lease()
+        if lease.holder_identity == self.identity:
+            lease.holder_identity = ""
+            lease.renew_time = 0.0
+        if self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+
+class SchedulerServer:
+    """healthz/readyz/metrics endpoints for one Scheduler instance."""
+
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
+                 elector: Optional[LeaderElector] = None):
+        self.scheduler = scheduler
+        self.elector = elector
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/readyz":
+                    ready, why = outer.readiness()
+                    self._send(200 if ready else 503, why)
+                elif self.path == "/metrics":
+                    self._send(200, outer.scheduler.metrics.exposition(),
+                               "text/plain; version=0.0.4")
+                elif self.path == "/statusz":
+                    self._send(200, json.dumps(outer.status(), indent=2),
+                               "application/json")
+                else:
+                    self._send(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def readiness(self) -> tuple[bool, str]:
+        """server.go:190-211: handlers registered + (if elected) leading."""
+        if not self.scheduler.client.pod_handlers:
+            return False, "informer handlers not registered"
+        if self.elector is not None and not self.elector.is_leader():
+            return False, "not the leader"
+        return True, "ok"
+
+    def status(self) -> dict:
+        s = self.scheduler
+        return {
+            "scheduled": s.scheduled_count,
+            "attempts": s.schedule_attempts,
+            "unschedulable": s.unschedulable_count,
+            "errors": s.error_count,
+            "deviceBatches": s.device_batches,
+            "hostScheduled": s.host_scheduled,
+            "preemptionAttempts": s.preemption_attempts,
+            "pendingPods": s.queue.pending_pods()[1],
+            "leader": (self.elector.is_leader()
+                       if self.elector is not None else True),
+        }
+
+    def start(self) -> "SchedulerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,105 @@
+"""Tracing: span trees + slow-cycle logging.
+
+The reference wires OpenTelemetry through component-base/tracing (spans
+around the scheduling cycle, schedule_one.go) and logs slow cycles via
+klog verbosity. This is the dependency-free analog:
+
+- `Tracer.span(name)` context manager builds a per-cycle span tree with
+  wall-clock durations and optional attributes.
+- finished root spans whose duration exceeds `slow_threshold_s` are kept in
+  `slow_cycles` (ring buffer) and handed to `on_slow` (default: stdlib
+  logging at WARNING) with a per-child breakdown — the "why was this cycle
+  slow" answer the reference gets from attempt-duration histograms plus
+  trace sampling.
+- `NOOP_TRACER` keeps the hot path branch-free when tracing is off: span()
+  returns a reusable null context.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.tracing")
+
+
+@dataclass
+class Span:
+    name: str
+    start: float = 0.0
+    duration_s: float = 0.0
+    attributes: dict = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+
+    def set(self, **attrs) -> None:
+        self.attributes.update(attrs)
+
+    def breakdown(self, indent: int = 0) -> str:
+        lines = [f"{'  ' * indent}{self.name}: {self.duration_s * 1e3:.1f}ms"
+                 + (f" {self.attributes}" if self.attributes else "")]
+        for c in self.children:
+            lines.append(c.breakdown(indent + 1))
+        return "\n".join(lines)
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Per-component tracer; single-threaded like the host loop it serves."""
+
+    def __init__(self, slow_threshold_s: float = 1.0, keep: int = 32,
+                 on_slow: Optional[Callable[[Span], None]] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.slow_threshold_s = slow_threshold_s
+        self.clock = clock
+        self.slow_cycles: deque[Span] = deque(maxlen=keep)
+        self.on_slow = on_slow or self._log_slow
+        self._stack: list[Span] = []
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        sp = Span(name=name, start=self.clock(),
+                  attributes=dict(attributes))
+        parent = self._stack[-1] if self._stack else None
+        if parent is not None:
+            parent.children.append(sp)
+        self._stack.append(sp)
+        try:
+            yield sp
+        finally:
+            self._stack.pop()
+            sp.duration_s = self.clock() - sp.start
+            if parent is None and sp.duration_s >= self.slow_threshold_s:
+                self.slow_cycles.append(sp)
+                self.on_slow(sp)
+
+    @staticmethod
+    def _log_slow(sp: Span) -> None:
+        logger.warning("slow scheduling cycle (%.0fms):\n%s",
+                       sp.duration_s * 1e3, sp.breakdown())
+
+
+class NoopTracer:
+    slow_cycles: deque = deque()
+
+    def span(self, name: str, **attributes):
+        return _NULL_SPAN
+
+
+NOOP_TRACER = NoopTracer()

@@ -1,0 +1,131 @@
+"""Serving surface (healthz/readyz/metrics, leader election) + tracing."""
+
+import urllib.request
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.server import LeaderElector, SchedulerServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+from kubernetes_tpu.utils.tracing import Tracer
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestServer:
+    def test_endpoints(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        srv = SchedulerServer(sched).start()
+        try:
+            assert _get(srv.port, "/healthz") == (200, "ok")
+            assert _get(srv.port, "/readyz")[0] == 200
+            code, body = _get(srv.port, "/metrics")
+            assert code == 200
+            assert "scheduler_schedule_attempts_total" in body
+            code, body = _get(srv.port, "/statusz")
+            assert code == 200 and '"scheduled": 1' in body
+            assert _get(srv.port, "/nope")[0] == 404
+        finally:
+            srv.stop()
+
+    def test_readyz_requires_leadership(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        clock = FakeClock()
+        el = LeaderElector(api, "sched-a", clock=clock)
+        srv = SchedulerServer(sched, elector=el).start()
+        try:
+            assert _get(srv.port, "/readyz")[0] == 503
+            el.tick()
+            assert _get(srv.port, "/readyz")[0] == 200
+        finally:
+            srv.stop()
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        api = APIServer()
+        clock = FakeClock()
+        a = LeaderElector(api, "a", lease_duration_s=15, clock=clock)
+        b = LeaderElector(api, "b", lease_duration_s=15, clock=clock)
+        assert a.tick() is True
+        assert b.tick() is False          # lease held by a
+        clock.t += 10
+        assert a.tick() is True           # renew
+        clock.t += 10
+        assert b.tick() is False          # a renewed 10s ago, not expired
+        clock.t += 20                     # a stops renewing → lease expires
+        assert b.tick() is True           # b takes over
+        assert not a.is_leader() or a.tick() is False
+
+    def test_release_hands_off_immediately(self):
+        api = APIServer()
+        clock = FakeClock()
+        events = []
+        a = LeaderElector(api, "a", clock=clock,
+                          on_stopped_leading=lambda: events.append("a-stop"))
+        b = LeaderElector(api, "b", clock=clock,
+                          on_started_leading=lambda: events.append("b-start"))
+        a.tick()
+        a.release()
+        assert events == ["a-stop"]
+        assert b.tick() is True
+        assert events == ["a-stop", "b-start"]
+
+
+class TestTracing:
+    def test_slow_cycle_capture(self):
+        clock = FakeClock()
+        slow = []
+        tr = Tracer(slow_threshold_s=0.5, clock=clock, on_slow=slow.append)
+        with tr.span("scheduling_cycle") as root:
+            with tr.span("schedule_batch"):
+                clock.t += 0.4
+            with tr.span("dispatcher_flush"):
+                clock.t += 0.3
+        assert len(slow) == 1
+        sp = slow[0]
+        assert sp.duration_s == 0.7
+        assert [c.name for c in sp.children] == ["schedule_batch",
+                                                 "dispatcher_flush"]
+        assert "schedule_batch: 400.0ms" in sp.breakdown()
+
+    def test_fast_cycles_not_captured(self):
+        clock = FakeClock()
+        tr = Tracer(slow_threshold_s=0.5, clock=clock)
+        with tr.span("scheduling_cycle"):
+            clock.t += 0.1
+        assert not tr.slow_cycles
+
+    def test_scheduler_wires_spans(self):
+        api = APIServer()
+        tr = Tracer(slow_threshold_s=0.0)   # capture every cycle
+        sched = Scheduler(api, batch_size=64, tracer=tr)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+        assert tr.slow_cycles
+        root = tr.slow_cycles[-1]
+        names = [c.name for c in root.children]
+        assert "schedule_batch" in names and "dispatcher_flush" in names
+        assert root.attributes.get("bound") == 1
