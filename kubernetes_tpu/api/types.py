@@ -415,6 +415,16 @@ class Pod:
     def namespace(self) -> str:
         return self.metadata.namespace
 
+    def with_node_name(self, node_name: str) -> "Pod":
+        """Shallow rebind copy for the assume/bind hot path: fresh Pod +
+        PodSpec (+ status) shells, node_name set; metadata, containers and
+        label dicts are SHARED per the aliasing contract above."""
+        p = _shallow(self)
+        p.spec = _shallow(self.spec)
+        p.spec.node_name = node_name
+        p.status = _shallow(self.status)
+        return p
+
     def clone(self) -> "Pod":
         # hot path (2 clones per scheduled pod): raw __dict__ copies — both
         # copy.copy (reduce protocol) and dataclasses.replace (re-runs

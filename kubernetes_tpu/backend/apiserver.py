@@ -119,8 +119,7 @@ class APIServer:
         if node_name not in self.nodes:
             raise NotFound(f"node {node_name}")
         old = current
-        new = current.clone()
-        new.spec.node_name = node_name
+        new = current.with_node_name(node_name)
         new.status.phase = "Running"
         self.pods[pod.uid] = new
         self.binding_count += 1

@@ -968,8 +968,7 @@ class Scheduler:
                          node_name: str,
                          state: Optional[CycleState] = None) -> None:
         pod = qpi.pod
-        assumed = pod.clone()
-        assumed.spec.node_name = node_name
+        assumed = pod.with_node_name(node_name)
         # reuse the queue entry's pre-parsed requests — no quantity
         # re-parsing on the per-bind hot path
         pi = PodInfo(pod=assumed, requests=qpi.pod_info.requests,
@@ -1083,8 +1082,7 @@ class Scheduler:
         except (KeyError, ValueError):
             pass
         self._invalidate_device_state()
-        fresh = pod.clone()
-        fresh.spec.node_name = ""
+        fresh = pod.with_node_name("")
         errors = self._bind_errors.get(pod.uid, 0) + 1
         self._bind_errors[pod.uid] = errors
         qpi = QueuedPodInfo(pod_info=PodInfo.of(fresh),
