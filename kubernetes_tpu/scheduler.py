@@ -303,6 +303,7 @@ class Scheduler:
         self._device_carry = None
         self._carry_profile = None   # profile whose cfg filled the sig cache
         # group (spread / inter-pod affinity) device state lifecycle
+        self._builder_reset_seen = 0  # builder.reset_count already consumed
         self._gd_dev = None          # GroupsDev (jnp) for the current carry
         self._gd_fam = None          # static active-family mask (jit key)
         self._gd_capacity = None     # (table_rows, node_bucket) it was built for
@@ -633,9 +634,12 @@ class Scheduler:
             self.builder.groups.any_groups()
             or bool(self.snapshot.have_pods_with_affinity_list)
             or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
+        table_reset = self.builder.reset_count != self._builder_reset_seen
+        self._builder_reset_seen = self.builder.reset_count
         capacity = (self.builder.dims.table_rows, na.used.shape[0])
         if carry is not None and (
-                carry.used.shape != na.used.shape
+                table_reset   # every signature id / group row invalidated
+                or carry.used.shape != na.used.shape
                 or groups_needed != (carry.groups is not None)
                 or (groups_needed and capacity != self._gd_capacity)):
             # structural change: reseed from the host snapshot

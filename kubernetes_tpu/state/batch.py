@@ -61,6 +61,9 @@ TOL_EXISTS = 2
 @dataclass
 class BatchDims:
     table_rows: int = 16   # U — distinct signatures (grows by doubling)
+    # growth cap: reaching this many used rows triggers a between-builds
+    # reset (compaction) instead of further doubling
+    max_table_rows: int = 4096
     images_per_pod: int = 8  # IC — container images per pod
     sel_terms: int = 4     # T — required node affinity terms
     sel_reqs: int = 6      # Q — requirements per term (incl. nodeSelector merge)
@@ -118,6 +121,10 @@ class BatchBuilder:
         from ..ops.groups import GroupManager
         self.state = state
         self.dims = dims or BatchDims()
+        # bumped whenever existing rows are INVALIDATED (reset), as opposed
+        # to appended; device-side consumers must reseed their group state
+        # and signature caches when this moves
+        self.reset_count = 0
         # signature key → ("row", sig_id, tidx) | ("fallback", reason)
         self._sig_cache: dict[tuple, tuple] = {}
         self._next_sig = 1
@@ -132,6 +139,7 @@ class BatchBuilder:
     # -- table lifecycle ------------------------------------------------------
 
     def _reset_table(self) -> None:
+        self.reset_count += 1
         self._sig_cache.clear()
         self.table = _zero_table(self.dims.table_rows,
                                  self.state.dims.resources, self.dims)
@@ -158,6 +166,13 @@ class BatchBuilder:
         # then reuse the same compiled program instead of minting a new
         # (smaller) shape bucket
         B = pow2_at_least(max(len(pods), pad_to))
+        if self.table_used >= self.dims.max_table_rows:
+            # compaction happens BETWEEN builds only (a mid-build reset
+            # would zero rows this batch already references): drop every
+            # row; the signatures still in use re-intern immediately, dead
+            # ones don't come back. Row capacity stays at its high-water
+            # bucket, so memory is bounded by MAX_TABLE_ROWS growth.
+            self._reset_table()
         if self.table.req.shape[1] != self.state.dims.resources:
             self._reset_table()  # resource table grew: row widths changed
         valid = np.zeros((B,), bool)

@@ -195,3 +195,30 @@ class TestAffinitySymmetry:
         assert web.spec.node_name, "b-web must bind (one zone is free)"
         zone_of = {"n0": "z0", "n1": "z1"}
         assert zone_of[web.spec.node_name] != zone_of[guard_node]
+
+
+class TestSignatureTableBounds:
+    def test_table_reset_keeps_scheduling_correct(self):
+        """Per-pod-unique labels mint one signature row each; past
+        MAX_TABLE_ROWS the table resets instead of doubling, and scheduling
+        stays correct across the reset."""
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node, make_pod
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        sched.builder.dims.max_table_rows = 32   # force resets quickly
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 64, "memory": "128Gi", "pods": 200}).obj())
+        total = 0
+        for r in range(3):
+            for i in range(40):   # 40 unique-label pods per round
+                api.create_pod(make_pod(f"p{r}-{i}")
+                               .req({"cpu": "250m", "memory": "256Mi"})
+                               .label("pod-name", f"p{r}-{i}").obj())
+            total += sched.schedule_pending()
+        assert total == 120
+        assert sched.builder.reset_count >= 1
+        assert sched.builder.dims.table_rows <= 64
+        assert sched.reconcile() == []
