@@ -1,0 +1,199 @@
+"""Scheduler extenders: out-of-process filter/prioritize/bind hooks.
+
+Mirrors pkg/scheduler/extender.go + schedule_one.go's extender phases:
+- `Extender` is the interface (extender.go:65 SchedulerExtender): Filter
+  runs after the plugin filters (findNodesThatPassExtenders,
+  schedule_one.go:558,598 — an ignorable extender's failure is skipped,
+  a filtered-out node records Unschedulable in the diagnosis), Prioritize
+  contributes weighted scores on top of the plugin totals
+  (prioritizeNodes, schedule_one.go:611-617), Bind optionally takes over
+  the bind call.
+- `HTTPExtender` posts ExtenderArgs-shaped JSON to the configured URLs —
+  the reference's webhook wire protocol (extender/v1 types), built on
+  urllib so it works against any HTTP endpoint.
+- `CallableExtender` wraps in-process functions for tests and embedded
+  extensions.
+
+Extenders are API-coupled and node-list-shaped, so they have no tensor
+form: the scheduler routes every pod of a profile with extenders through
+the host oracle path — the exact analog of the reference DISABLING
+opportunistic batching when extenders are configured
+(runtime/framework.go:775-780).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import Pod
+from .types import NodeInfo
+
+
+@dataclass
+class CallableExtender:
+    """In-process extender: filter/prioritize/bind as plain callables."""
+
+    name: str = "extender"
+    # filter(pod, nodes) → (feasible nodes, {node name: failure reason})
+    filter_fn: Optional[Callable] = None
+    # prioritize(pod, nodes) → {node name: score 0..10}
+    prioritize_fn: Optional[Callable] = None
+    weight: int = 1
+    # bind(pod, node_name) → None (raises on failure)
+    bind_fn: Optional[Callable] = None
+    ignorable: bool = False
+
+    def is_filter(self) -> bool:
+        return self.filter_fn is not None
+
+    def is_prioritizer(self) -> bool:
+        return self.prioritize_fn is not None
+
+    def is_binder(self) -> bool:
+        return self.bind_fn is not None
+
+    def is_ignorable(self) -> bool:
+        return self.ignorable
+
+    def filter(self, pod: Pod, nodes: list[NodeInfo]):
+        """→ (feasible, failed) or (feasible, failed, unresolvable)."""
+        return self.filter_fn(pod, nodes)
+
+    def prioritize(self, pod: Pod, nodes: list[NodeInfo]) -> dict[str, int]:
+        return self.prioritize_fn(pod, nodes)
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self.bind_fn(pod, node_name)
+
+
+@dataclass
+class HTTPExtender:
+    """extender.go HTTPExtender: the webhook wire protocol.
+
+    POSTs {"Pod": ..., "NodeNames": [...]} to url_prefix+filter_verb /
+    prioritize_verb and expects ExtenderFilterResult / HostPriorityList
+    JSON back (extender/v1)."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    ignorable: bool = False
+    timeout_s: float = 5.0
+    name_: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.name_ or self.url_prefix
+
+    def is_filter(self) -> bool:
+        return bool(self.filter_verb)
+
+    def is_prioritizer(self) -> bool:
+        return bool(self.prioritize_verb)
+
+    def is_binder(self) -> bool:
+        return bool(self.bind_verb)
+
+    def is_ignorable(self) -> bool:
+        return self.ignorable
+
+    def _post(self, verb: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.url_prefix}/{verb}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def filter(self, pod: Pod, nodes: list[NodeInfo]
+               ) -> tuple[list[NodeInfo], dict[str, str], dict[str, str]]:
+        result = self._post(self.filter_verb, {
+            "Pod": {"name": pod.name, "namespace": pod.namespace,
+                    "uid": pod.uid},
+            "NodeNames": [ni.name for ni in nodes]})
+        if result.get("Error"):
+            raise RuntimeError(result["Error"])
+        names = result.get("NodeNames")
+        # nil means "no opinion"; an EMPTY list is a total veto
+        # (extender.go distinguishes nil from empty)
+        keep = (set(names) if names is not None
+                else {ni.name for ni in nodes})
+        failed = dict(result.get("FailedNodes") or {})
+        unresolvable = dict(result.get("FailedAndUnresolvableNodes") or {})
+        return ([ni for ni in nodes if ni.name in keep], failed,
+                unresolvable)
+
+    def prioritize(self, pod: Pod, nodes: list[NodeInfo]) -> dict[str, int]:
+        result = self._post(self.prioritize_verb, {
+            "Pod": {"name": pod.name, "namespace": pod.namespace,
+                    "uid": pod.uid},
+            "NodeNames": [ni.name for ni in nodes]})
+        return {e["Host"]: int(e["Score"]) for e in result or []}
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self._post(self.bind_verb, {
+            "PodName": pod.name, "PodNamespace": pod.namespace,
+            "PodUID": pod.uid, "Node": node_name})
+
+
+def find_nodes_that_pass_extenders(extenders, pod: Pod,
+                                   feasible: list[NodeInfo],
+                                   diagnosis) -> list[NodeInfo]:
+    """schedule_one.go findNodesThatPassExtenders (:631-676)."""
+    from .interface import Status
+    for ext in extenders:
+        if not ext.is_filter():
+            continue
+        if not feasible:
+            break
+        try:
+            result = ext.filter(pod, feasible)
+        except Exception:
+            if ext.is_ignorable():
+                continue
+            raise
+        feasible_after, failed = result[0], result[1]
+        unresolvable = result[2] if len(result) > 2 else {}
+        ext_name = ext.name if isinstance(ext.name, str) else "extender"
+        for name, reason in failed.items():
+            diagnosis.node_to_status[name] = Status.unschedulable(
+                reason, plugin=ext_name)
+        for name, reason in unresolvable.items():
+            # permanently-vetoed nodes must not become preemption
+            # candidates (nodesWherePreemptionMightHelp skips these)
+            diagnosis.node_to_status[name] = Status.unresolvable(
+                reason, plugin=ext_name)
+        feasible = feasible_after
+    return feasible
+
+
+# extender/v1: extender priorities are 0..MaxExtenderPriority (10) and are
+# rescaled to the plugins' 0..MaxNodeScore (100) range when combined
+MAX_EXTENDER_PRIORITY = 10
+_EXTENDER_SCALE = 100 // MAX_EXTENDER_PRIORITY
+
+
+def extender_scores(extenders, pod: Pod, nodes: list[NodeInfo]
+                    ) -> dict[str, int]:
+    """prioritizeNodes' extender loop (schedule_one.go:700-741): each
+    prioritizer's 0..10 scores scale by weight × MaxNodeScore/
+    MaxExtenderPriority and add to the plugin totals."""
+    totals: dict[str, int] = {}
+    for ext in extenders:
+        if not ext.is_prioritizer():
+            continue
+        try:
+            scores = ext.prioritize(pod, nodes)
+        except Exception:
+            if ext.is_ignorable():
+                continue
+            raise
+        for name, score in scores.items():
+            totals[name] = (totals.get(name, 0)
+                            + score * ext.weight * _EXTENDER_SCALE)
+    return totals

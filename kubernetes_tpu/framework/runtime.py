@@ -314,7 +314,8 @@ class ScheduleResult:
 
 
 def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
-                 nodes: list[NodeInfo], nominator=None) -> ScheduleResult:
+                 nodes: list[NodeInfo], nominator=None,
+                 extenders=()) -> ScheduleResult:
     if not nodes:
         raise FitError(pod, 0)
     diagnosis = Diagnosis()
@@ -329,6 +330,10 @@ def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
 
     feasible = fwk.find_nodes_that_pass_filters(state, pod, nodes, pre_result,
                                                 diagnosis, nominator=nominator)
+    if extenders:
+        from .extender import find_nodes_that_pass_extenders
+        feasible = find_nodes_that_pass_extenders(extenders, pod, feasible,
+                                                  diagnosis)
     if not feasible:
         raise FitError(pod, len(nodes), diagnosis)
     if len(feasible) == 1:
@@ -342,6 +347,11 @@ def schedule_pod(fwk: Framework, state: CycleState, pod: Pod,
     totals, status = fwk.run_score_plugins(state, pod, feasible)
     if not status.is_success():
         raise RuntimeError(f"score error: {status.reasons}")
+    if extenders:
+        from .extender import extender_scores
+        ext = extender_scores(extenders, pod, feasible)
+        totals = [t + ext.get(ni.name, 0)
+                  for t, ni in zip(totals, feasible)]
 
     best = max(totals)
     argmax = frozenset(ni.name for ni, s in zip(feasible, totals) if s == best)

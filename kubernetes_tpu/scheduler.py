@@ -138,6 +138,11 @@ class Profile:
     # True when VolumeBinding is the only PreBind plugin: volume-free pods
     # can then skip the PreBind phase entirely (hot path)
     volume_only_pre_bind: bool = False
+    # out-of-process extenders (framework/extender.py). Extenders are
+    # API-coupled, so a profile with any routes ALL its pods through the
+    # host oracle — the analog of the reference disabling batching when
+    # extenders are configured (runtime/framework.go:775-780)
+    extenders: tuple = ()
 
 
 @dataclass
@@ -573,6 +578,10 @@ class Scheduler:
 
     def _schedule_profile_batch(self, qpis: list[QueuedPodInfo],
                                 profile: Profile) -> int:
+        if profile.extenders:
+            # no tensor form for webhook hooks: host path, batching off
+            return sum(1 if self._schedule_one_host(q) else 0
+                       for q in qpis)
         pods = [q.pod for q in qpis]
         self.cache.update_snapshot(self.snapshot)
         batch = self.builder.build(pods, snapshot=self.snapshot,
@@ -947,7 +956,8 @@ class Scheduler:
         try:
             result = schedule_pod(profile.framework, state, pod,
                                   self.snapshot.node_info_list,
-                                  nominator=self.queue.nominator)
+                                  nominator=self.queue.nominator,
+                                  extenders=profile.extenders)
         except FitError as err:
             self._handle_failure(qpi, err, state)
             return False
@@ -1037,7 +1047,20 @@ class Scheduler:
             return
         self.queue.done(pod.uid)
         self.cache.finish_binding(assumed)
-        self.dispatcher.add(APICall(CallType.BIND, assumed, node_name=node_name))
+        binder = next((e for e in profile.extenders if e.is_binder()), None)
+        if binder is not None:
+            # a binder extender takes over the bind call (extender.go
+            # IsBinder; schedule_one.go extendersBinding) — synchronously,
+            # since the webhook owns the API write
+            try:
+                binder.bind(assumed, node_name)
+            except Exception as e:
+                self._on_bind_error(assumed, node_name, e)
+                self.scheduled_count += 1   # _on_bind_error decrements
+                return
+        else:
+            self.dispatcher.add(APICall(CallType.BIND, assumed,
+                                        node_name=node_name))
         self.scheduled_count += 1
         from .metrics import SCHEDULED
         self.metrics.schedule_attempts.inc(

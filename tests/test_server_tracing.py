@@ -129,3 +129,91 @@ class TestTracing:
         names = [c.name for c in root.children]
         assert "schedule_batch" in names and "dispatcher_flush" in names
         assert root.attributes.get("bound") == 1
+
+
+class TestExtenders:
+    def _cluster(self, extenders):
+        from kubernetes_tpu.scheduler import Profile, Scheduler, \
+            default_plugins, DEFAULT_WEIGHTS
+        from kubernetes_tpu.framework.runtime import Framework
+        api = APIServer()
+        fwk = Framework("default-scheduler", default_plugins(api),
+                        weights=dict(DEFAULT_WEIGHTS))
+        prof = Profile(framework=fwk, extenders=tuple(extenders))
+        sched = Scheduler(api, profiles=[prof], batch_size=64)
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 50}).obj())
+        return api, sched
+
+    def test_extender_filter_vetoes_nodes(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+
+        def only_even(pod, nodes):
+            keep = [ni for ni in nodes if int(ni.name[1:]) % 2 == 0]
+            failed = {ni.name: "odd node" for ni in nodes
+                      if ni not in keep}
+            return keep, failed
+
+        api, sched = self._cluster([CallableExtender(
+            name="parity", filter_fn=only_even)])
+        for i in range(4):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 4
+        placed = {api.pods[f"default/p{i}"].spec.node_name
+                  for i in range(4)}
+        assert placed <= {"n0", "n2"}
+        assert sched.host_scheduled == 4   # batching disabled
+
+    def test_extender_prioritize_steers_placement(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+
+        def prefer_n3(pod, nodes):
+            return {"n3": 10}
+
+        api, sched = self._cluster([CallableExtender(
+            name="steer", prioritize_fn=prefer_n3, weight=1000)])
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/p"].spec.node_name == "n3"
+
+    def test_ignorable_extender_failure_is_skipped(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+
+        def boom(pod, nodes):
+            raise RuntimeError("extender down")
+
+        api, sched = self._cluster([CallableExtender(
+            name="flaky", filter_fn=boom, ignorable=True)])
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+
+    def test_binder_extender_takes_over_bind(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+        bound = []
+
+        api_holder = {}
+        def ext_bind(pod, node_name):
+            bound.append((pod.name, node_name))
+            api_holder["api"].bind(pod, node_name)
+
+        api, sched = self._cluster([CallableExtender(
+            name="binder", bind_fn=ext_bind)])
+        api_holder["api"] = api
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+        assert bound and bound[0][0] == "p"
+        assert api.pods["default/p"].spec.node_name == bound[0][1]
+
+    def test_total_veto_empty_list(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+
+        def veto_all(pod, nodes):
+            return [], {ni.name: "vetoed" for ni in nodes}
+
+        api, sched = self._cluster([CallableExtender(
+            name="veto", filter_fn=veto_all)])
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 0
+        assert api.pods["default/p"].spec.node_name == ""
