@@ -163,10 +163,10 @@ class WorkloadRunner:
     """Executes one workload's op list against a fresh Scheduler."""
 
     def __init__(self, scheduler_factory: Optional[Callable[[APIServer], Scheduler]] = None,
-                 batch_size: int = 4096):
-        # Big batches amortize the per-device-call synchronization latency
-        # (the assignment readback); the scan itself is sub-microsecond per
-        # pod, so batch size is bounded by queue depth, not device time.
+                 batch_size: int = 8192):
+        # Big batches amortize the per-drain device synchronization (one
+        # ~100ms+ tunnel round trip each); batch size is bounded by queue
+        # depth, not device time.
         self.batch_size = batch_size
         self.factory = scheduler_factory or (
             lambda api: Scheduler(api, batch_size=batch_size))
