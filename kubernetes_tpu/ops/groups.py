@@ -849,12 +849,22 @@ class GroupManager:
                 or snapshot.have_pods_with_required_anti_affinity_list),
         )
 
+    def device_rows(self) -> int:
+        """Row-axis size of the DEVICE group tensors: the padded count of
+        rows that actually exist, not the table's full padded capacity —
+        a one-signature spread workload ships [2, SC, N] tensors instead
+        of [16, SC, N], cutting every per-step group op by the same
+        factor. Crossing a pow2 boundary changes the capacity key, which
+        triggers a full reseed (the scheduler's _gd_capacity check)."""
+        from ..state.tensorize import pow2_at_least
+        return min(pow2_at_least(max(len(self.rows), 1), 2), self.U)
+
     def build_dev(self, snapshot) -> "tuple[GroupsDev, GroupCarry]":
         """Full (GroupsDev, GroupCarry) numpy build for all rows."""
         rows = range(len(self.rows))
         nd = self.node_data(snapshot, rows)
         seeds = self.seed_counts(snapshot, rows)
-        U, N = self.U, self.state.dims.nodes
+        U, N = self.device_rows(), self.state.dims.nodes
         d = self.dims
 
         def full(name, shape, dtype):
@@ -863,30 +873,24 @@ class GroupManager:
             arr[:src.shape[0]] = src
             return arr
 
+        # host-owned per-row / pairwise fields slice via the SAME field
+        # lists grow() and scatter_new_rows use — one classification source
+        sliced = {name: getattr(self, name)[:U].copy()
+                  for name in self._ROW_FIELDS}
+        sliced.update({name: getattr(self, name)[:U, :U].copy()
+                       for name in self._PAIRWISE_FIELDS})
         gd = GroupsDev(
-            spr_f_active=self.spr_f_active.copy(),
-            spr_f_max_skew=self.spr_f_max_skew.copy(),
-            spr_f_self=self.spr_f_self.copy(),
             spr_f_tv=full("spr_f_tv", (U, d.spread_constraints, N), np.int32),
             spr_f_elig=full("spr_f_elig", (U, d.spread_constraints, N), bool),
-            spr_s_active=self.spr_s_active.copy(),
-            spr_s_max_skew=self.spr_s_max_skew.copy(),
-            spr_s_is_host=self.spr_s_is_host.copy(),
             spr_s_tv=full("spr_s_tv", (U, d.spread_constraints, N), np.int32),
             spr_s_elig=full("spr_s_elig", (U, d.spread_constraints, N), bool),
             spr_s_keys_ok=full("spr_s_keys_ok", (U, N), bool),
             spr_s_dom=full("spr_s_dom", (U, d.spread_constraints, N), np.int32),
-            ipa_ra_active=self.ipa_ra_active.copy(),
             ipa_ra_tv=full("ipa_ra_tv", (U, d.ipa_req_terms, N), np.int32),
-            ipa_raa_active=self.ipa_raa_active.copy(),
             ipa_raa_tv=full("ipa_raa_tv", (U, d.ipa_anti_terms, N), np.int32),
-            ipa_self_all=self.ipa_self_all.copy(),
             ipa_stc_tv=full("ipa_stc_tv", (U, d.ipa_cons_terms, N), np.int32),
             ipa_stp_tv=full("ipa_stp_tv", (U, d.ipa_plcd_terms, N), np.int32),
-            m_spr_f=self.m_spr_f.copy(), m_spr_s=self.m_spr_s.copy(),
-            m_ipa_a=self.m_ipa_a.copy(), m_ipa_aa=self.m_ipa_aa.copy(),
-            m_ipa_exist=self.m_ipa_exist.copy(),
-            w_stc=self.w_stc.copy(), w_stp=self.w_stp.copy(),
+            **sliced,
         )
         gc = GroupCarry(
             spr_f_cnt=full("spr_f_cnt", (U, d.spread_constraints, N), np.int32),
@@ -916,16 +920,15 @@ def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
     import jax.numpy as jnp
 
     rows = range(lo, hi)
+    U = gd_dev.spr_f_active.shape[0]   # device row axis (compact, pow2)
     nd = mgr.node_data(snapshot, rows)
     seeds = mgr.seed_counts(snapshot, rows)
     gd_kw = {name: getattr(gd_dev, name).at[lo:hi].set(jnp.asarray(nd[name]))
              for name in nd}
-    for name in ("spr_f_active", "spr_f_max_skew", "spr_f_self",
-                 "spr_s_active", "spr_s_max_skew", "spr_s_is_host",
-                 "ipa_ra_active", "ipa_raa_active", "ipa_self_all",
-                 "m_spr_f", "m_spr_s", "m_ipa_a", "m_ipa_aa", "m_ipa_exist",
-                 "w_stc", "w_stp"):
-        gd_kw[name] = jnp.asarray(getattr(mgr, name))
+    for name in GroupManager._ROW_FIELDS:
+        gd_kw[name] = jnp.asarray(getattr(mgr, name)[:U])
+    for name in GroupManager._PAIRWISE_FIELDS:
+        gd_kw[name] = jnp.asarray(getattr(mgr, name)[:U, :U])
     gc_kw = {name: getattr(gc_dev, name).at[lo:hi].set(jnp.asarray(seeds[name]))
              for name in seeds}
     return gd_dev._replace(**gd_kw), gc_dev._replace(**gc_kw)

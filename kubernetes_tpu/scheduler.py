@@ -311,7 +311,10 @@ class Scheduler:
         self._builder_reset_seen = 0  # builder.reset_count already consumed
         self._gd_dev = None          # GroupsDev (jnp) for the current carry
         self._gd_fam = None          # static active-family mask (jit key)
-        self._gd_capacity = None     # (table_rows, node_bucket) it was built for
+        self._gd_capacity = None     # (groups.device_rows(), node_bucket)
+        #                              the resident group tensors were built
+        #                              for; any pow2 crossing of the live
+        #                              row count (or node growth) reseeds
         self._seeded_rows = 0        # signature rows whose counts are seeded
 
     # -- wiring ---------------------------------------------------------------
@@ -645,7 +648,7 @@ class Scheduler:
             or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
         table_reset = self.builder.reset_count != self._builder_reset_seen
         self._builder_reset_seen = self.builder.reset_count
-        capacity = (self.builder.dims.table_rows, na.used.shape[0])
+        capacity = (self.builder.groups.device_rows(), na.used.shape[0])
         if carry is not None and (
                 table_reset   # every signature id / group row invalidated
                 or carry.used.shape != na.used.shape

@@ -364,16 +364,28 @@ class TestMidCarryRowSeeding:
             api.create_node(make_node(f"n{i}")
                             .capacity({"cpu": "16", "memory": "32Gi", "pods": 110})
                             .zone(f"z{i % 2}").label(HOSTNAME, f"n{i}").obj())
-        # wave 1: establishes a resident carry with groups ON (affinity pod)
+        # wave 1: establishes a resident carry with groups ON (affinity
+        # pod) and THREE signature rows, so wave 2's fourth row stays
+        # inside the pow2-4 device bucket and takes the in-place scatter
+        # path (not a full reseed)
         api.create_pod(make_pod("a0").label("app", "web")
                        .pod_affinity(ZONE, {"app": "web"}, anti=True)
                        .req({"cpu": "100m"}).obj())
         for i in range(4):
             api.create_pod(make_pod(f"w1-{i}").label("app", "plain")
                            .req({"cpu": "100m"}).obj())
-        assert sched.schedule_pending() == 5
+        for i in range(2):
+            api.create_pod(make_pod(f"w1b-{i}").label("app", "other")
+                           .req({"cpu": "200m"}).obj())
+        assert sched.schedule_pending() == 7
         assert sched._device_carry is not None
+        assert sched.builder.groups.device_rows() == 4
         seeded_before = sched._seeded_rows
+        import kubernetes_tpu.ops.groups as groups_mod
+        scatter_calls = []
+        orig_scatter = groups_mod.scatter_new_rows
+        groups_mod.scatter_new_rows = (
+            lambda *a, **k: scatter_calls.append(1) or orig_scatter(*a, **k))
         # wave 2: a NEW spread signature arrives; the carry must stay
         # resident and the new row gets seeded in place
         for i in range(6):
@@ -381,7 +393,11 @@ class TestMidCarryRowSeeding:
                            .spread_constraint(1, ZONE, "DoNotSchedule",
                                               {"app": "spread"})
                            .req({"cpu": "250m"}).obj())
-        assert sched.schedule_pending() == 6
+        try:
+            assert sched.schedule_pending() == 6
+        finally:
+            groups_mod.scatter_new_rows = orig_scatter
+        assert scatter_calls, "new row must seed via scatter, not reseed"
         assert sched._seeded_rows > seeded_before
         # skew must hold across zones
         zone_of = {f"n{i}": f"z{i % 2}" for i in range(4)}
