@@ -162,3 +162,55 @@ class TestActivateAndNominator:
         assert [x.pod.name for x in q.nominator.pods_for_node("node-1")] == ["p"]
         q.nominator.delete(p)
         assert q.nominator.pods_for_node("node-1") == []
+
+
+def test_default_sort_key_matches_less():
+    """default_queue_sort_key must induce exactly default_queue_sort_less's
+    order (the bulk drain depends on it)."""
+    import random
+    from kubernetes_tpu.backend.queue import (default_queue_sort_key,
+                                              default_queue_sort_less)
+    from kubernetes_tpu.framework.types import PodInfo, QueuedPodInfo
+    from kubernetes_tpu.testing.wrappers import make_pod
+    rng = random.Random(5)
+    qpis = [QueuedPodInfo(pod_info=PodInfo.of(
+                make_pod(f"p{i}").priority(rng.randint(0, 3)).obj()),
+            timestamp=float(rng.randint(0, 3))) for i in range(40)]
+    by_key = sorted(qpis, key=default_queue_sort_key)
+    # insertion sort by the less-fn gives the canonical order
+    by_less = []
+    for q in qpis:
+        i = 0
+        while i < len(by_less) and default_queue_sort_less(by_less[i], q):
+            i += 1
+        by_less.insert(i, q)
+    assert [q.pod.uid for q in by_key] == [q.pod.uid for q in by_less]
+
+
+def test_bulk_drain_matches_per_pop():
+    import random
+    from kubernetes_tpu.backend.queue import SchedulingQueue
+    from kubernetes_tpu.testing.wrappers import make_pod
+    rng = random.Random(7)
+    pods = [make_pod(f"p{i}").priority(rng.randint(0, 4)).obj()
+            for i in range(50)]
+    q1 = SchedulingQueue(clock=lambda: 0.0)
+    q2 = SchedulingQueue(clock=lambda: 0.0)
+    for p in pods:
+        q1.add(p)
+        q2.add(p)
+    bulk = q1.drain()                      # sort fast path
+    singles = []
+    while True:                            # per-pop path
+        qpi = q2.pop()
+        if qpi is None:
+            break
+        singles.append(qpi)
+    assert [x.pod.uid for x in bulk] == [x.pod.uid for x in singles]
+    # capped drain: remainder stays poppable in order
+    q3 = SchedulingQueue(clock=lambda: 0.0)
+    for p in pods:
+        q3.add(p)
+    first = q3.drain(20)
+    rest = q3.drain()
+    assert [x.pod.uid for x in first + rest] == [x.pod.uid for x in singles]

@@ -1,0 +1,112 @@
+"""Soak: sustained mixed churn with invariant checks every cycle.
+
+The aux-subsystem analog of the reference's race/leak detection: drive the
+scheduler through waves of creation, deletion, cordoning, preemption, gang
+arrivals, and volume binds, and after EVERY wave assert the cross-layer
+invariants that silent state corruption would break:
+
+- reconcile() clean (device carry == host cache == snapshot)
+- no assumed pod outlives its bind (cache.assumed_pods drains)
+- no waiting pod leaks past its gang's resolution
+- every bound pod's node exists and its uid appears exactly once
+- scheduler counters stay consistent with the API server's bindings
+"""
+
+import random
+
+from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _invariants(api, sched):
+    assert sched.reconcile() == [], "carry/cache divergence"
+    # assumed pods must all be confirmed after flush
+    assert not sched.cache.assumed_pods, sched.cache.assumed_pods
+    for uid, rec in sched._waiting_pods.items():
+        assert uid in api.pods, f"waiting pod {uid} deleted but parked"
+    bound_nodes = [p.spec.node_name for p in api.pods.values()
+                   if p.spec.node_name]
+    for n in bound_nodes:
+        assert n in api.nodes, f"pod bound to missing node {n}"
+    # cache pod view matches the API server's bound set
+    cache_pods = {uid for uid, ps in sched.cache.pod_states.items()}
+    api_bound = {p.uid for p in api.pods.values() if p.spec.node_name}
+    assert api_bound <= cache_pods | set(sched._waiting_pods)
+
+
+def test_mixed_soak():
+    rng = random.Random(1234)
+    api = APIServer()
+    clock = Clock()
+    sched = Scheduler(api, batch_size=64, clock=clock)
+    for i in range(10):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": 16, "memory": "32Gi", "pods": 60})
+                        .zone(f"z{i % 3}").obj())
+    api.create_workload(Workload(metadata=ObjectMeta(name="gang"),
+                                 pod_groups=[PodGroup(name="w", min_count=4)]))
+    seq = 0
+    live: list[str] = []
+    for wave in range(25):
+        action = rng.random()
+        if action < 0.5:
+            # create a mixed batch
+            for _ in range(rng.randint(3, 10)):
+                kind = rng.random()
+                w = make_pod(f"s{seq}").req(
+                    {"cpu": f"{rng.randint(1, 6) * 250}m",
+                     "memory": f"{rng.randint(1, 4) * 512}Mi"})
+                if kind < 0.2:
+                    w = w.label("app", "x").spread_constraint(
+                        2, ZONE, "DoNotSchedule", {"app": "x"})
+                elif kind < 0.3:
+                    w = w.priority(rng.randint(50, 100))
+                elif kind < 0.4:
+                    w = w.workload("gang")
+                p = w.obj()
+                api.create_pod(p)
+                live.append(p.uid)
+                seq += 1
+        elif action < 0.7 and live:
+            # delete a few random pods (bound or pending)
+            for _ in range(rng.randint(1, 4)):
+                if not live:
+                    break
+                uid = live.pop(rng.randrange(len(live)))
+                if uid in api.pods:
+                    api.delete_pod(uid)
+        elif action < 0.85:
+            # cordon / uncordon a node
+            i = rng.randrange(10)
+            node = api.nodes[f"n{i}"]
+            w = make_node(f"n{i}").capacity(
+                {"cpu": 16, "memory": "32Gi", "pods": 60}).zone(f"z{i % 3}")
+            if not node.spec.unschedulable:
+                w = w.unschedulable()
+            api.update_node(w.obj())
+        else:
+            # time passes: backoffs expire, gang deadlines approach
+            clock.t += rng.choice([5.0, 40.0, 400.0])
+            sched.flush_queues()
+        sched.schedule_pending()
+        _invariants(api, sched)
+    # drain everything outstanding
+    for _ in range(6):
+        clock.t += 60.0
+        sched.flush_queues()
+        sched.schedule_pending()
+        _invariants(api, sched)
+    assert api.binding_count == sched.metrics.api_dispatcher_calls.value(
+        "pod_binding", "success")
