@@ -1,0 +1,91 @@
+"""`python -m kubernetes_tpu` — the scheduler binary.
+
+Mirrors cmd/kube-scheduler (app/server.go): options → Setup → Run with
+the operational endpoints up. The in-memory API server stands in for the
+cluster API; a demo workload (optional) exercises the scheduling loop so
+/metrics and /statusz show live numbers.
+
+    python -m kubernetes_tpu --port 10259
+    python -m kubernetes_tpu --config scheduler.yaml --demo 1000
+
+The run loop ticks leader election, flushes queue timers, schedules
+pending pods, and sleeps — the synchronous analog of scheduler.Run
+(scheduler.go:538) driving ScheduleOne until the context ends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes_tpu",
+                                 description="TPU-native batch scheduler")
+    ap.add_argument("--config", help="KubeSchedulerConfiguration YAML")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10259,
+                    help="healthz/readyz/metrics/statusz port (0 = ephemeral)")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--demo", type=int, default=0, metavar="PODS",
+                    help="create a demo cluster and schedule PODS pods")
+    ap.add_argument("--once", action="store_true",
+                    help="run one scheduling pass and exit (for scripting)")
+    args = ap.parse_args(argv)
+
+    from .backend.apiserver import APIServer
+    from .scheduler import Scheduler
+    from .server import LeaderElector, SchedulerServer
+    from .utils.tracing import Tracer
+
+    cfg = None
+    if args.config:
+        from .config import load
+        cfg = load(args.config)
+
+    api = APIServer()
+    sched = Scheduler(api, config=cfg, tracer=Tracer(slow_threshold_s=1.0))
+    elector = (LeaderElector(api, identity=f"scheduler-{id(api):x}")
+               if args.leader_elect else None)
+    server = SchedulerServer(sched, host=args.host, port=args.port,
+                             elector=elector).start()
+    print(f"serving on http://{args.host}:{server.port} "
+          f"(/healthz /readyz /metrics /statusz)", file=sys.stderr)
+
+    if args.demo:
+        from .testing.wrappers import make_node, make_pod
+        n_nodes = max(args.demo // 10, 4)
+        for i in range(n_nodes):
+            api.create_node(make_node(f"node-{i}").capacity(
+                {"cpu": 32, "memory": "64Gi", "pods": 110})
+                .zone(f"zone-{i % 3}").obj())
+        for i in range(args.demo):
+            api.create_pod(make_pod(f"demo-{i}").req(
+                {"cpu": "900m", "memory": "1Gi"}).obj())
+        print(f"demo: {n_nodes} nodes, {args.demo} pods", file=sys.stderr)
+
+    try:
+        while True:
+            if elector is not None:
+                elector.tick()
+            if elector is None or elector.is_leader():
+                sched.flush_queues()
+                bound = sched.schedule_pending()
+                if bound:
+                    print(f"scheduled {bound} pods "
+                          f"(total {sched.scheduled_count})", file=sys.stderr)
+            if args.once:
+                break
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if elector is not None:
+            elector.release()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

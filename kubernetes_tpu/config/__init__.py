@@ -61,6 +61,9 @@ class KubeSchedulerConfiguration:
     pod_max_backoff_seconds: float = 10.0            # types.go:84
     # TPU batch shape (replaces Parallelism, types.go:58)
     batch_size: int = 512
+    # names of out-of-tree plugins registered in the caller's Registry
+    # (accepted by validation; resolved by build_profiles' registry)
+    extra_plugins: tuple = ()
 
     # -- validation (apis/config/validation/validation.go) -------------------
 
@@ -79,7 +82,7 @@ class KubeSchedulerConfiguration:
             raise ValueError("percentageOfNodesToScore must be in (0, 100]")
         if self.batch_size <= 0:
             raise ValueError("batchSize must be > 0")
-        known = set(_default_plugin_names())
+        known = set(_default_plugin_names()) | set(self.extra_plugins)
         for p in self.profiles:
             for n in p.plugins.enabled + p.plugins.disabled:
                 if n not in known and n != "*":
@@ -105,6 +108,7 @@ class KubeSchedulerConfiguration:
             "podInitialBackoffSeconds": self.pod_initial_backoff_seconds,
             "podMaxBackoffSeconds": self.pod_max_backoff_seconds,
             "batchSize": self.batch_size,
+            "extraPlugins": list(self.extra_plugins),
         }
 
     @staticmethod
@@ -127,7 +131,8 @@ class KubeSchedulerConfiguration:
             pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds",
                                               1.0),
             pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
-            batch_size=d.get("batchSize", 512))
+            batch_size=d.get("batchSize", 512),
+            extra_plugins=tuple(d.get("extraPlugins", ())))
 
 
 def load(path: str) -> KubeSchedulerConfiguration:
@@ -144,14 +149,36 @@ def _default_plugin_names() -> list[str]:
     return [p.name() for p in default_plugins()] + ["DefaultPreemption"]
 
 
-def build_profiles(cfg: KubeSchedulerConfiguration, client=None):
+def default_registry(client=None):
+    """Registry of plugin factories (runtime/registry.go NewInTreeRegistry
+    analog): every in-tree plugin by name. Out-of-tree plugins register
+    additional factories and become enable-able through the config.
+
+    Factories construct a FRESH instance per call: plugin objects carry
+    per-scheduler handles (gang Handle, volume reserved-PV sets), so
+    sharing one instance across profiles or Scheduler instances would
+    cross their state."""
+    from ..framework.runtime import Registry
+    from ..scheduler import default_plugins
+    reg = Registry()
+    for name in [p.name() for p in default_plugins(client)]:
+        def factory(_name=name):
+            return next(p for p in default_plugins(client)
+                        if p.name() == _name)
+        reg.register(name, factory)
+    return reg
+
+
+def build_profiles(cfg: KubeSchedulerConfiguration, client=None,
+                   registry=None):
     """Config → the Scheduler's Profile list (profile.NewMap analog,
-    profile/profile.go:46): defaults ± enable/disable, weights applied,
-    ScoreConfig strategy set per profile."""
+    profile/profile.go:46): defaults ± enable/disable through the plugin
+    registry, weights applied, ScoreConfig strategy set per profile."""
     from ..framework.runtime import Framework
     from ..ops.program import ScoreConfig
     from ..scheduler import DEFAULT_WEIGHTS, Profile, default_plugins
 
+    registry = registry or default_registry(client)
     out = []
     for p in cfg.profiles:
         plugins = default_plugins(client)
@@ -164,10 +191,15 @@ def build_profiles(cfg: KubeSchedulerConfiguration, client=None):
         for name in p.plugins.enabled:
             if name in have:
                 continue
-            pl = next((d for d in default_plugins(client)
-                       if d.name() == name), None)
-            if pl is not None:
-                plugins.append(pl)
+            factory = registry.factories.get(name)
+            if factory is None:
+                # validation vouched for the name (possibly via
+                # extra_plugins) — silently running without it would be a
+                # config lie
+                raise ValueError(
+                    f"plugin {name!r} enabled by profile "
+                    f"{p.scheduler_name!r} has no registered factory")
+            plugins.append(factory())
         weights = dict(DEFAULT_WEIGHTS)
         weights.update(p.plugin_weights)
         fwk = Framework(p.scheduler_name, plugins, weights=weights)

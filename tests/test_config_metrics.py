@@ -220,3 +220,27 @@ class TestMultiProfile:
         api.create_pod(p)
         assert sched.schedule_pending() == 0
         assert api.pods["default/alien"].spec.node_name == ""
+
+
+class TestRegistry:
+    def test_factories_build_fresh_instances(self):
+        from kubernetes_tpu.config import default_registry
+        reg = default_registry()
+        a = reg.factories["GangScheduling"]()
+        b = reg.factories["GangScheduling"]()
+        assert a is not b
+
+    def test_enabled_without_factory_raises(self):
+        cfg = KubeSchedulerConfiguration(
+            extra_plugins=("MyPlugin",),
+            profiles=[KubeSchedulerProfile(
+                plugins=PluginSet(enabled=["MyPlugin"]))])
+        cfg.validate()   # name is vouched for...
+        with pytest.raises(ValueError, match="no registered factory"):
+            build_profiles(cfg)  # ...but no factory: must not run without it
+
+    def test_extra_plugins_round_trip(self):
+        cfg = KubeSchedulerConfiguration(extra_plugins=("MyPlugin",))
+        again = KubeSchedulerConfiguration.from_dict(cfg.to_dict())
+        assert again.extra_plugins == ("MyPlugin",)
+        again.validate()

@@ -217,3 +217,23 @@ class TestExtenders:
         api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"}).obj())
         assert sched.schedule_pending() == 0
         assert api.pods["default/p"].spec.node_name == ""
+
+
+class TestMainEntry:
+    def test_once_demo_run(self, capsys):
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from kubernetes_tpu.__main__ import main
+        rc = main(["--port", "0", "--demo", "40", "--once"])
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "scheduled 40 pods" in err
+
+    def test_once_with_config(self, tmp_path, capsys):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("batchSize: 32\n")
+        from kubernetes_tpu.__main__ import main
+        rc = main(["--port", "0", "--config", str(p), "--demo", "10",
+                   "--once", "--leader-elect"])
+        assert rc == 0
+        assert "scheduled 10 pods" in capsys.readouterr().err
