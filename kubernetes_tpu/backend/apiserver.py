@@ -54,22 +54,34 @@ class APIServer:
     pv_handlers: list[WatchHandlers] = field(default_factory=list)
     binding_count: int = 0
 
-    # -- watch registration ---------------------------------------------------
+    # -- watch registration (LIST+WATCH: informer semantics) ------------------
+    # client-go informers LIST current state before watching; a handler
+    # registered against a live store immediately receives synthetic adds
+    # for every existing object. This is what makes scheduler restart
+    # recovery work: a fresh Scheduler rebuilds its cache/queue/device
+    # state purely from these replays (cache.go's resync story).
+
+    @staticmethod
+    def _register(handlers: list, store: dict, h: WatchHandlers) -> None:
+        handlers.append(h)
+        if h.on_add:
+            for obj in list(store.values()):
+                h.on_add(obj)
 
     def watch_pods(self, h: WatchHandlers) -> None:
-        self.pod_handlers.append(h)
+        self._register(self.pod_handlers, self.pods, h)
 
     def watch_nodes(self, h: WatchHandlers) -> None:
-        self.node_handlers.append(h)
+        self._register(self.node_handlers, self.nodes, h)
 
     def watch_workloads(self, h: WatchHandlers) -> None:
-        self.workload_handlers.append(h)
+        self._register(self.workload_handlers, self.workloads, h)
 
     def watch_pvcs(self, h: WatchHandlers) -> None:
-        self.pvc_handlers.append(h)
+        self._register(self.pvc_handlers, self.pvcs, h)
 
     def watch_pvs(self, h: WatchHandlers) -> None:
-        self.pv_handlers.append(h)
+        self._register(self.pv_handlers, self.pvs, h)
 
     # -- pods -----------------------------------------------------------------
 

@@ -402,13 +402,15 @@ class Scheduler:
         self._handle_failure(rec.qpi, err, try_preempt=False)
 
     def _register_event_handlers(self) -> None:
-        """eventhandlers.go:499 addAllEventHandlers."""
-        self.client.watch_pods(WatchHandlers(
-            on_add=self._on_pod_add, on_update=self._on_pod_update,
-            on_delete=self._on_pod_delete))
+        """eventhandlers.go:499 addAllEventHandlers. Registration order
+        matters on a live store: nodes replay before pods so bound pods
+        land on real cache entries instead of imputed placeholders."""
         self.client.watch_nodes(WatchHandlers(
             on_add=self._on_node_add, on_update=self._on_node_update,
             on_delete=self._on_node_delete))
+        self.client.watch_pods(WatchHandlers(
+            on_add=self._on_pod_add, on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete))
         if hasattr(self.client, "watch_workloads"):
             self.client.watch_workloads(WatchHandlers(
                 on_add=self._on_workload_add))
