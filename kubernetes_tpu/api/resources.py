@@ -48,7 +48,7 @@ def _ceil(x: float) -> int:
     return math.ceil(x - 1e-9)
 
 
-@functools.lru_cache(maxsize=4096)
+@functools.lru_cache(maxsize=8192)
 def _parse_quantity_str(value: str, resource: str) -> int:
     m = _QTY_RE.match(value.strip())
     if not m:
