@@ -384,6 +384,10 @@ class PodSpec:
     preemption_policy: str = "PreemptLowerPriority"
     # volumes the scheduler inspects (PVC refs + inline CSI)
     volumes: list[Volume] = field(default_factory=list)
+    # node features this pod requires (nodedeclaredfeatures plugin; the
+    # reference INFERS these from spec fields via the ndf library — our
+    # object model declares them directly)
+    required_node_features: tuple[str, ...] = ()
     # gang scheduling: name of the Workload/pod-group this pod belongs to
     # (reference: scheduling/v1alpha1.Workload via pod labels; we model it as
     # a direct field + the label fallback used by workloadmanager).
@@ -464,6 +468,8 @@ class NodeStatus:
     capacity: dict[str, int] = field(default_factory=dict)
     allocatable: dict[str, int] = field(default_factory=dict)
     images: list[ContainerImage] = field(default_factory=list)
+    # features the node runtime declares (node.status.declaredFeatures)
+    declared_features: tuple[str, ...] = ()
 
 
 @dataclass

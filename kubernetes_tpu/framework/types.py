@@ -40,10 +40,11 @@ class ActionType(enum.IntFlag):
     UPDATE_POD_SCALE_DOWN = 256
     UPDATE_POD_TOLERATION = 512
     UPDATE_POD_SCHEDULING_GATES = 1024
+    UPDATE_NODE_DECLARED_FEATURE = 2048
     UPDATE = (UPDATE_NODE_ALLOCATABLE | UPDATE_NODE_LABEL | UPDATE_NODE_TAINT
               | UPDATE_NODE_CONDITION | UPDATE_NODE_ANNOTATION | UPDATE_POD_LABEL
               | UPDATE_POD_SCALE_DOWN | UPDATE_POD_TOLERATION
-              | UPDATE_POD_SCHEDULING_GATES)
+              | UPDATE_POD_SCHEDULING_GATES | UPDATE_NODE_DECLARED_FEATURE)
     ALL = ADD | DELETE | UPDATE
 
 

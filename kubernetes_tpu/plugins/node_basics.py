@@ -237,6 +237,50 @@ class SchedulingGates:
     # handled by queue.update re-running PreEnqueue
 
 
+class NodeDeclaredFeatures:
+    """PF, F, EE — nodedeclaredfeatures/nodedeclaredfeatures.go: every
+    feature the pod requires must appear in the node's declared feature
+    set, else UnschedulableAndUnresolvable. The reference infers the pod's
+    requirements from its spec via the ndf library; our object model
+    declares them directly in spec.required_node_features."""
+
+    def name(self) -> str:
+        return "NodeDeclaredFeatures"
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes):
+        if not pod.spec.required_node_features:
+            return None, Status.skip()
+        return None, Status.success()
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        declared = set(node_info.node.status.declared_features)
+        missing = [f for f in pod.spec.required_node_features
+                   if f not in declared]
+        if missing:
+            return Status.unresolvable(
+                "node declared features check failed - unsatisfied "
+                f"requirements: {', '.join(missing)}",
+                plugin=self.name())
+        return Status.success()
+
+    def events_to_register(self):
+        CEWH, AT, CE, ER = _hint_events()
+
+        def after_node_change(pod: Pod, old, new):
+            from ..framework.types import QueueingHint
+            if new is None:
+                return QueueingHint.QUEUE
+            declared = set(new.status.declared_features)
+            if all(f in declared for f in pod.spec.required_node_features):
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [CEWH(CE(ER.NODE,
+                        AT.ADD | AT.UPDATE_NODE_DECLARED_FEATURE),
+                     after_node_change)]
+
+
 class PrioritySort:
     """QueueSort — queuesort/priority_sort.go: priority desc, then queue
     timestamp asc."""

@@ -73,6 +73,8 @@ def node_update_action(old: Node, new: Node) -> ActionType:
     if (new.spec.taints != old.spec.taints
             or new.spec.unschedulable != old.spec.unschedulable):
         flags |= ActionType.UPDATE_NODE_TAINT
+    if new.status.declared_features != old.status.declared_features:
+        flags |= ActionType.UPDATE_NODE_DECLARED_FEATURE
     return flags
 
 
@@ -112,8 +114,10 @@ def default_plugins(client=None, ns_lister=None) -> list:
                                         VolumeZone)
     from .plugins.volumebinding import VolumeBinding
     # filter order mirrors apis/config/v1/default_plugins.go:30
+    from .plugins.node_basics import NodeDeclaredFeatures
     plugins = [
         SchedulingGates(), GangScheduling(), PrioritySort(),
+        NodeDeclaredFeatures(),
         NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
         NodePorts(), nr.Fit(), VolumeRestrictions(client),
         NodeVolumeLimits(client), VolumeBinding(client), VolumeZone(client),

@@ -265,6 +265,7 @@ class BatchBuilder:
                                     + list(spec.containers))),
             tuple((v.name, v.claim_name, v.csi_driver)
                   for v in spec.volumes),
+            spec.required_node_features,
         )
 
     # -- row compilation ------------------------------------------------------
@@ -277,6 +278,8 @@ class BatchBuilder:
             # the PVC/PV binding state machine is API-coupled (SURVEY §2.4
             # volumebinding): volume-bearing pods keep host semantics
             raise BatchCapacityError("pod has volumes")
+        if pod.spec.required_node_features:
+            raise BatchCapacityError("pod requires declared node features")
         # resources
         reqs = res.pod_requests(pod)
         row = self.state.rtable.vector(reqs)

@@ -121,6 +121,10 @@ class PodWrapper:
             name=f"vol-{len(self.pod.spec.volumes)}", csi_driver=driver))
         return self
 
+    def require_features(self, *features: str) -> "PodWrapper":
+        self.pod.spec.required_node_features = tuple(features)
+        return self
+
     def workload(self, ref: str) -> "PodWrapper":
         self.pod.spec.workload_ref = ref
         return self
@@ -226,6 +230,10 @@ class NodeWrapper:
 
     def taint(self, key: str, value: str = "", effect: str = "NoSchedule") -> "NodeWrapper":
         self.node_obj.spec.taints.append(Taint(key=key, value=value, effect=effect))
+        return self
+
+    def declare_features(self, *features: str) -> "NodeWrapper":
+        self.node_obj.status.declared_features = tuple(features)
         return self
 
     def unschedulable(self, v: bool = True) -> "NodeWrapper":

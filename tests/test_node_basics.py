@@ -128,3 +128,76 @@ class TestPrioritySort:
         a = QueuedPodInfo(PodInfo.of(make_pod().priority(5).obj()), timestamp=1.0)
         b = QueuedPodInfo(PodInfo.of(make_pod().priority(5).obj()), timestamp=2.0)
         assert p.less(a, b) and not p.less(b, a)
+
+
+class TestNodeDeclaredFeatures:
+    def test_requires_declared_features(self):
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node, make_pod
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("plain").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50}).obj())
+        api.create_node(make_node("fancy").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50})
+            .declare_features("UserNamespaces", "RecursiveReadOnlyMounts").obj())
+        api.create_pod(make_pod("needs").req({"cpu": "1", "memory": "1Gi"})
+                       .require_features("UserNamespaces").obj())
+        api.create_pod(make_pod("plain-pod").req(
+            {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 2
+        assert api.pods["default/needs"].spec.node_name == "fancy"
+
+    def test_unsatisfied_is_unresolvable(self):
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node, make_pod
+        class Clock:
+            t = 0.0
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, clock=clock)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50}).obj())
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"})
+                       .require_features("FutureFeature").obj())
+        assert sched.schedule_pending() == 0
+        qpi = sched.queue.unschedulable_pods["default/p"]
+        assert "NodeDeclaredFeatures" in qpi.unschedulable_plugins
+        # a node declaring the feature un-gates it (past the backoff)
+        api.create_node(make_node("n1").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50})
+            .declare_features("FutureFeature").obj())
+        clock.t += 15.0
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
+
+    def test_feature_update_on_existing_node_requeues(self):
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+        class Clock:
+            t = 0.0
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, clock=clock)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50}).obj())
+        api.create_pod(make_pod("p").req({"cpu": "1", "memory": "1Gi"})
+                       .require_features("F").obj())
+        assert sched.schedule_pending() == 0
+        # the EXISTING node gains the feature (kubelet upgrade)
+        api.update_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 50})
+            .declare_features("F").obj())
+        clock.t += 15.0
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
