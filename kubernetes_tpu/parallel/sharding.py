@@ -95,8 +95,8 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 def _sharded_step(cfg: ScoreConfig, axis: str, n_global: int,
                   na_l: NodeArrays, table: PodTableDev,
-                  groups: GroupsDev | None, offset: jnp.ndarray, c: Carry,
-                  x: PodXs):
+                  groups: GroupsDev | None, offset: jnp.ndarray, fam,
+                  c: Carry, x: PodXs):
     """One pod placement on a node shard. Collectives: pmax + pmin (plus the
     global normalization maxes inside _eval_pod and the group-kernel
     collectives described in the module docstring)."""
@@ -104,7 +104,7 @@ def _sharded_step(cfg: ScoreConfig, axis: str, n_global: int,
     pod = _gather_row(table, x)
     mask, score, parts = _eval_pod(cfg, na_l, c, pod, axis=axis,
                                    groups=groups, tidx=x.tidx,
-                                   n_global=n_global)
+                                   n_global=n_global, fam=fam)
     masked = jnp.where(mask, score, -1)
     lbest = jnp.argmax(masked).astype(jnp.int32)
     lscore = masked[lbest]
@@ -132,14 +132,15 @@ def _sharded_step(cfg: ScoreConfig, axis: str, n_global: int,
         # gate here is GLOBAL placement (counts update on every shard's
         # local slice via topology-value sharing)
         c2 = c2._replace(groups=group_update(groups, c2.groups, x.tidx,
-                                             pick, is_chosen, assigned))
+                                             pick, is_chosen, assigned,
+                                             fam=fam))
     return c2, jnp.where(assigned, gbest, -1)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "fam"))
 def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                       carry: Carry, pods: PodXs, table: PodTableDev,
-                      groups: GroupsDev | None = None):
+                      groups: GroupsDev | None = None, fam=None):
     """`ops.program.run_batch` with the node axis sharded over `mesh`.
 
     N (the padded node count) must be divisible by the mesh size; the
@@ -159,7 +160,7 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
         n_local = na_l.cap.shape[0]
         offset = (lax.axis_index(NODE_AXIS) * n_local).astype(jnp.int32)
         step = functools.partial(_sharded_step, cfg, NODE_AXIS, n_global,
-                                 na_l, table_r, groups_l, offset)
+                                 na_l, table_r, groups_l, offset, fam)
         return lax.scan(step, carry_l, pods_r)
 
     fn = jax.shard_map(

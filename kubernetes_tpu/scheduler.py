@@ -191,10 +191,18 @@ class Scheduler:
                  percentage_of_nodes_to_score: Optional[int] = None,
                  config=None,
                  metrics=None,
-                 tracer=None):
+                 tracer=None,
+                 mesh=None):
         """`config` is a config.KubeSchedulerConfiguration — when given it
         supplies profiles, batch size, backoffs and sampling percentage;
-        explicitly passed arguments win over the config's values."""
+        explicitly passed arguments win over the config's values.
+
+        `mesh` (a jax.sharding.Mesh) makes multi-chip first-class: every
+        device segment runs the node-axis-sharded program
+        (parallel/sharding.py run_batch_sharded) with XLA collectives over
+        ICI; the closed-form uniform path (single-device only) is gated
+        off. Decisions are bit-identical to single-device scheduling
+        (tests/test_sharding.py + the scheduler-level mesh test)."""
         self.client = client
         self.clock = clock
         queue_backoffs = {}
@@ -211,6 +219,17 @@ class Scheduler:
                 pod_initial_backoff=config.pod_initial_backoff_seconds,
                 pod_max_backoff=config.pod_max_backoff_seconds)
         self.batch_size = 512 if batch_size is None else batch_size
+        self.mesh = mesh
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            if n_dev & (n_dev - 1):
+                raise ValueError(
+                    f"mesh size {n_dev} must be a power of two: the pow2 "
+                    "node-bucket padding guarantees shard divisibility "
+                    "only then (run_batch_sharded precondition)")
+
+        self._na_sharded = None      # mesh-placed NodeArrays cache
+        self._na_sharded_gen = -1    # staging generation it was built from
         # Compatibility knob (types.go:62): the reference samples nodes to
         # bound filter cost; the TPU program filters ALL nodes in one
         # vectorized pass, so 100 is both the default and the fast path.
@@ -229,6 +248,10 @@ class Scheduler:
         self.cache = Cache(clock=clock)
         self.snapshot = Snapshot()
         self.state = ClusterState()
+        if mesh is not None:
+            # the node bucket must never be smaller than the mesh
+            self.state.dims.nodes = max(self.state.dims.nodes,
+                                        int(mesh.devices.size))
         default_plugins_list = next(iter(self.profiles.values())).framework.plugins
         spread_p = next((p for p in default_plugins_list
                          if p.name() == "PodTopologySpread"), None)
@@ -644,7 +667,7 @@ class Scheduler:
                 # update surfaced images): honor queue order and let the
                 # oracle take the segment
                 return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
-        na = self.state.device_arrays()
+        na = self._node_arrays()
         # group kernels are needed when any signature row carries spread or
         # inter-pod affinity constraints, or when existing cluster pods do
         # (affinity is symmetric: they veto/score ANY incoming pod)
@@ -659,19 +682,29 @@ class Scheduler:
                 table_reset   # every signature id / group row invalidated
                 or carry.used.shape != na.used.shape
                 or groups_needed != (carry.groups is not None)
-                or (groups_needed and capacity != self._gd_capacity)):
+                or (groups_needed and capacity != self._gd_capacity)
+                # sharded group tensors reseed whole: the in-place row
+                # scatter is a single-device optimization
+                or (groups_needed and self.mesh is not None
+                    and self.builder.table_used > self._seeded_rows)):
             # structural change: reseed from the host snapshot
             carry = None
             self.cache.update_snapshot(self.snapshot)
             self.state.apply_snapshot(self.snapshot)
-            na = self.state.device_arrays()
+            na = self._node_arrays()
         if carry is None:
             gcarry = None
             if groups_needed:
                 gd_np, gc_np = self.builder.groups.build_dev(self.snapshot)
-                self._gd_dev = to_device(gd_np)
+                if self.mesh is not None:
+                    from .parallel.sharding import (shard_group_carry,
+                                                    shard_groups)
+                    self._gd_dev = shard_groups(self.mesh, to_device(gd_np))
+                    gcarry = shard_group_carry(self.mesh, to_device(gc_np))
+                else:
+                    self._gd_dev = to_device(gd_np)
+                    gcarry = to_device(gc_np)
                 self._gd_fam = self.builder.groups.families(self.snapshot)
-                gcarry = to_device(gc_np)
             else:
                 self._gd_dev = None
                 self._gd_fam = None
@@ -730,6 +763,22 @@ class Scheduler:
     # below this run length the scan's per-step cost beats the matrix setup
     UNIFORM_RUN_MIN = 16
 
+    def _node_arrays(self):
+        """Device (or mesh-placed) node arrays, cached until the staging
+        generation moves (adopt_carry and every staging write bump it; the
+        single-device cache inside ClusterState has its own flag — the two
+        caches never share invalidation state)."""
+        if self.mesh is None:
+            return self.state.device_arrays()
+        if (self._na_sharded is None
+                or self._na_sharded_gen != self.state.staging_gen):
+            from .parallel.sharding import shard_node_arrays
+            self.state.ensure_arrays()
+            self._na_sharded_gen = self.state.staging_gen
+            self._na_sharded = shard_node_arrays(
+                self.mesh, self.state.arrays)
+        return self._na_sharded
+
     def _cluster_has_prefer_taints(self) -> bool:
         # mask by valid: freed rows of removed nodes keep their taint
         # columns until the slot is rewritten and must not disable the
@@ -778,7 +827,8 @@ class Scheduler:
         flag fails (rare: BalancedAllocation non-monotonicity or a depth-J
         overflow) does the host roll back to that segment's input carry and
         replay with escalation. Returns (carry, assignments[:n])."""
-        fast_ok = (not groups_needed and cfg.strategy == "LeastAllocated"
+        fast_ok = (self.mesh is None
+                   and not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
         if not fast_ok:
             # pow2-bucketed scan: a residual drain must not pay the full
@@ -887,6 +937,10 @@ class Scheduler:
         tidx = np.full((bucket,), batch.tidx[j - 1], np.int32)
         tidx[:m] = batch.tidx[i:j]
         xs = PodXs(valid=valid, sig=sig, tidx=tidx)
+        if self.mesh is not None:
+            from .parallel.sharding import run_batch_sharded
+            return run_batch_sharded(cfg, self.mesh, na, carry, xs, table,
+                                     groups=self._gd_dev, fam=self._gd_fam)
         return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev,
                          fam=self._gd_fam)
 

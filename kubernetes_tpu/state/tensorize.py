@@ -110,6 +110,11 @@ class ClusterState:
     arrays: Optional[NodeArrays] = None  # numpy staging
     _device: Optional[NodeArrays] = None  # jax device copy (lazy)
     _device_dirty: bool = True
+    # monotonic generation of the STAGING arrays: bumped on every mutation
+    # (snapshot writes, growth, adopt_carry) so external caches — e.g. the
+    # scheduler's mesh-sharded copy — can invalidate without sharing the
+    # single-device cache's consume-on-read flag
+    staging_gen: int = 0
 
     # -- index management -----------------------------------------------------
 
@@ -133,6 +138,7 @@ class ClusterState:
         self.dims.nodes = pow2_at_least(len(self.node_names), max(8, old * 2))
         if self.arrays is not None:
             self.arrays = _pad_rows(self.arrays, self.dims.nodes)
+            self.staging_gen += 1
 
     def node_id(self, name: str) -> int:
         """Interned id used for NodeName filter / matchFields."""
@@ -173,6 +179,7 @@ class ClusterState:
             dirty_writes = True
         if dirty_writes or full:
             self._device_dirty = True
+            self.staging_gen += 1
 
     def _write_row(self, idx: int, ni: NodeInfo) -> None:
         a = self.arrays
@@ -258,11 +265,13 @@ class ClusterState:
             self.arrays = a._replace(image_id=pad(a.image_id),
                                      image_size=pad(a.image_size))
         self._device_dirty = True
+        self.staging_gen += 1
 
     def _grow_resources(self) -> None:
         self.dims.resources = self.rtable.width
         if self.arrays is not None:
             self.arrays = _pad_cols(self.arrays, self.dims)
+            self.staging_gen += 1
 
     # -- device transfer ------------------------------------------------------
 
@@ -290,6 +299,7 @@ class ClusterState:
         np.copyto(a.nonzero_used, np.asarray(nonzero_used))
         np.copyto(a.npods, np.asarray(npods))
         np.copyto(a.ports, np.asarray(ports))
+        self.staging_gen += 1
         if touched:
             self.row_gen.update(touched)
         if self._device is not None:

@@ -209,3 +209,40 @@ def test_sharded_image_locality_matches_single_device(n_devices):
                                      initial_carry(na_sh), xs, table)
     np.testing.assert_array_equal(np.asarray(single_assign),
                                   np.asarray(sh_assign))
+
+
+def test_scheduler_mesh_mode_matches_single_device():
+    """Scheduler(mesh=...) runs every segment through the sharded program;
+    bind decisions must match the single-device scheduler exactly,
+    including group constraints and mid-stream arrivals."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    from kubernetes_tpu.backend.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+
+    def run(mesh):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=32, mesh=mesh)
+        for i in range(8):
+            api.create_node(make_node(f"n{i}")
+                            .capacity({"cpu": 8, "memory": "16Gi", "pods": 40})
+                            .zone(f"z{i % 2}")
+                            .label("kubernetes.io/hostname", f"n{i}").obj())
+        total = 0
+        for wave in range(2):
+            for i in range(10):
+                w = make_pod(f"p{wave}-{i}").req(
+                    {"cpu": f"{250 * (1 + i % 3)}m", "memory": "512Mi"})
+                if i % 3 == 0:
+                    w = w.label("app", "s").spread_constraint(
+                        1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                        {"app": "s"})
+                api.create_pod(w.obj())
+            total += sched.schedule_pending()
+        assert sched.reconcile() == []
+        return total, {p.name: p.spec.node_name for p in api.pods.values()}
+
+    single = run(None)
+    sharded = run(make_mesh(4))
+    assert single == sharded
+    assert single[0] == 20
