@@ -139,6 +139,44 @@ class APIServer:
             if h.on_update:
                 h.on_update(old, new)
 
+    def bind_all(self, pods: list[Pod]) -> list[tuple[Pod, Exception]]:
+        """Bulk Binding subresource: each pod arrives with spec.node_name
+        already set (the scheduler's assumed copy). The stored object is
+        derived from `current` exactly like bind() — a client update that
+        landed after the scheduler drained the pod must survive the bind,
+        only nodeName/phase change. Store updates apply first, then
+        handlers fan out. Returns per-pod failures."""
+        failures: list[tuple[Pod, Exception]] = []
+        updates: list[tuple[Pod, Pod]] = []
+        store = self.pods
+        nodes = self.nodes
+        for pod in pods:
+            uid = pod.uid
+            current = store.get(uid)
+            node_name = pod.spec.node_name
+            if current is None:
+                failures.append((pod, NotFound(uid)))
+                continue
+            if current.spec.node_name and current.spec.node_name != node_name:
+                failures.append((pod, Conflict(
+                    f"pod {uid} is already assigned to node "
+                    f"{current.spec.node_name}")))
+                continue
+            if node_name not in nodes:
+                failures.append((pod, NotFound(f"node {node_name}")))
+                continue
+            new = current.with_node_name(node_name)
+            new.status.phase = "Running"
+            store[uid] = new
+            updates.append((current, new))
+        self.binding_count += len(updates)
+        for h in self.pod_handlers:
+            cb = h.on_update
+            if cb:
+                for old, new in updates:
+                    cb(old, new)
+        return failures
+
     def patch_pod_status(self, pod: Pod, condition: dict,
                          nominated_node_name=None) -> None:
         """nominated_node_name: None = leave unchanged, "" = clear (the

@@ -53,6 +53,7 @@ class APIDispatcher:
     on_bind_error: Optional[Callable[[Pod, str, Exception], None]] = None
     metrics: Optional[object] = None  # SchedulerMetrics (api_dispatcher_calls)
     _queue: dict[str, APICall] = field(default_factory=dict)  # uid → pending
+    _binds: list[Pod] = field(default_factory=list)  # bulk fast path (bound pods)
     executed: int = 0
     errors: int = 0
 
@@ -62,10 +63,63 @@ class APIDispatcher:
         if pending is not None:
             if _RELEVANCE[call.call_type] < _RELEVANCE[pending.call_type]:
                 return  # less relevant than what's queued: suppress
+            if (call.call_type == CallType.STATUS_PATCH
+                    and pending.call_type == CallType.STATUS_PATCH):
+                # merge, don't replace (reference call_queue.go Merge): the
+                # newer condition wins, but an unset nominated_node_name
+                # must not drop the pending call's
+                if call.nominated_node_name is None:
+                    call.nominated_node_name = pending.nominated_node_name
+                if call.condition is None:
+                    call.condition = pending.condition
         self._queue[uid] = call
+
+    def add_binds(self, pods: list) -> None:
+        """Bulk enqueue of bind calls for already-assumed pods (each pod
+        carries its node in spec.node_name). The hot path of the batch
+        commit: one list extend instead of B dict transactions."""
+        if self._queue:
+            # a bind supersedes a pending patch — but never a DELETE,
+            # which outranks it (same relevance ordering as add())
+            for p in pods:
+                pending = self._queue.get(p.uid)
+                if pending is not None:
+                    if pending.call_type == CallType.DELETE:
+                        continue
+                    del self._queue[p.uid]
+                self._binds.append(p)
+            return
+        self._binds.extend(pods)
 
     def flush(self) -> int:
         """Execute all pending calls; returns count executed."""
+        n_bulk = 0
+        if self._binds:
+            binds = self._binds
+            self._binds = []
+            n_bulk = len(binds)
+            if hasattr(self.client, "bind_all"):
+                failures = self.client.bind_all(binds)
+            else:
+                failures = []
+                for p in binds:
+                    try:
+                        self.client.bind(p, p.spec.node_name)
+                    except Exception as e:
+                        failures.append((p, e))
+            n_fail = len(failures)
+            self.executed += n_bulk - n_fail
+            self.errors += n_fail
+            if self.metrics is not None:
+                if n_bulk - n_fail:
+                    self.metrics.api_dispatcher_calls.inc(
+                        CallType.BIND.value, "success", by=n_bulk - n_fail)
+                if n_fail:
+                    self.metrics.api_dispatcher_calls.inc(
+                        CallType.BIND.value, "error", by=n_fail)
+            for pod, e in failures:
+                if self.on_bind_error is not None:
+                    self.on_bind_error(pod, pod.spec.node_name, e)
         calls = list(self._queue.values())
         self._queue.clear()
         for call in calls:
@@ -90,7 +144,7 @@ class APIDispatcher:
                 if (call.call_type == CallType.BIND
                         and self.on_bind_error is not None):
                     self.on_bind_error(call.pod, call.node_name, e)
-        return len(calls)
+        return len(calls) + n_bulk
 
     def is_delete_pending(self, uid: str) -> bool:
         """A victim whose DELETE is queued but not flushed is the in-memory
@@ -99,4 +153,4 @@ class APIDispatcher:
         return pending is not None and pending.call_type == CallType.DELETE
 
     def __len__(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._binds)

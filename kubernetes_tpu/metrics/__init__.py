@@ -115,6 +115,24 @@ class Histogram:
         self._sums[key] = self._sums.get(key, 0.0) + value
         self._totals[key] = self._totals.get(key, 0) + 1
 
+    def observe_array(self, values, *labels: str) -> None:
+        """Vectorized observe (metric_recorder.go's batched flush analog):
+        one numpy bucket-count pass for a whole drain's worth of samples
+        instead of a Python observe() per pod."""
+        import numpy as np
+        v = np.asarray(values, float)
+        if v.size == 0:
+            return
+        key = tuple(labels)
+        counts = self._counts.get(key)
+        if counts is None:
+            counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+        idx = np.searchsorted(self.buckets, v, side="left")
+        for b, c in zip(*np.unique(idx, return_counts=True)):
+            counts[int(b)] += int(c)
+        self._sums[key] = self._sums.get(key, 0.0) + float(v.sum())
+        self._totals[key] = self._totals.get(key, 0) + int(v.size)
+
     def count(self, *labels: str) -> int:
         return self._totals.get(tuple(labels), 0)
 

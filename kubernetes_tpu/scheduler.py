@@ -22,6 +22,7 @@ MoveAllToActiveOrBackoffQueue with the matching ClusterEvent.
 from __future__ import annotations
 
 import time as _time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -149,6 +150,60 @@ class Profile:
     extenders: tuple = ()
 
 
+def _needs_per_pod_hooks(profile: "Profile", spec) -> bool:
+    """True when a pod must run the full reserve/permit/pre-bind chain in
+    _assume_and_bind. MUST mirror _assume_and_bind's `run_hooks` gate and
+    _run_pre_bind's volume skip — _fast_commit bypasses both for pods
+    where this returns False, so any change to either gate changes this
+    predicate too."""
+    fwk = profile.framework
+    return bool(
+        ((fwk.reserve_plugins or fwk.permit_plugins)
+         and (not profile.gang_only_hooks
+              or spec.workload_ref or spec.volumes))
+        or (fwk.pre_bind_plugins
+            and (not profile.volume_only_pre_bind or spec.volumes)))
+
+
+@dataclass
+class _RunRec:
+    """One dispatched device run (a uniform top-L call or a scan segment)
+    awaiting readback. `carry_in` is the device carry the run consumed —
+    kept so an inexact uniform result can rewind and replay."""
+
+    kind: str                 # "uniform" | "scan"
+    i: int
+    j: int
+    carry_in: object
+    result: object            # device array: packed[L+2] or assignments
+    L: int = 0
+    J: int = 0
+    uniform: bool = False
+
+
+@dataclass
+class _PendingDrain:
+    """A dispatched-but-uncommitted queue drain: the device results are in
+    flight (copy_to_host_async issued); the host commit (assume + bind +
+    failure handling) runs when they arrive. This is the TPU analog of the
+    reference's async binding cycle (schedule_one.go:123 bindingCycle
+    goroutine): the scheduling algorithm races ahead of the commit I/O."""
+
+    qpis: list
+    profile: object
+    batch: object             # PodBatch (numpy) — kept for replay
+    table: object             # PodTableDev
+    na: object                # NodeArrays used at dispatch
+    n: int
+    groups_needed: bool
+    records: list = field(default_factory=list)
+    dispatched_at: float = 0.0
+
+    def ready(self) -> bool:
+        return all(r.result.is_ready() for r in self.records
+                   if hasattr(r.result, "is_ready"))
+
+
 @dataclass
 class _WaitingPodRec:
     """A pod parked at Permit (reference runtime/waiting_pods_map.go): its
@@ -264,7 +319,7 @@ class Scheduler:
 
         default_fwk = next(iter(self.profiles.values())).framework
         self.queue = SchedulingQueue(
-            pre_enqueue=default_fwk.run_pre_enqueue_plugins,
+            pre_enqueue=self._make_pre_enqueue(default_fwk),
             queueing_hints=self._build_queueing_hints(default_fwk),
             clock=clock, **queue_backoffs)
 
@@ -334,6 +389,19 @@ class Scheduler:
         # segment reseeds from the host snapshot.
         self._device_carry = None
         self._carry_profile = None   # profile whose cfg filled the sig cache
+        # dispatched-but-uncommitted drains (async commit pipeline). Depth
+        # bounds the optimism: device results stream back via
+        # copy_to_host_async while later drains are created/dispatched, so
+        # the ~100ms tunneled readback latency pipelines instead of gating
+        # every drain (SURVEY §7 hard-part 4).
+        self._pending: deque[_PendingDrain] = deque()
+        self.max_inflight_drains = 8
+        # device-resident PodTable cache: rows only append and the version
+        # bumps on every mutation, so one upload serves every drain until
+        # a new signature appears (the per-drain re-upload was ~25 tunnel
+        # transfers each)
+        self._table_dev = None
+        self._table_dev_version = -1
         # group (spread / inter-pod affinity) device state lifecycle
         self._builder_reset_seen = 0  # builder.reset_count already consumed
         self._gd_dev = None          # GroupsDev (jnp) for the current carry
@@ -345,6 +413,28 @@ class Scheduler:
         self._seeded_rows = 0        # signature rows whose counts are seeded
 
     # -- wiring ---------------------------------------------------------------
+
+    @staticmethod
+    def _make_pre_enqueue(fwk: Framework):
+        """PreEnqueue gate with a constant-time fast path: when the only
+        PreEnqueue plugins are the standard pair (SchedulingGates gates on
+        spec.schedulingGates, GangScheduling on spec.workloadRef), a pod
+        with neither field set cannot be gated — skip the plugin loop
+        entirely (this runs once per created pod, on the ingest hot
+        path)."""
+        std_only = all(p.name() in ("SchedulingGates", "GangScheduling")
+                       for p in fwk.pre_enqueue_plugins)
+        if not std_only:
+            return fwk.run_pre_enqueue_plugins
+        run = fwk.run_pre_enqueue_plugins
+        ok = Status.success()
+
+        def pre_enqueue(pod: Pod) -> Status:
+            spec = pod.spec
+            if not spec.scheduling_gates and not spec.workload_ref:
+                return ok
+            return run(pod)
+        return pre_enqueue
 
     @staticmethod
     def _build_queueing_hints(fwk: Framework) -> dict[str, list[ClusterEventWithHint]]:
@@ -559,26 +649,76 @@ class Scheduler:
 
     # -- scheduling: batch path ----------------------------------------------
 
-    def schedule_pending(self, max_batches: int = 0) -> int:
+    def schedule_pending(self, max_batches: int = 0, wait: bool = True) -> int:
         """Drain + schedule everything currently pending. Returns the net
-        number of successful binds (flush failures are not counted)."""
+        number of successful binds committed so far (flush failures are not
+        counted). With `wait=False` the call returns after dispatching:
+        device results still in flight commit on a later call (or
+        `wait_pending()`), which is what lets ingestion of the next pod
+        chunk overlap the tunneled device readback."""
         start = self.scheduled_count
         batches = 0
         while True:
+            # commit whatever has already landed
+            while self._pending and self._pending[0].ready():
+                self._commit_next()
+            self.queue.flush_backoff_completed()
+            if not len(self.queue.active_q):
+                if not wait or not self._pending:
+                    break
+                self.wait_pending()
+                continue    # a commit may have re-activated pods
+            qlen = len(self.queue.active_q)
+            if not wait and qlen < self.batch_size:
+                # adaptive batching: let the queue accumulate so the next
+                # dispatch amortizes the tunnel round trip over more pods
+                # (each device execution costs ~100ms wall through the
+                # tunnel regardless of size — execution COUNT is the cost).
+                # Dispatch early only to fill an idle pipeline, and only
+                # once a minimum worth of pods is available.
+                if self._pending or qlen < max(self.batch_size // 4, 1):
+                    break
+            # device shapes are drain-size independent (uniform L comes
+            # from batch_size, scan buckets from pow2 padding), so take
+            # everything up to the cap — one execution per drain
             qpis = self.queue.drain(self.batch_size)
             if not qpis:
                 break
             with self.tracer.span("scheduling_cycle",
                                   pods=len(qpis)) as cycle:
+                before = self.scheduled_count
                 with self.tracer.span("schedule_batch"):
-                    bound = self._schedule_batch(qpis)
+                    self._schedule_batch(qpis)
+                while len(self._pending) > self.max_inflight_drains:
+                    self._commit_next()
                 with self.tracer.span("dispatcher_flush"):
                     self.dispatcher.flush()
-                cycle.set(bound=bound)
+                cycle.set(bound=self.scheduled_count - before)
             batches += 1
             if max_batches and batches >= max_batches:
                 break
+        if wait:
+            self.wait_pending()
+        elif len(self.dispatcher):
+            self.dispatcher.flush()
         return self.scheduled_count - start
+
+    def wait_pending(self) -> None:
+        """Commit every in-flight drain and flush the dispatcher — the
+        pipeline barrier (reference WaitForCacheSync-style quiescence)."""
+        self._drain_pending()
+        self.dispatcher.flush()
+
+    def prime(self) -> None:
+        """Pre-build the host snapshot and device staging arrays from the
+        current cluster state — the analog of the reference waiting for
+        informer cache sync before serving (WaitForCacheSync,
+        app/server.go): node ingestion cost lands here, not in the first
+        scheduling cycle."""
+        self._drain_pending()
+        self.cache.update_snapshot(self.snapshot)
+        self.state.apply_snapshot(self.snapshot)
+        self.state.ensure_arrays()
 
     def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
         if self.queue.nominator.nominated_pods:
@@ -587,6 +727,7 @@ class Scheduler:
             # program doesn't model nominations, so the host oracle takes
             # over until they resolve — nominations are short-lived (victim
             # deletes flush at the end of the previous cycle)
+            self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         # route per profile (profile.go:46 Map lookup): a drain can mix
         # schedulerNames; each maximal same-profile stretch runs with ITS
@@ -601,6 +742,7 @@ class Scheduler:
                 j += 1
             profile = self.profiles.get(name)
             if profile is None:
+                self._drain_pending()
                 for q in qpis[i:j]:
                     self._schedule_one_host(q)  # drops unowned pods
             else:
@@ -612,21 +754,21 @@ class Scheduler:
                                 profile: Profile) -> int:
         if profile.extenders:
             # no tensor form for webhook hooks: host path, batching off
+            self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0
                        for q in qpis)
         pods = [q.pod for q in qpis]
-        self.cache.update_snapshot(self.snapshot)
-        batch = self.builder.build(pods, snapshot=self.snapshot,
-                                   pad_to=self.batch_size)
+        batch = self.builder.build(pods, pad_to=self.batch_size)
         if not batch.host_fallback.any():
             # common case: whole drain is device-eligible; reuse this build
-            return self._schedule_device_segment(qpis, profile,
-                                                 prebuilt=batch)
+            return self._dispatch_device_drain(qpis, profile,
+                                               prebuilt=batch)
         fallback = batch.host_fallback
         bound = 0
         i = 0
         while i < len(qpis):
             if fallback[i]:
+                self._drain_pending()
                 ok = self._schedule_one_host(qpis[i])
                 bound += 1 if ok else 0
                 i += 1
@@ -634,25 +776,35 @@ class Scheduler:
             j = i + 1
             while j < len(qpis) and not fallback[j]:
                 j += 1
-            bound += self._schedule_device_segment(qpis[i:j], profile)
+            bound += self._dispatch_device_drain(qpis[i:j], profile)
+            # host pods interleave the drain: commit the device stretch now
+            # so queue order is preserved end to end
+            self._drain_pending()
             i = j
         return bound
 
-    def _schedule_device_segment(self, qpis: list[QueuedPodInfo],
-                                 profile: Profile, prebuilt=None) -> int:
+    def _dispatch_device_drain(self, qpis: list[QueuedPodInfo],
+                               profile: Profile, prebuilt=None) -> int:
+        """Build + dispatch one drain's device programs WITHOUT waiting for
+        the results; appends a _PendingDrain whose commit happens when the
+        async host copies land. Returns binds committed inside this call
+        (only the host-fallback retry path commits synchronously)."""
         from .ops.groups import scatter_new_rows, to_device
 
         carry = self._device_carry
         if carry is not None and self._carry_profile != profile.name:
             # the signature cache's s_fit/s_bal were computed under another
             # profile's ScoreConfig: invalidate (sig 0 never matches)
-            import jax.numpy as _jnp
             carry = carry._replace(
-                cache=carry.cache._replace(sig=_jnp.int32(0)))
+                cache=carry.cache._replace(sig=jnp.int32(0)))
+            self._device_carry = carry
         self._carry_profile = profile.name
         if carry is None:
             # reseed device state from the host snapshot (first batch, or an
-            # external event invalidated the resident carry)
+            # external event invalidated the resident carry). Pending
+            # commits mutate the host cache the snapshot is built from, so
+            # they must land first.
+            self._drain_pending()
             self.cache.update_snapshot(self.snapshot)
             self.state.apply_snapshot(self.snapshot)
         if (prebuilt is not None
@@ -660,12 +812,12 @@ class Scheduler:
             segment_batch = prebuilt
         else:
             segment_batch = self.builder.build([q.pod for q in qpis],
-                                               snapshot=self.snapshot,
                                                pad_to=self.batch_size)
             if segment_batch.host_fallback.any():
                 # state moved between routing and segment build (e.g. a node
                 # update surfaced images): honor queue order and let the
                 # oracle take the segment
+                self._drain_pending()
                 return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         na = self._node_arrays()
         # group kernels are needed when any signature row carries spread or
@@ -689,6 +841,7 @@ class Scheduler:
                     and self.builder.table_used > self._seeded_rows)):
             # structural change: reseed from the host snapshot
             carry = None
+            self._drain_pending()
             self.cache.update_snapshot(self.snapshot)
             self.state.apply_snapshot(self.snapshot)
             na = self._node_arrays()
@@ -713,7 +866,14 @@ class Scheduler:
             carry = initial_carry(na, gcarry)
         elif groups_needed and self.builder.table_used > self._seeded_rows:
             # new signature rows while the carry is resident: seed just those
-            # rows from the live snapshot (assumes included) and scatter in
+            # rows from the live snapshot (assumes included) and scatter in.
+            # Pending commits must land first — the seeds count them.
+            self._drain_pending()
+            carry = self._device_carry
+            if carry is None:
+                # a bind error during the drain invalidated the carry:
+                # restart this dispatch against the reseeded state
+                return self._dispatch_device_drain(qpis, profile, prebuilt)
             self.cache.update_snapshot(self.snapshot)
             self._gd_dev, gcarry = scatter_new_rows(
                 self._gd_dev, carry.groups, self.builder.groups,
@@ -721,44 +881,25 @@ class Scheduler:
             self._gd_fam = self.builder.groups.families(self.snapshot)
             carry = carry._replace(groups=gcarry)
             self._seeded_rows = self.builder.table_used
-        table = table_from_batch(segment_batch)
+        if (self._table_dev is None
+                or self._table_dev_version != segment_batch.table_version):
+            self._table_dev = table_from_batch(segment_batch)
+            self._table_dev_version = segment_batch.table_version
+        table = self._table_dev
+        n = len(qpis)
         t0 = _time.perf_counter()
-        with self.tracer.span("device_program", pods=len(qpis),
+        with self.tracer.span("device_dispatch", pods=n,
                               groups=groups_needed):
-            carry, assignments = self._run_device_program(
-                profile.score_config, na, carry, segment_batch, table,
-                len(qpis), groups_needed)
-        batch_dt = _time.perf_counter() - t0
-        self.metrics.device_batch_duration.observe(batch_dt)
-        self.metrics.device_batch_size.observe(len(qpis))
-        # per-attempt latency: the device batch amortizes one scheduling
-        # algorithm pass over the whole drain (metrics.go:214 analog), so
-        # each pod's attempt cost is the batch wall time split evenly
-        per_pod = batch_dt / max(len(qpis), 1)
-        from .metrics import SCHEDULED, UNSCHEDULABLE
-        n_ok = int((assignments >= 0).sum())
-        if n_ok:
-            self.metrics.attempt_duration.observe(per_pod, SCHEDULED,
-                                                  profile.name)
-        if len(qpis) - n_ok:
-            self.metrics.attempt_duration.observe(per_pod, UNSCHEDULABLE,
-                                                  profile.name)
-        # the carry stays device-resident: the only readback per batch is the
-        # assignment vector
+            carry, records = self._dispatch_runs(
+                profile, na, carry, segment_batch, table, n, groups_needed)
         self._device_carry = carry
         self.device_batches += 1
-        bound = 0
-        diag_cache: dict = {}
-        for i, (qpi, a) in enumerate(zip(qpis, assignments)):
-            self.schedule_attempts += 1
-            if a >= 0:
-                node_name = self.state.node_names[int(a)]
-                self._assume_and_bind(qpi, node_name)
-                bound += 1
-            else:
-                err = self._device_fit_error(qpi, profile, diag_cache)
-                self._handle_failure(qpi, err)
-        return bound
+        self.metrics.device_batch_size.observe(n)
+        self._pending.append(_PendingDrain(
+            qpis=qpis, profile=profile, batch=segment_batch, table=table,
+            na=na, n=n, groups_needed=groups_needed, records=records,
+            dispatched_at=t0))
+        return 0
 
     # below this run length the scan's per-step cost beats the matrix setup
     UNIFORM_RUN_MIN = 16
@@ -809,93 +950,229 @@ class Scheduler:
             i = j
         return runs
 
-    def _run_device_program(self, cfg: ScoreConfig, na, carry, batch,
-                            table, n: int, groups_needed: bool):
-        """Route the drain through the fastest exact program — and through
-        the FEWEST device↔host round trips, which on a tunneled TPU
-        dominate everything else (~100ms per sync once the first readback
-        forces synchronous mode).
+    def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
+                       n: int, groups_needed: bool):
+        """Dispatch the drain through the fastest exact program with ZERO
+        host synchronization — results stream back asynchronously and the
+        carry chains device-side.
 
         Maximal same-signature runs collapse to closed-form top-L
         assignment (ops/program.py run_uniform — reference batch.go:97's
         sortedNodes trick, one top_k per run instead of one scan step per
         pod); anything else — short runs, host-port pods (sig 0), group
         constraints, MostAllocated, PreferNoSchedule taints, preferred
-        affinity — keeps the sequential scan. All segments of the drain are
-        dispatched back-to-back with the carry chaining on device; ONE
-        packed readback validates every run's exactness flags. Only when a
-        flag fails (rare: BalancedAllocation non-monotonicity or a depth-J
-        overflow) does the host roll back to that segment's input carry and
-        replay with escalation. Returns (carry, assignments[:n])."""
+        affinity — keeps the sequential scan. Each record carries its input
+        carry so commit-time validation (rare failures:
+        BalancedAllocation non-monotonicity, depth-J overflow) can rewind
+        and replay. Returns (chain carry, [_RunRec])."""
+        cfg = profile.score_config
         fast_ok = (self.mesh is None
                    and not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
         if not fast_ok:
-            # pow2-bucketed scan: a residual drain must not pay the full
-            # standing-batch step count (the group program costs ~ms/step)
-            carry, assignments = self._scan_dispatch(cfg, na, carry, batch,
-                                                     0, n, table)
-            return carry, np.asarray(assignments)[:n]
-        # (the fast path builds per-segment PodXs in _scan_dispatch /
-        # run_uniform; only the signature table ships whole)
-        runs = self._classify_runs(batch, n)
-        out = np.full((n,), -1, np.int32)
-        n_nodes = max(len(self.snapshot.node_info_list), 1)
-        worklist = list(runs)
-        while worklist:
-            # phase A: optimistic dispatch of every remaining segment, no
-            # host synchronization — the carry chains device-side
-            pend = []  # (kind, i, j, carry_before, result_dev, L, J)
-            cur = carry
-            for (i, j, uniform) in worklist:
-                if uniform:
-                    L = pow2_at_least(j - i)
-                    K = min(L, na.cap.shape[0])
-                    J = min(pow2_at_least(4 * (j - i) // n_nodes + 4), L + 1)
-                    c2, packed = run_uniform(
-                        cfg, na, cur, self._xone(batch, i), table,
-                        np.int32(j - i), L, K, J)
-                    pend.append(("uniform", i, j, cur, packed, L, J))
-                else:
-                    c2, assigns = self._scan_dispatch(cfg, na, cur, batch,
-                                                      i, j, table)
-                    pend.append(("scan", i, j, cur, assigns, 0, 0))
-                cur = c2
-            # phase B: one readback for the whole dispatch chain
-            if len(pend) == 1:
-                res = [np.asarray(pend[0][4])]
+            spans = [(0, n, False)]
+        else:
+            spans = self._classify_runs(batch, n)
+        return self._dispatch_spans(cfg, na, batch, table, spans, carry)
+
+    def _uniform_shape(self, na) -> tuple[int, int, int]:
+        """(L, K, J) for run_uniform, chosen to be STABLE across drains:
+        L is the standing batch bucket (run length only masks via
+        n_actual), and J quantizes the node count to its pow2 bucket — so
+        the whole workload compiles ONE uniform executable instead of one
+        per observed run length. On a tunneled TPU a fresh XLA compile
+        costs 20-40s; shape stability is worth more than a minimal J."""
+        L = pow2_at_least(self.batch_size)
+        K = min(L, na.cap.shape[0])
+        n_q = pow2_at_least(max(self.cache.node_count(), 1))
+        J = min(max(pow2_at_least(4 * L // n_q + 4), 8), L + 1)
+        return L, K, J
+
+    def _dispatch_spans(self, cfg: ScoreConfig, na, batch, table,
+                        spans, carry):
+        """Dispatch the given (i, j, uniform) spans back-to-back, chaining
+        the carry on device; issues async host copies so the tunnel
+        transfer overlaps whatever the host does next."""
+        records = []
+        for (i, j, uniform) in spans:
+            if uniform:
+                L, K, J = self._uniform_shape(na)
+                c2, packed = run_uniform(
+                    cfg, na, carry, self._xone(batch, i), table,
+                    np.int32(j - i), L, K, J)
+                records.append(_RunRec("uniform", i, j, carry, packed,
+                                       L, J, True))
             else:
-                flat = np.asarray(jnp.concatenate([p[4] for p in pend]))
-                res, off = [], 0
-                for p in pend:
-                    ln = p[4].shape[0]
-                    res.append(flat[off:off + ln])
-                    off += ln
-            # phase C: validate in order; first failure rolls back
-            carry = cur
-            worklist = []
-            for idx, (kind, i, j, cbef, _dev, L, J) in enumerate(pend):
-                r = res[idx]
-                if kind == "scan":
-                    out[i:j] = r[:j - i]
-                    continue
-                exact, depth = bool(r[L]), bool(r[L + 1])
-                if exact and depth:
-                    out[i:j] = r[:j - i]
-                    continue
-                # rollback: resolve THIS segment synchronously, then
-                # re-dispatch everything after it against the new carry
-                carry = cbef
-                if exact:
-                    carry = self._uniform_escalate(cfg, na, carry, batch,
-                                                   i, j, table, out, J)
-                else:
-                    carry, a = self._scan_dispatch(cfg, na, carry, batch,
-                                                   i, j, table)
-                    out[i:j] = np.asarray(a)[:j - i]
-                worklist = [(pi, pj, pu) for (pi, pj, pu) in runs if pi >= j]
-                break
-        return carry, out
+                c2, assigns = self._scan_dispatch(cfg, na, carry, batch,
+                                                  i, j, table)
+                records.append(_RunRec("scan", i, j, carry, assigns))
+            carry = c2
+        for rec in records:
+            if hasattr(rec.result, "copy_to_host_async"):
+                rec.result.copy_to_host_async()
+        return carry, records
+
+    # -- commit pipeline ------------------------------------------------------
+
+    def _drain_pending(self) -> None:
+        while self._pending:
+            self._commit_next()
+
+    def _commit_next(self) -> None:
+        """Commit the oldest in-flight drain: resolve its device results
+        (blocking only if the async copy hasn't landed), validate the
+        uniform runs' exactness flags, and run the host commit. An inexact
+        run rewinds to its input carry, replays synchronously, then
+        re-dispatches everything downstream — including later pending
+        drains — against the corrected chain."""
+        pd = self._pending.popleft()
+        out = np.full((pd.n,), -1, np.int32)
+        idx = 0
+        while idx < len(pd.records):
+            rec = pd.records[idx]
+            r = np.asarray(rec.result)
+            m = rec.j - rec.i
+            if rec.kind == "scan":
+                out[rec.i:rec.j] = r[:m]
+                idx += 1
+                continue
+            exact, depth = bool(r[rec.L]), bool(r[rec.L + 1])
+            if exact and depth:
+                out[rec.i:rec.j] = r[:m]
+                idx += 1
+                continue
+            # rollback: resolve THIS run synchronously from its input carry
+            cfg = pd.profile.score_config
+            carry = rec.carry_in
+            if exact:
+                carry = self._uniform_escalate(cfg, pd.na, carry, pd.batch,
+                                               rec.i, rec.j, pd.table, out,
+                                               rec.J)
+            else:
+                carry, a = self._scan_dispatch(cfg, pd.na, carry, pd.batch,
+                                               rec.i, rec.j, pd.table)
+                out[rec.i:rec.j] = np.asarray(a)[:m]
+            # re-dispatch the rest of this drain ...
+            spans = [(q.i, q.j, q.uniform) for q in pd.records[idx + 1:]]
+            carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
+                                                   pd.table, spans, carry)
+            pd.records[idx + 1:] = new_recs
+            # ... and every later pending drain, against the new chain
+            prev_profile = pd.profile
+            for pd2 in self._pending:
+                if pd2.profile is not prev_profile:
+                    carry = carry._replace(
+                        cache=carry.cache._replace(sig=jnp.int32(0)))
+                    prev_profile = pd2.profile
+                carry, pd2.records = self._dispatch_runs(
+                    pd2.profile, pd2.na, carry, pd2.batch, pd2.table,
+                    pd2.n, pd2.groups_needed)
+            if self._device_carry is not None:
+                self._device_carry = carry
+            idx += 1
+        self.metrics.device_batch_duration.observe(
+            max(_time.perf_counter() - pd.dispatched_at, 0.0))
+        self._commit_assignments(pd, out)
+
+    def _commit_assignments(self, pd: _PendingDrain, out) -> int:
+        """Host commit of a resolved drain: bulk assume + bind enqueue for
+        hook-free pods, the full reserve/permit/pre-bind chain for the
+        rest, failure handling for the unassigned."""
+        qpis = pd.qpis
+        profile = pd.profile
+        fwk = profile.framework
+        n = pd.n
+        self.schedule_attempts += n
+        from .metrics import SCHEDULED, UNSCHEDULABLE
+        n_ok = int((out >= 0).sum())
+        # attempt latency = dispatch→commit wall time split over the drain.
+        # NOTE: with the async pipeline this includes time the result sat
+        # in flight behind other work — an SLI-style number (queue-to-bind),
+        # deliberately not a device-busy-time measurement.
+        per_pod = max(_time.perf_counter() - pd.dispatched_at, 0.0) / max(n, 1)
+        if n_ok:
+            self.metrics.attempt_duration.observe(per_pod, SCHEDULED,
+                                                  profile.name)
+        if n - n_ok:
+            self.metrics.attempt_duration.observe(per_pod, UNSCHEDULABLE,
+                                                  profile.name)
+        names = self.state.node_names
+        fast: list[tuple[QueuedPodInfo, str]] = []
+        bound = 0
+        diag_cache: dict = {}
+        failures: list[QueuedPodInfo] = []
+        for i in range(n):
+            a = out[i]
+            qpi = qpis[i]
+            if a < 0:
+                failures.append(qpi)
+                continue
+            if _needs_per_pod_hooks(profile, qpi.pod.spec):
+                self._assume_and_bind(qpi, names[int(a)])
+                bound += 1
+            else:
+                fast.append((qpi, names[int(a)]))
+        bound += self._fast_commit(fast, profile)
+        if failures:
+            # diagnosis reads the live snapshot (assumes included)
+            self.cache.update_snapshot(self.snapshot)
+            for qpi in failures:
+                err = self._device_fit_error(qpi, profile, diag_cache)
+                self._handle_failure(qpi, err)
+        return bound
+
+    def _fast_commit(self, pairs: list, profile: Profile) -> int:
+        """Vectorized commit for hook-free pods: the per-pod work of
+        assume (cache.go:369) + FinishBinding + bind enqueue collapsed to
+        the minimum — this loop bounds the whole scheduler's throughput
+        (schedule_one.go:65-136's responsibilities at batch scale)."""
+        if not pairs:
+            return 0
+        from .backend.cache import _PodState
+        cache = self.cache
+        pod_states = cache.pod_states
+        assumed_set = cache.assumed_pods
+        ttl = cache.ttl
+        nominated = self.queue.nominator.nominated_pods
+        in_flight = self.queue.in_flight_pods
+        now = self.clock()
+        bound_pods: list[Pod] = []
+        sli_by_attempts: dict[int, list] = {}
+        for qpi, node_name in pairs:
+            pod = qpi.pod
+            uid = pod.uid
+            if uid in pod_states:
+                in_flight.pop(uid, None)
+                continue
+            assumed = pod.with_node_name(node_name)
+            pi = PodInfo(pod=assumed, requests=qpi.pod_info.requests,
+                         cpu_nonzero=qpi.pod_info.cpu_nonzero,
+                         mem_nonzero=qpi.pod_info.mem_nonzero)
+            cache._add_pod_info_to_node(pi)
+            st = _PodState(pod=assumed, assumed=True, binding_finished=True)
+            if ttl > 0:
+                st.deadline = now + ttl
+            pod_states[uid] = st
+            assumed_set.add(uid)
+            if nominated:
+                self.queue.nominator.delete(pod)
+            in_flight.pop(uid, None)
+            bound_pods.append(assumed)
+            sli_by_attempts.setdefault(qpi.attempts or 1, []).append(
+                now - (qpi.initial_attempt_timestamp or qpi.timestamp))
+            if qpi.unschedulable_plugins:
+                qpi.unschedulable_plugins = set()
+            qpi.consecutive_errors_count = 0
+        if not in_flight:
+            self.queue.in_flight_events.clear()
+        self.dispatcher.add_binds(bound_pods)
+        nb = len(bound_pods)
+        self.scheduled_count += nb
+        from .metrics import SCHEDULED
+        self.metrics.schedule_attempts.inc(SCHEDULED, profile.name, by=nb)
+        for attempts, values in sli_by_attempts.items():
+            self.metrics.sli_duration.observe_array(values, str(attempts))
+        return nb
 
     def _xone(self, batch, i: int) -> PodXs:
         return PodXs(valid=np.bool_(True), sig=np.int32(batch.sig[i]),
@@ -904,11 +1181,11 @@ class Scheduler:
     def _uniform_escalate(self, cfg: ScoreConfig, na, carry, batch,
                           i: int, j: int, table, out, j_failed: int):
         """Depth-J overflow recovery: retry the run with a deeper matrix
-        (synchronous — this path is rare), falling back to the scan if
-        even J=L+1 reports failure (can't happen semantically, but belt
-        and braces)."""
-        L = pow2_at_least(j - i)
-        K = min(L, na.cap.shape[0])
+        (synchronous — this path is rare, and the only one that mints
+        non-standard J shapes), falling back to the scan if even J=L+1
+        reports failure (can't happen semantically, but belt and
+        braces)."""
+        L, K, _ = self._uniform_shape(na)
         J = j_failed
         while J < L + 1:
             J = min(8 * J, L + 1)
@@ -948,6 +1225,7 @@ class Scheduler:
         """Debug/divergence check (cache debugger analog): pull the resident
         device carry into staging and compare against the host cache truth.
         Returns divergent node names; [] when scan bookkeeping matches."""
+        self._drain_pending()
         self.cache.update_snapshot(self.snapshot)
         if self._device_carry is not None:
             c = self._device_carry
@@ -997,6 +1275,7 @@ class Scheduler:
 
     def schedule_one(self) -> bool:
         """Reference ScheduleOne: pop + host-schedule a single pod."""
+        self._drain_pending()
         qpi = self.queue.pop()
         if qpi is None:
             return False
@@ -1062,7 +1341,8 @@ class Scheduler:
         cs = state or CycleState()
         # volume-free pods under gang-only hooks skip reserve/permit; a pod
         # with PVC volumes always runs the full chain (VolumeBinding holds
-        # its per-node decisions in the CycleState from the host filter)
+        # its per-node decisions in the CycleState from the host filter).
+        # Mirrored by _needs_per_pod_hooks — keep the gates in lockstep.
         run_hooks = (fwk.reserve_plugins or fwk.permit_plugins) and (
             pod.spec.workload_ref or pod.spec.volumes
             or not profile.gang_only_hooks)
@@ -1226,6 +1506,7 @@ class Scheduler:
     def flush_queues(self) -> None:
         """SchedulingQueue.Run periodic work (scheduling_queue.go:406-413)
         + the WaitOnPermit timeout sweep (waiting_pods_map.go timers)."""
+        self._drain_pending()
         now = self.clock()
         for uid, rec in list(self._waiting_pods.items()):
             if rec.deadline <= now:

@@ -127,6 +127,14 @@ class BatchBuilder:
         self.reset_count = 0
         # signature key → ("row", sig_id, tidx) | ("fallback", reason)
         self._sig_cache: dict[tuple, tuple] = {}
+        # identity fast path: pods stamped from a shared template (the
+        # reference's typical controller-replica shape) share their spec and
+        # label dict OBJECTS; (id(spec), id(labels), ns) then implies an
+        # identical signature without recomputing the content key. Values
+        # hold strong refs to the keyed objects so ids can't be recycled.
+        # Relies on the object-model aliasing contract (api/types.py): specs
+        # and label dicts are immutable once a pod is created.
+        self._ident_cache: dict[tuple, tuple] = {}
         self._next_sig = 1
         self.table = _zero_table(self.dims.table_rows,
                                  state.dims.resources, self.dims)
@@ -141,6 +149,7 @@ class BatchBuilder:
     def _reset_table(self) -> None:
         self.reset_count += 1
         self._sig_cache.clear()
+        self._ident_cache.clear()
         self.table = _zero_table(self.dims.table_rows,
                                  self.state.dims.resources, self.dims)
         self.table_used = 0
@@ -200,9 +209,17 @@ class BatchBuilder:
                         table_version=self.table_version)
 
     def _lookup(self, pod: Pod) -> tuple:
+        ident = (id(pod.spec), id(pod.metadata.labels),
+                 pod.metadata.namespace)
+        hit = self._ident_cache.get(ident)
+        if hit is not None:
+            return hit[2]
         key = self._sig_key(pod)
         ent = self._sig_cache.get(key)
         if ent is not None:
+            if len(self._ident_cache) < 65536:
+                self._ident_cache[ident] = (pod.spec, pod.metadata.labels,
+                                            ent)
             return ent
         if self.table_used >= self.table.req.shape[0]:
             self._grow_table()
@@ -225,6 +242,8 @@ class BatchBuilder:
             self.table_version += 1
             ent = ("row", sig_id, u)
         self._sig_cache[key] = ent
+        if len(self._ident_cache) < 65536:
+            self._ident_cache[ident] = (pod.spec, pod.metadata.labels, ent)
         return ent
 
     # -- signature (signers.go analog, content-level) -------------------------

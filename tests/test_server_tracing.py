@@ -128,7 +128,10 @@ class TestTracing:
         root = tr.slow_cycles[-1]
         names = [c.name for c in root.children]
         assert "schedule_batch" in names and "dispatcher_flush" in names
-        assert root.attributes.get("bound") == 1
+        # async commit pipeline: the bind may land after the cycle span
+        # closes (wait_pending), so `bound` counts commits inside the cycle
+        assert root.attributes.get("pods") == 1
+        assert root.attributes.get("bound") in (0, 1)
 
 
 class TestExtenders:
