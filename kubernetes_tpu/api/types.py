@@ -392,6 +392,9 @@ class PodSpec:
     # (reference: scheduling/v1alpha1.Workload via pod labels; we model it as
     # a direct field + the label fallback used by workloadmanager).
     workload_ref: str = ""
+    # DRA: names of ResourceClaims (same namespace) this pod consumes
+    # (core/v1 PodSpec.ResourceClaims → resourceClaimName)
+    resource_claims: tuple[str, ...] = ()
 
 
 @dataclass
@@ -506,3 +509,141 @@ class Workload:
 def pod_group_key(pod: Pod) -> str:
     """Identity of the gang a pod belongs to ("" = not gang-scheduled)."""
     return pod.spec.workload_ref or pod.metadata.labels.get("scheduling.k8s.io/workload", "")
+
+
+# ---------------------------------------------------------------------------
+# Dynamic Resource Allocation (reference: staging/src/k8s.io/api/resource/
+# v1/types.go — ResourceSlice, ResourceClaim with structured parameters;
+# consumed by plugins/dynamicresources/, registry.go:48)
+
+
+@dataclass(frozen=True)
+class Device:
+    """resource/v1 Device (basic): a named device with string attributes
+    (the structured-parameters selector surface)."""
+
+    name: str
+    attributes: tuple[tuple[str, str], ...] = ()
+
+    def attr(self, key: str) -> Optional[str]:
+        for k, v in self.attributes:
+            if k == key:
+                return v
+        return None
+
+
+@dataclass
+class ResourceSlice:
+    """resource/v1 ResourceSlice: one node's published device pool for one
+    driver (types.go ResourceSliceSpec: nodeName + driver + devices)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    driver: str = ""
+    devices: list[Device] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class DeviceRequest:
+    """resource/v1 DeviceRequest (exactly-count mode): ask `count` devices
+    of `driver` whose attributes match every selector entry."""
+
+    name: str = "req-0"
+    driver: str = ""
+    count: int = 1
+    selectors: dict[str, str] = field(default_factory=dict)
+
+    def matches(self, device: Device) -> bool:
+        return all(device.attr(k) == v for k, v in self.selectors.items())
+
+
+@dataclass
+class DeviceAllocation:
+    """resource/v1 AllocationResult (reduced): which devices on which node
+    satisfied each request."""
+
+    node_name: str = ""
+    # request name → (driver, device name) tuples
+    results: dict[str, tuple[tuple[str, str], ...]] = field(default_factory=dict)
+
+    def device_ids(self) -> set[tuple[str, str, str]]:
+        """(node, driver, device) ids this allocation occupies."""
+        return {(self.node_name, drv, dev)
+                for devs in self.results.values() for (drv, dev) in devs}
+
+
+@dataclass
+class ResourceClaim:
+    """resource/v1 ResourceClaim: device requests + allocation status."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    requests: list[DeviceRequest] = field(default_factory=list)
+    allocation: Optional[DeviceAllocation] = None   # status.allocation
+    reserved_for: list[str] = field(default_factory=list)  # pod uids
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget (reference: staging/src/k8s.io/api/policy/v1/types.go
+# PodDisruptionBudget; consumed by preemption's PDB-violating victim
+# partition, pkg/scheduler/framework/preemption/preemption.go:658)
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PDB, the subset preemption reads: a selector over pods in
+    the PDB's namespace plus one of min_available / max_unavailable
+    (int or "N%" string). `disruptions_allowed` mirrors
+    status.disruptionsAllowed and is computed by the API server's mini
+    disruption controller at list time (the reference scheduler likewise
+    trusts the controller-written status, preemption.go:700)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[int | str] = None
+    max_unavailable: Optional[int | str] = None
+    disruptions_allowed: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def matches(self, pod: Pod) -> bool:
+        if pod.metadata.namespace != self.metadata.namespace:
+            return False
+        if self.selector is None:
+            return False  # nil selector matches no pods (policy/v1 semantics)
+        return self.selector.matches(pod.metadata.labels)
+
+
+def _resolve_maybe_percent(value: int | str, total: int) -> int:
+    """IntOrString fields: "25%" rounds UP for maxUnavailable-style use in
+    the disruption controller; we follow GetScaledValueFromIntOrPercent
+    with round-up=False for minAvailable and the controller's defaults —
+    scoped here to round-down for both, documented divergence."""
+    if isinstance(value, str) and value.endswith("%"):
+        return int(value[:-1]) * total // 100
+    return int(value)

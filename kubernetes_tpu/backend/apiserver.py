@@ -16,7 +16,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.types import (Node, PersistentVolume, PersistentVolumeClaim,
-                         Pod, StorageClass, Workload)
+                         Pod, PodDisruptionBudget, ResourceClaim,
+                         ResourceSlice, StorageClass, Workload,
+                         _resolve_maybe_percent)
 
 
 class Conflict(Exception):
@@ -47,11 +49,17 @@ class APIServer:
     pvs: dict[str, PersistentVolume] = field(default_factory=dict)
     storage_classes: dict[str, StorageClass] = field(default_factory=dict)
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
+    pdbs: dict[str, PodDisruptionBudget] = field(default_factory=dict)
+    resource_slices: dict[str, ResourceSlice] = field(default_factory=dict)
+    resource_claims: dict[str, ResourceClaim] = field(default_factory=dict)
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
     workload_handlers: list[WatchHandlers] = field(default_factory=list)
     pvc_handlers: list[WatchHandlers] = field(default_factory=list)
     pv_handlers: list[WatchHandlers] = field(default_factory=list)
+    pdb_handlers: list[WatchHandlers] = field(default_factory=list)
+    claim_handlers: list[WatchHandlers] = field(default_factory=list)
+    slice_handlers: list[WatchHandlers] = field(default_factory=list)
     binding_count: int = 0
 
     # -- watch registration (LIST+WATCH: informer semantics) ------------------
@@ -278,3 +286,89 @@ class APIServer:
 
     def get_storage_class(self, name: str) -> Optional[StorageClass]:
         return self.storage_classes.get(name)
+
+    # -- DRA: ResourceSlices / ResourceClaims (resource/v1) -------------------
+
+    def watch_resource_claims(self, h: WatchHandlers) -> None:
+        self._register(self.claim_handlers, self.resource_claims, h)
+
+    def watch_resource_slices(self, h: WatchHandlers) -> None:
+        self._register(self.slice_handlers, self.resource_slices, h)
+
+    def create_resource_slice(self, s: ResourceSlice) -> ResourceSlice:
+        self.resource_slices[s.name] = s
+        for h in self.slice_handlers:
+            if h.on_add:
+                h.on_add(s)
+        return s
+
+    def list_resource_slices(self) -> list[ResourceSlice]:
+        return list(self.resource_slices.values())
+
+    def create_resource_claim(self, c: ResourceClaim) -> ResourceClaim:
+        self.resource_claims[c.uid] = c
+        for h in self.claim_handlers:
+            if h.on_add:
+                h.on_add(c)
+        return c
+
+    def get_resource_claim(self, namespace: str, name: str
+                           ) -> Optional[ResourceClaim]:
+        return self.resource_claims.get(f"{namespace}/{name}")
+
+    def list_resource_claims(self) -> list[ResourceClaim]:
+        return list(self.resource_claims.values())
+
+    def update_claim_status(self, claim: ResourceClaim) -> ResourceClaim:
+        """Write allocation + reservedFor (the PreBind status write,
+        dynamicresources.go PreBind → claim status update)."""
+        old = self.resource_claims.get(claim.uid)
+        if old is None:
+            raise NotFound(claim.uid)
+        self.resource_claims[claim.uid] = claim
+        for h in self.claim_handlers:
+            if h.on_update:
+                h.on_update(old, claim)
+        return claim
+
+    # -- PodDisruptionBudgets (policy/v1) -------------------------------------
+
+    def watch_pdbs(self, h: WatchHandlers) -> None:
+        self._register(self.pdb_handlers, self.pdbs, h)
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        self.pdbs[pdb.uid] = pdb
+        for h in self.pdb_handlers:
+            if h.on_add:
+                h.on_add(pdb)
+        return pdb
+
+    def delete_pdb(self, uid: str) -> None:
+        pdb = self.pdbs.pop(uid, None)
+        if pdb is None:
+            raise NotFound(uid)
+        for h in self.pdb_handlers:
+            if h.on_delete:
+                h.on_delete(pdb)
+
+    def list_pdbs(self) -> list[PodDisruptionBudget]:
+        """PDBs with a freshly computed status.disruptionsAllowed — the
+        in-memory stand-in for the disruption controller
+        (pkg/controller/disruption): expected = pods matching the
+        selector, healthy = the bound ones."""
+        out = []
+        for pdb in self.pdbs.values():
+            matched = [p for p in self.pods.values() if pdb.matches(p)]
+            expected = len(matched)
+            healthy = sum(1 for p in matched if p.spec.node_name)
+            if pdb.min_available is not None:
+                want = _resolve_maybe_percent(pdb.min_available, expected)
+                allowed = healthy - want
+            elif pdb.max_unavailable is not None:
+                cap = _resolve_maybe_percent(pdb.max_unavailable, expected)
+                allowed = cap - (expected - healthy)
+            else:
+                allowed = 0
+            pdb.disruptions_allowed = max(allowed, 0)
+            out.append(pdb)
+        return out

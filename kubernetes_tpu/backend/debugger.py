@@ -1,0 +1,79 @@
+"""Cache debugger: compare scheduler state against API-server truth + dump.
+
+Mirrors pkg/scheduler/backend/cache/debugger/ (debugger.go:31-76,
+comparer.go, dumper.go): on demand (SIGUSR2 in the reference; an explicit
+`compare()`/`dump()` call or the server's debug endpoint here), the host
+cache's nodes and pods are diffed against the API server's — the safety net
+for cache-vs-informer divergence. The TPU build already has a second
+comparer layer (Scheduler.reconcile: device carry vs host cache); this one
+closes the remaining gap (host cache vs apiserver).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.logging import klog
+
+
+class CacheDebugger:
+    def __init__(self, client, cache, queue, metrics=None):
+        self.client = client
+        self.cache = cache
+        self.queue = queue
+        self.metrics = metrics
+
+    # -- comparer (comparer.go CompareNodes/ComparePods) ----------------------
+
+    def compare(self) -> list[str]:
+        """Returns human-readable discrepancy strings ([] = clean)."""
+        out: list[str] = []
+        # nodes: every apiserver node must be cached, and vice versa
+        # (imputed placeholder entries are cache-internal, not divergence)
+        api_nodes = set(self.client.nodes)
+        cached = {name for name, item in self.cache.nodes.items()
+                  if name not in self.cache._imputed_nodes}
+        for name in sorted(api_nodes - cached):
+            out.append(f"node {name} in apiserver but not in cache")
+        for name in sorted(cached - api_nodes):
+            out.append(f"node {name} in cache but not in apiserver")
+        # pods: bound pods must agree on existence and placement; assumed
+        # (not yet confirmed) pods are expected to lead the apiserver
+        api_bound = {uid: p for uid, p in self.client.pods.items()
+                     if p.spec.node_name}
+        for uid, p in api_bound.items():
+            ps = self.cache.pod_states.get(uid)
+            if ps is None:
+                out.append(f"pod {uid} bound to {p.spec.node_name} in "
+                           "apiserver but not in cache")
+            elif ps.pod.spec.node_name != p.spec.node_name:
+                out.append(f"pod {uid} on {ps.pod.spec.node_name} in cache "
+                           f"but {p.spec.node_name} in apiserver")
+        for uid, ps in self.cache.pod_states.items():
+            if uid in self.cache.assumed_pods:
+                continue  # optimistic entries lead the apiserver by design
+            if uid not in api_bound:
+                out.append(f"pod {uid} in cache but not bound in apiserver")
+        if out:
+            if self.metrics is not None:
+                self.metrics.cache_divergence.inc("host_vs_apiserver",
+                                                  by=len(out))
+            for line in out:
+                klog.warning("cache divergence", detail=line)
+        else:
+            klog.v(4).info("cache comparer: clean",
+                           nodes=len(api_nodes), pods=len(api_bound))
+        return out
+
+    # -- dumper (dumper.go) ----------------------------------------------------
+
+    def dump(self) -> dict:
+        """Cache + queue snapshot for post-mortems (dumper.go dumps to the
+        log; returning the structure keeps it testable — the server's
+        debug endpoint serializes it)."""
+        pending, summary = self.queue.pending_pods()
+        return {
+            "cache": self.cache.dump(),
+            "queue": {"summary": summary,
+                      "pending": [p.uid for p in pending]},
+        }

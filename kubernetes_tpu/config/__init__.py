@@ -47,7 +47,12 @@ class KubeSchedulerProfile:
     # plugin name → weight (MultiPoint weight, default_plugins.go:93)
     plugin_weights: dict[str, int] = field(default_factory=dict)
     # NodeResourcesFit scoring strategy: LeastAllocated | MostAllocated
+    # (shorthand for pluginArgs.NodeResourcesFit.scoringStrategy)
     scoring_strategy: str = "LeastAllocated"
+    # typed per-plugin args (types_pluginargs.go analog): plugin name →
+    # camelCase arg dict, decoded by _decode_plugin_args into the plugin's
+    # own Args dataclass and handed to its factory
+    plugin_args: dict[str, dict] = field(default_factory=dict)
 
 
 @dataclass
@@ -64,6 +69,8 @@ class KubeSchedulerConfiguration:
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
+    # feature gate overrides (--feature-gates flag / featureGates field)
+    feature_gates: dict[str, bool] = field(default_factory=dict)
 
     # -- validation (apis/config/validation/validation.go) -------------------
 
@@ -92,6 +99,14 @@ class KubeSchedulerConfiguration:
             if p.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
                 raise ValueError(
                     f"unknown scoringStrategy {p.scoring_strategy!r}")
+            for name in p.plugin_args:
+                if name not in known:
+                    raise ValueError(
+                        f"pluginArgs for unknown plugin {name!r} in "
+                        f"profile {p.scheduler_name!r}")
+                _decode_plugin_args(name, p.plugin_args[name])  # validates
+        from .features import default_gate
+        default_gate(self.feature_gates)  # raises on unknown gate names
 
     # -- round trip ----------------------------------------------------------
 
@@ -109,6 +124,7 @@ class KubeSchedulerConfiguration:
             "podMaxBackoffSeconds": self.pod_max_backoff_seconds,
             "batchSize": self.batch_size,
             "extraPlugins": list(self.extra_plugins),
+            "featureGates": dict(self.feature_gates),
         }
 
     @staticmethod
@@ -121,7 +137,9 @@ class KubeSchedulerConfiguration:
                     enabled=list(pd.get("plugins", {}).get("enabled", [])),
                     disabled=list(pd.get("plugins", {}).get("disabled", []))),
                 plugin_weights=dict(pd.get("pluginWeights", {})),
-                scoring_strategy=pd.get("scoringStrategy", "LeastAllocated"))
+                scoring_strategy=pd.get("scoringStrategy", "LeastAllocated"),
+                plugin_args={k: dict(v) for k, v in
+                             pd.get("pluginArgs", {}).items()})
             for pd in d.get("profiles", [{}])
         ] or [KubeSchedulerProfile()]
         return KubeSchedulerConfiguration(
@@ -132,7 +150,8 @@ class KubeSchedulerConfiguration:
                                               1.0),
             pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
             batch_size=d.get("batchSize", 512),
-            extra_plugins=tuple(d.get("extraPlugins", ())))
+            extra_plugins=tuple(d.get("extraPlugins", ())),
+            feature_gates=dict(d.get("featureGates", {})))
 
 
 def load(path: str) -> KubeSchedulerConfiguration:
@@ -147,6 +166,73 @@ def load(path: str) -> KubeSchedulerConfiguration:
 def _default_plugin_names() -> list[str]:
     from ..scheduler import default_plugins
     return [p.name() for p in default_plugins()] + ["DefaultPreemption"]
+
+
+def _decode_plugin_args(name: str, d: dict):
+    """camelCase arg dict → the plugin's typed Args dataclass
+    (apis/config/types_pluginargs.go + scheme decoding analog). Raises on
+    unknown plugin-arg keys — silent typos in scheduler config are the
+    classic production foot-gun the reference's strict decoding catches."""
+    def pick(allowed: dict):
+        unknown = set(d) - set(allowed)
+        if unknown:
+            raise ValueError(f"unknown {name}Args fields {sorted(unknown)}")
+        return {py: d[yaml] for yaml, py in allowed.items() if yaml in d}
+
+    if name == "NodeResourcesFit":
+        from ..plugins.noderesources import FitArgs, ResourceSpec
+        kw = pick({"scoringStrategy": "scoring_strategy",
+                   "resources": "resources",
+                   "ignoredResources": "ignored_resources"})
+        if "scoring_strategy" in kw and kw["scoring_strategy"] not in (
+                "LeastAllocated", "MostAllocated"):
+            raise ValueError(
+                f"unknown scoringStrategy {kw['scoring_strategy']!r}")
+        if "resources" in kw:
+            kw["resources"] = tuple(
+                ResourceSpec(r["name"], r.get("weight", 1))
+                for r in kw["resources"])
+        if "ignored_resources" in kw:
+            kw["ignored_resources"] = frozenset(kw["ignored_resources"])
+        return FitArgs(**kw)
+    if name == "NodeResourcesBalancedAllocation":
+        from ..plugins.noderesources import (BalancedAllocationArgs,
+                                             ResourceSpec)
+        kw = pick({"resources": "resources"})
+        if "resources" in kw:
+            kw["resources"] = tuple(
+                ResourceSpec(r["name"], r.get("weight", 1))
+                for r in kw["resources"])
+        return BalancedAllocationArgs(**kw)
+    if name == "PodTopologySpread":
+        from ..api.types import TopologySpreadConstraint
+        from ..plugins.podtopologyspread import PodTopologySpreadArgs
+        kw = pick({"defaultingType": "defaulting_type",
+                   "defaultConstraints": "default_constraints"})
+        if kw.get("defaulting_type") not in (None, "List", "System"):
+            raise ValueError(
+                f"unknown defaultingType {kw['defaulting_type']!r}")
+        if "default_constraints" in kw:
+            kw["default_constraints"] = tuple(
+                TopologySpreadConstraint(
+                    max_skew=c.get("maxSkew", 1),
+                    topology_key=c["topologyKey"],
+                    when_unsatisfiable=c.get("whenUnsatisfiable",
+                                             "DoNotSchedule"))
+                for c in kw["default_constraints"])
+        return PodTopologySpreadArgs(**kw)
+    if name == "InterPodAffinity":
+        from ..plugins.interpodaffinity import InterPodAffinityArgs
+        kw = pick({"hardPodAffinityWeight": "hard_pod_affinity_weight",
+                   "ignorePreferredTermsOfExistingPods":
+                       "ignore_preferred_terms_of_existing_pods"})
+        return InterPodAffinityArgs(**kw)
+    if name == "GangScheduling":
+        kw = pick({"schedulingTimeoutSeconds": "scheduling_timeout_seconds"})
+        if kw.get("scheduling_timeout_seconds", 1) <= 0:
+            raise ValueError("schedulingTimeoutSeconds must be > 0")
+        return kw
+    raise ValueError(f"plugin {name!r} does not accept args")
 
 
 def default_registry(client=None):
@@ -179,9 +265,19 @@ def build_profiles(cfg: KubeSchedulerConfiguration, client=None,
     from ..scheduler import DEFAULT_WEIGHTS, Profile, default_plugins
 
     registry = registry or default_registry(client)
+    from .features import default_gate
+    gate = default_gate(cfg.feature_gates)
+    # feature-gated default plugins (v1/default_plugins.go:60-71 pattern:
+    # a gate adds/removes its plugin from the default set)
+    gated_off = {name for name, feature in (
+        ("GangScheduling", "GenericWorkload"),
+        ("NodeDeclaredFeatures", "NodeDeclaredFeatures"),
+        ("DynamicResources", "DynamicResourceAllocation"),
+    ) if not gate.enabled(feature)}
     out = []
     for p in cfg.profiles:
-        plugins = default_plugins(client)
+        plugins = [pl for pl in default_plugins(client)
+                   if pl.name() not in gated_off]
         if "*" in p.plugins.disabled:
             plugins = []
         else:
@@ -200,11 +296,44 @@ def build_profiles(cfg: KubeSchedulerConfiguration, client=None,
                     f"plugin {name!r} enabled by profile "
                     f"{p.scheduler_name!r} has no registered factory")
             plugins.append(factory())
+        # typed per-plugin args: rebuild the named plugin with its Args
+        strategy = p.scoring_strategy
+        for pname, argdict in p.plugin_args.items():
+            decoded = _decode_plugin_args(pname, argdict)
+            for idx, pl in enumerate(plugins):
+                if pl.name() != pname:
+                    continue
+                if pname == "NodeResourcesFit":
+                    from ..plugins.noderesources import Fit, FitArgs
+                    if "scoringStrategy" not in argdict:
+                        # args without a strategy key must not silently
+                        # reset the profile-level scoringStrategy
+                        decoded = FitArgs(
+                            scoring_strategy=strategy,
+                            resources=decoded.resources,
+                            ignored_resources=decoded.ignored_resources)
+                    plugins[idx] = Fit(decoded)
+                    strategy = decoded.scoring_strategy
+                elif pname == "NodeResourcesBalancedAllocation":
+                    from ..plugins.noderesources import BalancedAllocation
+                    plugins[idx] = BalancedAllocation(decoded)
+                elif pname == "PodTopologySpread":
+                    from ..plugins.podtopologyspread import PodTopologySpread
+                    plugins[idx] = PodTopologySpread(decoded)
+                elif pname == "InterPodAffinity":
+                    from ..plugins.interpodaffinity import InterPodAffinity
+                    old = plugins[idx]
+                    plugins[idx] = InterPodAffinity(
+                        decoded, ns_lister=getattr(old, "ns_lister", None))
+                elif pname == "GangScheduling":
+                    for k, v in decoded.items():
+                        setattr(pl, k, v)
+                break
         weights = dict(DEFAULT_WEIGHTS)
         weights.update(p.plugin_weights)
         fwk = Framework(p.scheduler_name, plugins, weights=weights)
         score_cfg = ScoreConfig(
-            strategy=p.scoring_strategy,
+            strategy=strategy,
             w_taint=weights.get("TaintToleration", 3),
             w_node_affinity=weights.get("NodeAffinity", 2),
             w_spread=weights.get("PodTopologySpread", 2),

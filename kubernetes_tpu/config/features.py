@@ -1,0 +1,97 @@
+"""Feature gates: component-base/featuregate + pkg/features/kube_features.go.
+
+A FeatureGate is a registry of known features with per-feature defaults and
+maturity stages; a config (or test) overrides specific gates by name, and
+unknown names are rejected exactly like featuregate.Set. The scheduler
+consults the gate at wiring time — the same pattern the reference uses to
+introduce OpportunisticBatching (kube_features.go:686), the async API
+dispatcher (SchedulerAsyncAPICalls, :891) and the Workload API
+(GenericWorkload, :338).
+
+GA features cannot be disabled (featuregate.go's locked-to-default
+behavior for GA+locked gates) — mirrored here for the gates whose off
+state no longer exists in this architecture.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+ALPHA = "Alpha"
+BETA = "Beta"
+GA = "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    """featuregate.FeatureSpec: default + prerelease stage + lock."""
+
+    default: bool
+    stage: str = BETA
+    lock_to_default: bool = False
+
+
+# the known gate set (kube_features.go analogs + TPU-backend gates)
+DEFAULT_FEATURES: dict[str, FeatureSpec] = {
+    # KEP-5598 signature batching → here: the closed-form uniform fast
+    # path over same-signature runs (kube_features.go:686)
+    "OpportunisticBatching": FeatureSpec(True, BETA),
+    # async API call pipeline (kube_features.go:891); off = every drain
+    # commits synchronously before the next dispatch
+    "SchedulerAsyncAPICalls": FeatureSpec(True, BETA),
+    # Workload / gang scheduling API (kube_features.go:338)
+    "GenericWorkload": FeatureSpec(True, ALPHA),
+    # queueing hints consulted on requeue (SchedulerQueueingHint)
+    "SchedulerQueueingHints": FeatureSpec(True, BETA),
+    # nodedeclaredfeatures plugin
+    "NodeDeclaredFeatures": FeatureSpec(True, ALPHA),
+    # dynamicresources plugin (structured parameters)
+    "DynamicResourceAllocation": FeatureSpec(True, BETA),
+}
+
+
+class FeatureGate:
+    """featuregate.MutableFeatureGate (reduced): known map + overrides."""
+
+    def __init__(self, known: dict[str, FeatureSpec] | None = None):
+        self._known = dict(known if known is not None else DEFAULT_FEATURES)
+        self._overrides: dict[str, bool] = {}
+
+    def add(self, name: str, spec: FeatureSpec) -> None:
+        """Register an out-of-tree feature (featuregate.Add)."""
+        self._known[name] = spec
+
+    def enabled(self, name: str) -> bool:
+        if name in self._overrides:
+            return self._overrides[name]
+        spec = self._known.get(name)
+        if spec is None:
+            raise KeyError(f"unknown feature gate {name!r}")
+        return spec.default
+
+    def set(self, name: str, value: bool) -> None:
+        spec = self._known.get(name)
+        if spec is None:
+            raise ValueError(
+                f"unknown feature gate {name!r} (known: "
+                f"{sorted(self._known)})")
+        if spec.lock_to_default and value != spec.default:
+            raise ValueError(
+                f"feature gate {name!r} is {spec.stage} and locked to "
+                f"{spec.default}")
+        self._overrides[name] = value
+
+    def set_from_map(self, overrides: dict[str, bool]) -> None:
+        for name, value in overrides.items():
+            self.set(name, bool(value))
+
+    def known(self) -> dict[str, FeatureSpec]:
+        return dict(self._known)
+
+
+def default_gate(overrides: dict[str, bool] | None = None) -> FeatureGate:
+    gate = FeatureGate()
+    if overrides:
+        gate.set_from_map(overrides)
+    return gate

@@ -79,6 +79,9 @@ class CycleState:
         self._data: dict[str, Any] = {}
         self.skip_filter_plugins: set[str] = set()
         self.skip_score_plugins: set[str] = set()
+        # plugin_execution_duration sampling flag: set on ~10% of cycles
+        # (reference pluginMetricsSamplePercent, schedule_one.go:51)
+        self.record_plugin_metrics: bool = False
 
     def write(self, key: str, value: Any) -> None:
         self._data[key] = value

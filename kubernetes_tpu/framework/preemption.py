@@ -11,10 +11,10 @@ Mirrors pkg/scheduler/framework/preemption/preemption.go:
   (plugins/defaultpreemption/default_preemption.go:583) — remove all
   lower-priority pods, check fit with nominated pods, then reprieve victims
   most-important-first.
-- `pick_one_node` (:658) — the 5-step ordering. We have no
-  PodDisruptionBudget objects yet, so every candidate has zero PDB
-  violations and step 1 never discriminates; victim start times map to
-  `creation_index` (latest-started = highest index).
+- `pick_one_node` (:658) — the 5-step ordering; step 1 discriminates by
+  `num_pdb_violations` fed by the PDB-violating victim partition
+  (`filterPodsWithPDBViolation`, preemption.go:~700). Victim start times
+  map to `creation_index` (latest-started = highest index).
 - `prepare_candidate` (:180) — victim deletes via the API dispatcher +
   clearing lower-priority nominations on the node; the caller publishes
   NominatedNodeName.
@@ -50,12 +50,16 @@ class Evaluator:
     def __init__(self, framework, nominator=None,
                  min_candidate_nodes_percentage: int = 10,
                  min_candidate_nodes_absolute: int = 100,
-                 is_delete_pending: Optional[Callable[[str], bool]] = None):
+                 is_delete_pending: Optional[Callable[[str], bool]] = None,
+                 pdb_lister: Optional[Callable[[], list]] = None):
         self.fwk = framework
         self.nominator = nominator
         self.min_pct = min_candidate_nodes_percentage
         self.min_abs = min_candidate_nodes_absolute
         self._is_delete_pending = is_delete_pending or (lambda uid: False)
+        # () → [PodDisruptionBudget] with fresh disruptionsAllowed; the
+        # reference uses a PDB informer lister (preemption.go:700)
+        self.pdb_lister = pdb_lister
 
     # -- entry (preemption.go:268 Preempt) ------------------------------------
 
@@ -132,10 +136,11 @@ class Evaluator:
         snapshot list — PreFilter state (spread counts etc.) must be seeded
         over every node exactly like a real scheduling cycle, not over the
         resolvable subset."""
+        pdbs = self.pdb_lister() if self.pdb_lister is not None else []
         candidates: list[Candidate] = []
         for ni in nodes:
             victims, pdb_violations, ok = self.select_victims_on_node(
-                pod, ni, all_nodes=all_nodes or nodes)
+                pod, ni, all_nodes=all_nodes or nodes, pdbs=pdbs)
             if ok:
                 candidates.append(Candidate(
                     node_name=ni.name, victims=victims,
@@ -145,7 +150,8 @@ class Evaluator:
         return candidates
 
     def select_victims_on_node(self, pod: Pod, node_info: NodeInfo,
-                               all_nodes: list[NodeInfo]
+                               all_nodes: list[NodeInfo],
+                               pdbs: Optional[list] = None
                                ) -> tuple[list[PodInfo], int, bool]:
         """default_preemption.go:583. Returns (victims, pdbViolations, fits).
 
@@ -172,19 +178,50 @@ class Evaluator:
         # preemptor must fit with ALL lower-priority pods gone
         if not self._fits(state, pod, ni):
             return [], 0, False
-        # reprieve pods most-important-first (util.MoreImportantPod:
-        # priority desc, then earlier start via creation_index) while the
-        # preemptor still fits (no PDBs yet: the violating-first partition
-        # is empty)
+        # partition by PDB impact, then reprieve pods most-important-first
+        # (util.MoreImportantPod: priority desc, then earlier start via
+        # creation_index) while the preemptor still fits. PDB-VIOLATING
+        # pods are reprieved FIRST (default_preemption.go:640): they get
+        # the best chance of being added back, so PDB-protected workloads
+        # are disrupted only when nothing else frees enough room.
+        violating, non_violating = self._filter_pods_with_pdb_violation(
+            potential, pdbs or [])
+        key = lambda pi: (-pi.pod.spec.priority,
+                          pi.pod.metadata.creation_index)
         victims: list[PodInfo] = []
-        potential.sort(key=lambda pi: (-pi.pod.spec.priority,
-                                       pi.pod.metadata.creation_index))
-        for pi in potential:
-            self._add_pod(state, pod, pi, ni)
-            if not self._fits(state, pod, ni):
-                self._remove_pod(state, pod, pi, ni)
-                victims.append(pi)
-        return victims, 0, True
+        num_violating = 0
+        for group, counts in ((sorted(violating, key=key), True),
+                              (sorted(non_violating, key=key), False)):
+            for pi in group:
+                self._add_pod(state, pod, pi, ni)
+                if not self._fits(state, pod, ni):
+                    self._remove_pod(state, pod, pi, ni)
+                    victims.append(pi)
+                    if counts:
+                        num_violating += 1
+        return victims, num_violating, True
+
+    @staticmethod
+    def _filter_pods_with_pdb_violation(pods: list[PodInfo], pdbs: list
+                                        ) -> tuple[list[PodInfo], list[PodInfo]]:
+        """preemption.go filterPodsWithPDBViolation: a pod is 'violating'
+        if evicting it would push some matching PDB past its
+        disruptionsAllowed budget, accounting for earlier pods in this
+        call consuming the same budgets."""
+        if not pdbs:
+            return [], list(pods)
+        remaining = {id(pdb): pdb.disruptions_allowed for pdb in pdbs}
+        violating: list[PodInfo] = []
+        non_violating: list[PodInfo] = []
+        for pi in pods:
+            matching = [pdb for pdb in pdbs if pdb.matches(pi.pod)]
+            if any(remaining[id(pdb)] <= 0 for pdb in matching):
+                violating.append(pi)
+            else:
+                for pdb in matching:
+                    remaining[id(pdb)] -= 1
+                non_violating.append(pi)
+        return violating, non_violating
 
     def _fits(self, state: CycleState, pod: Pod, ni: NodeInfo) -> bool:
         status = self.fwk.run_filter_plugins_with_nominated_pods(

@@ -55,6 +55,10 @@ class Framework:
         self.profile_name = profile_name
         self.plugins = plugins
         self.weights = weights or {}
+        # SchedulerMetrics handle (set by the Scheduler): feeds the sampled
+        # plugin_execution_duration histogram when a cycle's CycleState has
+        # record_plugin_metrics set (instrumented_plugins.go analog)
+        self.metrics = None
         self.pre_enqueue_plugins = [p for p in plugins if hasattr(p, "pre_enqueue")]
         self.queue_sort_plugins = [p for p in plugins if hasattr(p, "less")]
         self.pre_filter_plugins = [p for p in plugins if hasattr(p, "pre_filter")]
@@ -104,10 +108,32 @@ class Framework:
     # -- Filter --------------------------------------------------------------
 
     def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if state.record_plugin_metrics and self.metrics is not None:
+            return self._run_filter_plugins_instrumented(state, pod,
+                                                         node_info)
         for p in self.filter_plugins:
             if p.name() in state.skip_filter_plugins:
                 continue
             status = p.filter(state, pod, node_info)
+            if not status.is_success():
+                status.plugin = status.plugin or p.name()
+                return status
+        return Status.success()
+
+    def _run_filter_plugins_instrumented(self, state: CycleState, pod: Pod,
+                                         node_info: NodeInfo) -> Status:
+        """Sampled timing per plugin Filter call (metrics.go:322
+        PluginExecutionDuration via the async recorder; here the histogram
+        write is cheap enough to take inline on the sampled cycles)."""
+        import time as _t
+        hist = self.metrics.plugin_execution_duration
+        for p in self.filter_plugins:
+            if p.name() in state.skip_filter_plugins:
+                continue
+            t0 = _t.perf_counter()
+            status = p.filter(state, pod, node_info)
+            hist.observe(_t.perf_counter() - t0, p.name(), "Filter",
+                         status.code.name)
             if not status.is_success():
                 status.plugin = status.plugin or p.name()
                 return status
@@ -227,9 +253,13 @@ class Framework:
                           ) -> tuple[list[int], Status]:
         """Returns the weighted total per node (parallel to `nodes`)."""
         totals = [0] * len(nodes)
+        record = state.record_plugin_metrics and self.metrics is not None
         for p in self.score_plugins:
             if p.name() in state.skip_score_plugins:
                 continue
+            if record:
+                import time as _t
+                t0 = _t.perf_counter()
             scores = []
             for ni in nodes:
                 s, status = p.score(state, pod, ni)
@@ -237,6 +267,10 @@ class Framework:
                     status.plugin = status.plugin or p.name()
                     return totals, status
                 scores.append(s)
+            if record:
+                self.metrics.plugin_execution_duration.observe(
+                    _t.perf_counter() - t0, p.name(), "Score",
+                    status.code.name)
             status = p.normalize_scores(state, pod, scores,
                                         node_names=[ni.name for ni in nodes])
             if not status.is_success():

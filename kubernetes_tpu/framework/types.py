@@ -57,6 +57,9 @@ class EventResource(str, enum.Enum):
     PV = "PersistentVolume"
     CSI_NODE = "CSINode"
     WORKLOAD = "Workload"
+    PDB = "PodDisruptionBudget"
+    RESOURCE_CLAIM = "ResourceClaim"
+    RESOURCE_SLICE = "ResourceSlice"
     WILDCARD = "*"
 
 

@@ -238,6 +238,23 @@ class SchedulerMetrics:
             n + "api_dispatcher_calls_total",
             "API calls flushed by the dispatcher, by type and result.",
             ("call_type", "result")))
+        self.plugin_execution_duration = r.register(Histogram(
+            n + "plugin_execution_duration_seconds",
+            "Duration of running a plugin at a specific extension point, "
+            "sampled on ~10% of host scheduling cycles "
+            "(metrics.go:322 PluginExecutionDuration).",
+            buckets=exponential_buckets(0.00001, 1.5, 20),
+            label_names=("plugin", "extension_point", "status")))
+        self.plugin_evaluation_total = r.register(Counter(
+            n + "plugin_evaluation_total",
+            "Number of plugin evaluations at filter/score extension "
+            "points, by plugin and profile "
+            "(metrics.go PluginEvaluationTotal).",
+            ("plugin", "extension_point", "profile")))
+        self.cache_divergence = r.register(Counter(
+            n + "cache_divergence_total",
+            "Discrepancies found by the cache comparer, by kind."
+            , ("kind",)))
 
     def exposition(self) -> str:
         return self.registry.exposition()

@@ -1,0 +1,255 @@
+"""DynamicResources (DRA): structured-parameters device allocation.
+
+Mirrors pkg/scheduler/framework/plugins/dynamicresources/ (registered at
+registry.go:48; 2,687 LoC in the reference), scoped to the structured
+model this framework's API carries (api/types.py ResourceSlice /
+ResourceClaim / DeviceRequest):
+
+- PreFilter (dynamicresources.go PreFilter): resolve the pod's claims; a
+  claim that is already allocated pins the pod to its allocation's node
+  (PreFilterResult node shortcut). Pods without claims → Skip.
+- Filter (:Filter): a node passes if every unallocated claim can be
+  satisfied from the node's ResourceSlices — devices matching the
+  request's attribute selectors, minus devices occupied by other claims'
+  allocations and by this scheduler's in-flight assumed allocations (the
+  SharedDRAManager assume-cache role, scheduler.go:327-350).
+- Reserve/Unreserve (:Reserve): allocate devices into the assume cache /
+  roll back.
+- PreBind (:PreBind): write the allocation + reservedFor to the API
+  server, making it visible to other schedulers and restarts.
+- EventsToRegister: ResourceClaim and ResourceSlice changes can make a
+  rejected pod schedulable.
+
+Claims are API-coupled (allocation state machine), so claim-bearing pods
+take the host path — the builder marks them host_fallback exactly like
+volume-bearing pods (state/batch.py), matching SURVEY §2.4's "keep Go
+path" note while the tensor form stays an optimization opportunity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.types import (Device, DeviceAllocation, Pod, ResourceClaim)
+from ..framework.interface import CycleState, PreFilterResult, Status
+from ..framework.types import ActionType, ClusterEvent, EventResource, NodeInfo
+
+NAME = "DynamicResources"
+_STATE_KEY = "PreFilterDynamicResources"
+
+
+class _StateData:
+    def __init__(self, claims: list[ResourceClaim]):
+        self.claims = claims
+        # (claim uid, node) → DeviceAllocation candidate from Filter
+        self.informational: dict[tuple, DeviceAllocation] = {}
+        # occupancy + device index, computed ONCE in PreFilter (occupancy
+        # cannot change within a pod's filter pass; recomputing per node
+        # would be O(nodes × claims + nodes × slices))
+        self.occupied: set[tuple[str, str, str]] = set()
+        self.node_devices: dict[str, list] = {}
+
+    def clone(self) -> "_StateData":
+        c = _StateData(list(self.claims))
+        c.informational = dict(self.informational)
+        c.occupied = set(self.occupied)
+        c.node_devices = self.node_devices
+        return c
+
+
+class DynamicResources:
+    """PF, F, R, PB, EE — reference dynamicresources.go."""
+
+    def __init__(self, client=None):
+        self.client = client
+        # assume cache: claim uid → DeviceAllocation (assumed, pre-PreBind);
+        # survives across cycles so concurrent pods see each other's holds
+        self.assumed: dict[str, DeviceAllocation] = {}
+
+    def name(self) -> str:
+        return NAME
+
+    # -- EnqueueExtensions ----------------------------------------------------
+
+    def events_to_register(self):
+        from ..backend.queue import ClusterEventWithHint
+        return [
+            ClusterEventWithHint(ClusterEvent(
+                EventResource.RESOURCE_CLAIM,
+                ActionType.ADD | ActionType.UPDATE)),
+            ClusterEventWithHint(ClusterEvent(
+                EventResource.RESOURCE_SLICE,
+                ActionType.ADD | ActionType.UPDATE)),
+        ]
+
+    # -- helpers --------------------------------------------------------------
+
+    def _pod_claims(self, pod: Pod) -> tuple[list[ResourceClaim], Optional[str]]:
+        claims = []
+        for name in pod.spec.resource_claims:
+            c = (self.client.get_resource_claim(pod.namespace, name)
+                 if self.client is not None else None)
+            if c is None:
+                return [], f"resourceclaim {pod.namespace}/{name} not found"
+            claims.append(c)
+        return claims, None
+
+    def _occupied_devices(self) -> set[tuple[str, str, str]]:
+        """(node, driver, device) ids held by allocated claims (API truth)
+        plus in-flight assumed allocations."""
+        occupied: set[tuple[str, str, str]] = set()
+        if self.client is not None:
+            for c in self.client.list_resource_claims():
+                if c.allocation is not None:
+                    occupied |= c.allocation.device_ids()
+        for alloc in self.assumed.values():
+            occupied |= alloc.device_ids()
+        return occupied
+
+    def _device_index(self) -> dict[str, list]:
+        """node → [(driver, Device)] from the published slices."""
+        index: dict[str, list] = {}
+        if self.client is not None:
+            for s in self.client.list_resource_slices():
+                index.setdefault(s.node_name, []).extend(
+                    (s.driver, d) for d in s.devices)
+        return index
+
+    @staticmethod
+    def _allocate_on_node(claim: ResourceClaim, node_name: str,
+                          node_devices: list, occupied: set
+                          ) -> Optional[DeviceAllocation]:
+        """Try to satisfy every request of `claim` from `node_devices`,
+        first-fit in slice/device order (the structured-parameters
+        allocator's deterministic ordering). `occupied` is not mutated."""
+        results: dict[str, tuple] = {}
+        taken: set[tuple[str, str, str]] = set()
+        for req in claim.requests:
+            picked = []
+            for driver, dev in node_devices:
+                if len(picked) >= req.count:
+                    break
+                if req.driver and driver != req.driver:
+                    continue
+                did = (node_name, driver, dev.name)
+                if did in occupied or did in taken:
+                    continue
+                if not req.matches(dev):
+                    continue
+                picked.append((driver, dev.name))
+                taken.add(did)
+            if len(picked) < req.count:
+                return None
+            results[req.name] = tuple(picked)
+        return DeviceAllocation(node_name=node_name, results=results)
+
+    # -- PreFilter ------------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes
+                   ) -> tuple[Optional[PreFilterResult], Status]:
+        if not pod.spec.resource_claims:
+            return None, Status.skip()
+        claims, err = self._pod_claims(pod)
+        if err:
+            return None, Status.unschedulable(err, plugin=NAME)
+        data = _StateData(claims)
+        data.occupied = self._occupied_devices()
+        data.node_devices = self._device_index()
+        state.write(_STATE_KEY, data)
+        # an allocated claim pins the pod to its node (PreFilter shortcut)
+        pinned = {c.allocation.node_name for c in claims
+                  if c.allocation is not None}
+        if len(pinned) > 1:
+            return None, Status.unschedulable(
+                "claims are allocated on different nodes", plugin=NAME)
+        if pinned:
+            return PreFilterResult(node_names=pinned), Status.success()
+        return None, Status.success()
+
+    # -- Filter ---------------------------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        data = state.read_or_none(_STATE_KEY)
+        if data is None:
+            return Status.success()
+        # node-local occupancy: a pod's OWN claims must not double-book a
+        # device — each claim's candidate pick occupies for the next
+        occupied = set(data.occupied)
+        node_devices = data.node_devices.get(node_info.name, ())
+        for claim in data.claims:
+            if claim.allocation is not None:
+                if claim.allocation.node_name != node_info.name:
+                    return Status.unschedulable(
+                        f"claim {claim.name} allocated on "
+                        f"{claim.allocation.node_name}", plugin=NAME)
+                continue
+            alloc = self._allocate_on_node(claim, node_info.name,
+                                           node_devices, occupied)
+            if alloc is None:
+                return Status.unschedulable(
+                    f"cannot allocate claim {claim.name} on "
+                    f"{node_info.name}", plugin=NAME)
+            occupied |= alloc.device_ids()
+            # remember the candidate allocation for Reserve; keyed per node
+            data.informational[(claim.uid, node_info.name)] = alloc
+        return Status.success()
+
+    # -- Reserve / Unreserve --------------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read_or_none(_STATE_KEY)
+        if data is None:
+            return Status.success()
+        # AUTHORITATIVE occupancy re-check: other pods may have assumed
+        # devices since PreFilter snapshotted it, so a Filter-time
+        # candidate is only trusted if its devices are still free
+        occupied = self._occupied_devices()
+        reserved_here: list[str] = []
+        for claim in data.claims:
+            if claim.allocation is not None:
+                continue
+            alloc = data.informational.get((claim.uid, node_name))
+            if alloc is not None and (alloc.device_ids() & occupied):
+                alloc = None  # stale candidate: devices got taken
+            if alloc is None:
+                alloc = self._allocate_on_node(
+                    claim, node_name,
+                    data.node_devices.get(node_name, ()), occupied)
+            if alloc is None:
+                for uid in reserved_here:   # roll back partial reserve
+                    self.assumed.pop(uid, None)
+                return Status.unschedulable(
+                    f"claim {claim.name} no longer allocatable on "
+                    f"{node_name}", plugin=NAME)
+            self.assumed[claim.uid] = alloc
+            reserved_here.append(claim.uid)
+            occupied |= alloc.device_ids()
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        data = state.read_or_none(_STATE_KEY)
+        if data is None:
+            return
+        for claim in data.claims:
+            self.assumed.pop(claim.uid, None)
+
+    # -- PreBind --------------------------------------------------------------
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read_or_none(_STATE_KEY)
+        if data is None:
+            return Status.success()
+        for claim in data.claims:
+            alloc = self.assumed.pop(claim.uid, None)
+            if alloc is None and claim.allocation is None:
+                return Status.error(
+                    f"claim {claim.name} lost its assumed allocation",
+                    plugin=NAME)
+            if alloc is not None:
+                claim.allocation = alloc
+            if pod.uid not in claim.reserved_for:
+                claim.reserved_for.append(pod.uid)
+            if self.client is not None:
+                self.client.update_claim_status(claim)
+        return Status.success()

@@ -30,8 +30,13 @@ WAIT = Status(Code.WAIT, ("waiting for minCount pods from a gang to be "
 
 
 class GangScheduling:
-    def __init__(self, handle=None):
+    def __init__(self, handle=None, scheduling_timeout_seconds=None):
         self.handle = handle
+        # per-profile wait budget (GangSchedulingArgs via config
+        # pluginArgs; defaults to the WorkloadManager's 300s)
+        from ..backend.workloadmanager import DEFAULT_SCHEDULING_TIMEOUT
+        self.scheduling_timeout_seconds = (
+            scheduling_timeout_seconds or DEFAULT_SCHEDULING_TIMEOUT)
 
     def name(self) -> str:
         return "GangScheduling"
@@ -101,7 +106,8 @@ class GangScheduling:
             return Status.error("no pod group state", plugin=self.name()), 0.0
         quorum = info.assumed | info.assigned
         if len(quorum) < min_count:
-            timeout = info.scheduling_timeout(self.handle.now())
+            timeout = info.scheduling_timeout(
+                self.handle.now(), self.scheduling_timeout_seconds)
             if timeout <= 0:
                 # the group deadline already expired: reject outright —
                 # waking members of a dead gang would ping-pong them

@@ -53,6 +53,7 @@ from .plugins.podtopologyspread import PodTopologySpread
 from .state.batch import BatchBuilder, BatchDims
 from .state.tensorize import (EFFECT_PREFER_NO_SCHEDULE, ClusterState,
                               pow2_at_least)
+from .utils.logging import klog
 
 EVENT_NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
 EVENT_NODE_UPDATE = ClusterEvent(EventResource.NODE, ActionType.UPDATE)
@@ -114,6 +115,7 @@ def default_plugins(client=None, ns_lister=None) -> list:
     from .plugins.volume_basics import (NodeVolumeLimits, VolumeRestrictions,
                                         VolumeZone)
     from .plugins.volumebinding import VolumeBinding
+    from .plugins.dynamicresources import DynamicResources
     # filter order mirrors apis/config/v1/default_plugins.go:30
     from .plugins.node_basics import NodeDeclaredFeatures
     plugins = [
@@ -122,6 +124,7 @@ def default_plugins(client=None, ns_lister=None) -> list:
         NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
         NodePorts(), nr.Fit(), VolumeRestrictions(client),
         NodeVolumeLimits(client), VolumeBinding(client), VolumeZone(client),
+        DynamicResources(client),
         nr.BalancedAllocation(), PodTopologySpread(),
         InterPodAffinity(ns_lister=ns_lister), ImageLocality(),
     ]
@@ -160,9 +163,10 @@ def _needs_per_pod_hooks(profile: "Profile", spec) -> bool:
     return bool(
         ((fwk.reserve_plugins or fwk.permit_plugins)
          and (not profile.gang_only_hooks
-              or spec.workload_ref or spec.volumes))
+              or spec.workload_ref or spec.volumes or spec.resource_claims))
         or (fwk.pre_bind_plugins
-            and (not profile.volume_only_pre_bind or spec.volumes)))
+            and (not profile.volume_only_pre_bind
+                 or spec.volumes or spec.resource_claims)))
 
 
 @dataclass
@@ -261,6 +265,9 @@ class Scheduler:
         self.client = client
         self.clock = clock
         queue_backoffs = {}
+        from .config.features import default_gate
+        self.feature_gates = default_gate(
+            config.feature_gates if config is not None else None)
         if config is not None:
             config.validate()
             from .config import build_profiles
@@ -318,15 +325,26 @@ class Scheduler:
             client=client, on_bind_error=self._on_bind_error)
 
         default_fwk = next(iter(self.profiles.values())).framework
+        # SchedulerQueueingHints off → empty hint map → every event
+        # requeues conservatively (the gate-off behavior in the reference,
+        # scheduling_queue.go isPodWorthRequeuing without hints)
+        hints = (self._build_queueing_hints(default_fwk)
+                 if self.feature_gates.enabled("SchedulerQueueingHints")
+                 else {})
         self.queue = SchedulingQueue(
             pre_enqueue=self._make_pre_enqueue(default_fwk),
-            queueing_hints=self._build_queueing_hints(default_fwk),
+            queueing_hints=hints,
             clock=clock, **queue_backoffs)
 
         from .metrics import SchedulerMetrics
         self.metrics = metrics or SchedulerMetrics(
             queue_depths=self._queue_depths)
         self.dispatcher.metrics = self.metrics
+        for prof in self.profiles.values():
+            prof.framework.metrics = self.metrics
+        from .backend.debugger import CacheDebugger
+        self.debugger = CacheDebugger(client, self.cache, self.queue,
+                                      metrics=self.metrics)
         from .utils.tracing import NOOP_TRACER
         self.tracer = tracer or NOOP_TRACER
 
@@ -339,15 +357,18 @@ class Scheduler:
             for p in prof.framework.plugins:
                 if isinstance(p, GangScheduling):
                     p.handle = self
+            from .plugins.dynamicresources import DynamicResources
             from .plugins.volumebinding import VolumeBinding
-            # "gang_only": every reserve/permit plugin is scoped to gang or
-            # volume pods, so a pod with neither skips the hook chain
+            # "gang_only": every reserve/permit plugin is scoped to gang,
+            # volume or claim pods, so a pod with none of those skips the
+            # hook chain (paired with _needs_per_pod_hooks)
             prof.gang_only_hooks = all(
-                isinstance(p, (GangScheduling, VolumeBinding))
+                isinstance(p, (GangScheduling, VolumeBinding,
+                               DynamicResources))
                 for p in (prof.framework.reserve_plugins
                           + prof.framework.permit_plugins))
             prof.volume_only_pre_bind = all(
-                isinstance(p, VolumeBinding)
+                isinstance(p, (VolumeBinding, DynamicResources))
                 for p in prof.framework.pre_bind_plugins)
 
         # wire preemption (PostFilter) into every profile: the Evaluator
@@ -368,6 +389,8 @@ class Scheduler:
             dp.dispatcher = self.dispatcher
             dp.nominator = self.queue.nominator
             dp.snapshot = self.snapshot
+            if hasattr(client, "list_pdbs"):
+                dp.pdb_lister = client.list_pdbs
             dp.set_framework(fwk)
 
         self._register_event_handlers()
@@ -395,7 +418,10 @@ class Scheduler:
         # the ~100ms tunneled readback latency pipelines instead of gating
         # every drain (SURVEY §7 hard-part 4).
         self._pending: deque[_PendingDrain] = deque()
-        self.max_inflight_drains = 8
+        # SchedulerAsyncAPICalls off = no optimism: every dispatch commits
+        # before the next (the reference's synchronous API-call mode)
+        self.max_inflight_drains = (
+            8 if self.feature_gates.enabled("SchedulerAsyncAPICalls") else 0)
         # device-resident PodTable cache: rows only append and the version
         # bumps on every mutation, so one upload serves every drain until
         # a new signature appears (the per-drain re-upload was ~25 tunnel
@@ -536,6 +562,23 @@ class Scheduler:
                 on_add=self._on_pvc_change, on_update=self._on_pvc_change))
         if hasattr(self.client, "watch_pvs"):
             self.client.watch_pvs(WatchHandlers(on_add=self._on_pv_add))
+        if hasattr(self.client, "watch_pdbs"):
+            self.client.watch_pdbs(WatchHandlers(
+                on_add=self._on_pdb_change, on_update=self._on_pdb_change,
+                on_delete=self._on_pdb_change))
+        if hasattr(self.client, "watch_resource_claims"):
+            self.client.watch_resource_claims(WatchHandlers(
+                on_add=lambda c: self.queue.move_all_to_active_or_backoff_queue(
+                    ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.ADD),
+                    None, c),
+                on_update=lambda o, n: self.queue.move_all_to_active_or_backoff_queue(
+                    ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.UPDATE),
+                    o, n)))
+        if hasattr(self.client, "watch_resource_slices"):
+            self.client.watch_resource_slices(WatchHandlers(
+                on_add=lambda s: self.queue.move_all_to_active_or_backoff_queue(
+                    ClusterEvent(EventResource.RESOURCE_SLICE, ActionType.ADD),
+                    None, s)))
 
     def _responsible(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.profiles
@@ -621,6 +664,19 @@ class Scheduler:
         (volume_binding.go EventsToRegister: PV Add)."""
         self.queue.move_all_to_active_or_backoff_queue(
             ClusterEvent(EventResource.PV, ActionType.ADD), None, pv)
+
+    def _on_pdb_change(self, *args) -> None:
+        """A PDB change can alter preemption viability for pods rejected by
+        DefaultPreemption (its budget freed up → a candidate now exists).
+        Unschedulable pods carry the FILTER plugins as rejectors, whose
+        hints don't cover PDB events — so this uses the wildcard event
+        (conservative requeue), not EventResource.PDB which every hint map
+        would veto. PDB changes are rare; the broad sweep is cheap."""
+        old, new = (args[0], args[1]) if len(args) == 2 else (None, args[0])
+        self.queue.move_all_to_active_or_backoff_queue(
+            ClusterEvent(EventResource.WILDCARD, ActionType.ALL,
+                         "PodDisruptionBudgetChange"),
+            old, new)
 
     def _on_workload_add(self, workload) -> None:
         """A Workload's arrival can un-gate its gang's pods (PreEnqueue)
@@ -967,6 +1023,7 @@ class Scheduler:
         and replay. Returns (chain carry, [_RunRec])."""
         cfg = profile.score_config
         fast_ok = (self.mesh is None
+                   and self.feature_gates.enabled("OpportunisticBatching")
                    and not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
         if not fast_ok:
@@ -1113,12 +1170,28 @@ class Scheduler:
             else:
                 fast.append((qpi, names[int(a)]))
         bound += self._fast_commit(fast, profile)
+        # every device batch evaluates every kernel-modeled filter/score
+        # plugin for every pod (PluginEvaluationTotal,
+        # instrumented_plugins.go:83 — batch-granular here)
+        for p in fwk.filter_plugins:
+            self.metrics.plugin_evaluation_total.inc(
+                p.name(), "Filter", profile.name, by=n)
+        for p in fwk.score_plugins:
+            self.metrics.plugin_evaluation_total.inc(
+                p.name(), "Score", profile.name, by=n)
         if failures:
             # diagnosis reads the live snapshot (assumes included)
             self.cache.update_snapshot(self.snapshot)
             for qpi in failures:
                 err = self._device_fit_error(qpi, profile, diag_cache)
                 self._handle_failure(qpi, err)
+        klog.v(2).info("batch committed", profile=profile.name, pods=n,
+                       bound=bound, unschedulable=len(failures),
+                       latency_ms=round(per_pod * n * 1e3, 1))
+        if klog.v(5).enabled and failures:
+            for qpi in failures:
+                klog.v(5).info("unschedulable", pod=qpi.pod.uid,
+                               plugins=sorted(qpi.unschedulable_plugins))
         return bound
 
     def _fast_commit(self, pairs: list, profile: Profile) -> int:
@@ -1233,7 +1306,20 @@ class Scheduler:
                     for ni in self.snapshot.node_info_list}
             self.state.adopt_carry(c.used, c.nonzero_used, c.npods, c.ports,
                                    touched=gens)
-        return self.state.reconcile(self.snapshot)
+        divergent = self.state.reconcile(self.snapshot)
+        if divergent:
+            self.metrics.cache_divergence.inc("device_vs_host",
+                                              by=len(divergent))
+            klog.warning("device carry diverges from host cache",
+                         nodes=divergent)
+        return divergent
+
+    def debug_compare(self) -> dict:
+        """Full divergence sweep (cache debugger analog, SIGUSR2 in the
+        reference): device-carry vs host cache AND host cache vs
+        apiserver truth."""
+        return {"device_vs_host": self.reconcile(),
+                "host_vs_apiserver": self.debugger.compare()}
 
     def _device_fit_error(self, qpi: QueuedPodInfo, profile: Profile,
                           diag_cache: dict) -> FitError:
@@ -1295,6 +1381,9 @@ class Scheduler:
             return False
         self.cache.update_snapshot(self.snapshot)
         state = CycleState()
+        # plugin_execution_duration sampling: ~10% of host cycles
+        # (pluginMetricsSamplePercent, schedule_one.go:51,104-107)
+        state.record_plugin_metrics = (self.schedule_attempts % 10 == 0)
         try:
             result = schedule_pod(profile.framework, state, pod,
                                   self.snapshot.node_info_list,
@@ -1304,6 +1393,11 @@ class Scheduler:
             self._handle_failure(qpi, err, state)
             return False
         except Exception:
+            # a plugin blew up (schedule_one.go:161 err path): record it —
+            # silent requeue makes plugin bugs undebuggable
+            klog.exception("scheduling attempt failed with plugin error",
+                           pod=pod.uid,
+                           errors=qpi.consecutive_errors_count + 1)
             qpi.consecutive_errors_count += 1
             self.error_count += 1
             self.queue.add_unschedulable_if_not_present(qpi)
@@ -1345,6 +1439,7 @@ class Scheduler:
         # Mirrored by _needs_per_pod_hooks — keep the gates in lockstep.
         run_hooks = (fwk.reserve_plugins or fwk.permit_plugins) and (
             pod.spec.workload_ref or pod.spec.volumes
+            or pod.spec.resource_claims
             or not profile.gang_only_hooks)
         if run_hooks:
             status = fwk.run_reserve_plugins_reserve(cs, assumed, node_name)
@@ -1423,8 +1518,10 @@ class Scheduler:
         requeue — returns False so the caller aborts the bind."""
         fwk = profile.framework
         pod = qpi.pod
-        if not fwk.pre_bind_plugins or (profile.volume_only_pre_bind
-                                        and not pod.spec.volumes):
+        if not fwk.pre_bind_plugins or (
+                profile.volume_only_pre_bind
+                and not pod.spec.volumes
+                and not pod.spec.resource_claims):
             return True
         status = fwk.run_pre_bind_plugins(cs, assumed, node_name)
         if status.is_success():
@@ -1447,6 +1544,8 @@ class Scheduler:
         error is persistent (drain → bind fail → re-add → drain ...)."""
         self.scheduled_count -= 1
         self.error_count += 1
+        klog.error("bind failed; forgetting assumed pod and requeueing",
+                   pod=pod.uid, node=node_name, err=str(err))
         try:
             self.cache.forget_pod(pod)
         except (KeyError, ValueError):
@@ -1489,6 +1588,8 @@ class Scheduler:
                 self.queue.nominator.add(qpi, nominated)
                 self.preemption_attempts += 1
                 self.metrics.preemption_attempts.inc()
+                klog.v(2).info("preemption nominated node", pod=pod.uid,
+                               node=nominated)
         from .metrics import UNSCHEDULABLE
         self.metrics.schedule_attempts.inc(
             UNSCHEDULABLE, pod.spec.scheduler_name)

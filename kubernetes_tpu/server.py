@@ -146,6 +146,14 @@ class SchedulerServer:
                 elif self.path == "/statusz":
                     self._send(200, json.dumps(outer.status(), indent=2),
                                "application/json")
+                elif self.path == "/debug/cache":
+                    # cache debugger dump + comparer (the reference binds
+                    # these to SIGUSR2, debugger.go:31-76; an endpoint is
+                    # the serving-surface equivalent)
+                    self._send(200, json.dumps({
+                        "divergence": outer.scheduler.debug_compare(),
+                        "dump": outer.scheduler.debugger.dump(),
+                    }, indent=2, default=str), "application/json")
                 else:
                     self._send(404, "not found")
 
@@ -179,6 +187,20 @@ class SchedulerServer:
 
     def start(self) -> "SchedulerServer":
         self._thread.start()
+        # SIGUSR2 → cache compare + dump to the log (debugger.go
+        # ListenForSignal). Only possible from the main thread; embedded
+        # uses fall back to the /debug/cache endpoint.
+        try:
+            import signal
+
+            def on_usr2(signum, frame):
+                from .utils.logging import klog
+                klog.info("SIGUSR2: cache debugger",
+                          divergence=self.scheduler.debug_compare())
+
+            signal.signal(signal.SIGUSR2, on_usr2)
+        except (ValueError, AttributeError, OSError):
+            pass
         return self
 
     def stop(self) -> None:

@@ -285,6 +285,7 @@ class BatchBuilder:
             tuple((v.name, v.claim_name, v.csi_driver)
                   for v in spec.volumes),
             spec.required_node_features,
+            spec.resource_claims,
         )
 
     # -- row compilation ------------------------------------------------------
@@ -299,6 +300,10 @@ class BatchBuilder:
             raise BatchCapacityError("pod has volumes")
         if pod.spec.required_node_features:
             raise BatchCapacityError("pod requires declared node features")
+        if pod.spec.resource_claims:
+            # DRA claims are an API-coupled allocation state machine
+            # (plugins/dynamicresources.py): host path, like volumes
+            raise BatchCapacityError("pod has resource claims")
         # resources
         reqs = res.pod_requests(pod)
         row = self.state.rtable.vector(reqs)

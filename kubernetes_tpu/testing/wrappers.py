@@ -125,6 +125,11 @@ class PodWrapper:
         self.pod.spec.required_node_features = tuple(features)
         return self
 
+    def claim(self, *names: str) -> "PodWrapper":
+        """DRA: reference ResourceClaims by name (same namespace)."""
+        self.pod.spec.resource_claims = self.pod.spec.resource_claims + names
+        return self
+
     def workload(self, ref: str) -> "PodWrapper":
         self.pod.spec.workload_ref = ref
         return self

@@ -1,0 +1,84 @@
+"""klog-style leveled, structured logging.
+
+Mirrors the reference's klog/v2 conventions (contextual key/value logging;
+verbosity levels V(2) production, V(4/5) debug, V(10) per-score dumps —
+pkg/scheduler/schedule_one.go:830-838) on top of the stdlib logging module:
+
+    from kubernetes_tpu.utils.logging import klog
+    klog.v(2).info("Scheduled pod", pod=uid, node=name)
+    klog.error("bind failed", err=e, pod=uid)
+
+`set_verbosity(n)` enables V(m) for m <= n (default 2, like a production
+kube-scheduler). V-levels map onto stdlib levels beneath INFO so standard
+handlers/formatters keep working; key/values render as k=v suffixes the way
+klog's structured output does.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_logger = logging.getLogger("kubernetes_tpu")
+if not _logger.handlers:  # library default: stderr handler, not propagated
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(
+        "%(levelname).1s%(asctime)s.%(msecs)03d %(name)s] %(message)s",
+        datefmt="%H:%M:%S"))
+    _logger.addHandler(_h)
+    _logger.propagate = False
+
+_verbosity = int(os.environ.get("KTPU_VERBOSITY", "2"))
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def verbosity() -> int:
+    return _verbosity
+
+
+def _fmt(msg: str, kv: dict) -> str:
+    if not kv:
+        return msg
+    parts = " ".join(f"{k}={v!r}" if isinstance(v, str) else f"{k}={v}"
+                     for k, v in kv.items())
+    return f"{msg} {parts}"
+
+
+class _Verbose:
+    """klog.Verbose: a level-gated handle; `enabled` lets callers skip
+    expensive argument construction (if klog.v(5).enabled: ...)."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def info(self, msg: str, **kv) -> None:
+        if self.enabled:
+            _logger.info(_fmt(msg, kv))
+
+
+class _Klog:
+    def v(self, level: int) -> _Verbose:
+        return _Verbose(level <= _verbosity)
+
+    def info(self, msg: str, **kv) -> None:
+        _logger.info(_fmt(msg, kv))
+
+    def warning(self, msg: str, **kv) -> None:
+        _logger.warning(_fmt(msg, kv))
+
+    def error(self, msg: str, **kv) -> None:
+        _logger.error(_fmt(msg, kv))
+
+    def exception(self, msg: str, **kv) -> None:
+        """error + traceback of the active exception (klog.ErrorS with an
+        err and stack)."""
+        _logger.exception(_fmt(msg, kv))
+
+
+klog = _Klog()

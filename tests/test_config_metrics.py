@@ -244,3 +244,170 @@ class TestRegistry:
         again = KubeSchedulerConfiguration.from_dict(cfg.to_dict())
         assert again.extra_plugins == ("MyPlugin",)
         again.validate()
+
+
+class TestFeatureGates:
+    """config/features.py: featuregate registry + scheduler consultation
+    (kube_features.go:686 OpportunisticBatching, :891 AsyncAPICalls)."""
+
+    def test_defaults_and_overrides(self):
+        from kubernetes_tpu.config.features import default_gate
+        g = default_gate()
+        assert g.enabled("OpportunisticBatching")
+        g.set("OpportunisticBatching", False)
+        assert not g.enabled("OpportunisticBatching")
+
+    def test_unknown_gate_rejected(self):
+        from kubernetes_tpu.config.features import default_gate
+        with pytest.raises(ValueError, match="unknown feature gate"):
+            default_gate({"NoSuchGate": True})
+        cfg = KubeSchedulerConfiguration(feature_gates={"Bogus": True})
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_gate_flips_uniform_fast_path(self, monkeypatch):
+        """With OpportunisticBatching off, run_uniform must never be
+        invoked — every drain takes the scan program."""
+        import kubernetes_tpu.scheduler as sched_mod
+
+        def boom(*a, **k):
+            raise AssertionError("run_uniform called with gate off")
+
+        api = APIServer()
+        cfg = KubeSchedulerConfiguration(
+            feature_gates={"OpportunisticBatching": False})
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        monkeypatch.setattr(sched_mod, "run_uniform", boom)
+        for i in range(3):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 32, "memory": "64Gi", "pods": 110}).obj())
+        for i in range(40):   # >= UNIFORM_RUN_MIN, would trigger top-L
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj())
+        assert sched.schedule_pending() == 40
+
+    def test_async_api_calls_gate_sets_pipeline_depth(self):
+        api = APIServer()
+        cfg = KubeSchedulerConfiguration(
+            feature_gates={"SchedulerAsyncAPICalls": False})
+        sched = Scheduler(api, config=cfg)
+        assert sched.max_inflight_drains == 0
+        assert Scheduler(APIServer()).max_inflight_drains == 8
+
+
+class TestPluginArgs:
+    """Typed per-plugin args (types_pluginargs.go analog)."""
+
+    def test_most_allocated_via_plugin_args_packs(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [{
+            "pluginArgs": {"NodeResourcesFit": {
+                "scoringStrategy": "MostAllocated"}},
+        }]})
+        cfg.validate()
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        assert (next(iter(sched.profiles.values()))
+                .score_config.strategy == "MostAllocated")
+        for i in range(2):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 110}).obj())
+        # pre-load n1; MostAllocated must PACK subsequent pods onto it
+        seed = make_pod("seed").req({"cpu": "2", "memory": "2Gi"}).obj()
+        api.create_pod(seed)
+        api.bind(seed, "n1")
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 3
+        assert all(api.pods[f"default/p{i}"].spec.node_name == "n1"
+                   for i in range(3))
+
+    def test_unknown_arg_field_rejected(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [{
+            "pluginArgs": {"NodeResourcesFit": {"scoringStratgy": "x"}}}]})
+        with pytest.raises(ValueError, match="unknown NodeResourcesFitArgs"):
+            cfg.validate()
+
+    def test_args_for_unknown_plugin_rejected(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [{
+            "pluginArgs": {"NoSuchPlugin": {}}}]})
+        with pytest.raises(ValueError, match="unknown plugin"):
+            cfg.validate()
+
+    def test_gang_timeout_arg_applied(self):
+        from kubernetes_tpu.config import build_profiles
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [{
+            "pluginArgs": {"GangScheduling": {
+                "schedulingTimeoutSeconds": 42}}}]})
+        cfg.validate()
+        profs = build_profiles(cfg, APIServer())
+        gang = next(p for p in profs[0].framework.plugins
+                    if p.name() == "GangScheduling")
+        assert gang.scheduling_timeout_seconds == 42
+
+
+class TestObservability:
+    """Leveled logging + sampled plugin metrics + cache comparer
+    (metrics.go:322, debugger.go:31-76)."""
+
+    def _tiny_cluster(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=16)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 32, "memory": "64Gi", "pods": 110}).obj())
+        return api, sched
+
+    def test_plugin_execution_duration_sampled_on_host_path(self):
+        api, sched = self._tiny_cluster()
+        sched.UNIFORM_RUN_MIN = 10**9
+        # host path via extenders-free... force host: use schedule_one
+        for i in range(12):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj())
+        for _ in range(12):
+            sched.schedule_one()
+        hist = sched.metrics.plugin_execution_duration
+        # ~10% sampling over 12 attempts -> at least one Filter sample
+        assert hist.count("NodeResourcesFit", "Filter", "SUCCESS") >= 1
+
+    def test_plugin_evaluation_total_counts_device_batches(self):
+        api, sched = self._tiny_cluster()
+        for i in range(8):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj())
+        assert sched.schedule_pending() == 8
+        assert sched.metrics.plugin_evaluation_total.value(
+            "NodeResourcesFit", "Filter", "default-scheduler") == 8
+
+    def test_cache_comparer_clean_and_divergent(self):
+        api, sched = self._tiny_cluster()
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "100m", "memory": "64Mi"}).obj())
+        assert sched.schedule_pending() == 1
+        assert sched.debugger.compare() == []
+        # inject divergence: drop the pod from the cache behind the
+        # scheduler's back
+        sched.cache.pod_states.pop("default/p0")
+        sched.cache.assumed_pods.discard("default/p0")
+        problems = sched.debugger.compare()
+        assert any("not in cache" in p for p in problems)
+        assert sched.metrics.cache_divergence.value("host_vs_apiserver") >= 1
+
+    def test_debug_compare_both_layers(self):
+        api, sched = self._tiny_cluster()
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "100m", "memory": "64Mi"}).obj())
+        sched.schedule_pending()
+        out = sched.debug_compare()
+        assert out == {"device_vs_host": [], "host_vs_apiserver": []}
+
+    def test_klog_levels(self, capsys):
+        from kubernetes_tpu.utils.logging import klog, set_verbosity, verbosity
+        old = verbosity()
+        try:
+            set_verbosity(2)
+            assert klog.v(2).enabled and not klog.v(5).enabled
+            set_verbosity(5)
+            assert klog.v(5).enabled
+        finally:
+            set_verbosity(old)

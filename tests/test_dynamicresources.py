@@ -1,0 +1,191 @@
+"""DRA: structured-parameters device allocation
+(plugins/dynamicresources.py; reference
+pkg/scheduler/framework/plugins/dynamicresources/)."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (Device, DeviceRequest, ObjectMeta,
+                                      ResourceClaim, ResourceSlice)
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _gpu_slice(node, count=2, driver="gpu.example.com", mem="16Gi"):
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}-{driver}"),
+        node_name=node, driver=driver,
+        devices=[Device(name=f"{node}-gpu{i}",
+                        attributes=(("memory", mem), ("kind", "gpu")))
+                 for i in range(count)])
+
+
+def _claim(name, driver="gpu.example.com", count=1, selectors=None):
+    return ResourceClaim(
+        metadata=ObjectMeta(name=name),
+        requests=[DeviceRequest(name="req-0", driver=driver, count=count,
+                                selectors=selectors or {})])
+
+
+def _cluster(n_nodes=3, gpus_on=("n1",)):
+    api = APIServer()
+    sched = Scheduler(api, batch_size=32)
+    for i in range(n_nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110}).obj())
+    for n in gpus_on:
+        api.create_resource_slice(_gpu_slice(n))
+    return api, sched
+
+
+class TestAllocation:
+    def test_claim_pod_lands_on_device_node(self):
+        api, sched = _cluster(gpus_on=("n1",))
+        api.create_resource_claim(_claim("c0"))
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("c0").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/p0"].spec.node_name == "n1"
+        claim = api.get_resource_claim("default", "c0")
+        assert claim.allocation is not None
+        assert claim.allocation.node_name == "n1"
+        assert claim.reserved_for == ["default/p0"]
+
+    def test_selector_filters_devices(self):
+        api, sched = _cluster(gpus_on=("n1",))
+        api.create_resource_slice(_gpu_slice("n2", mem="80Gi"))
+        api.create_resource_claim(_claim("big", selectors={"memory": "80Gi"}))
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("big").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/p0"].spec.node_name == "n2"
+
+    def test_devices_are_exclusive_across_claims(self):
+        """Two pods, two claims, one node with 2 GPUs asking 2 each: only
+        one can allocate; the other is unschedulable until capacity."""
+        api, sched = _cluster(gpus_on=("n1",))
+        for i in range(2):
+            api.create_resource_claim(_claim(f"c{i}", count=2))
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).claim(f"c{i}").obj())
+        assert sched.schedule_pending() == 1
+        pods = [api.pods[f"default/p{i}"] for i in range(2)]
+        assert sorted(bool(p.spec.node_name) for p in pods) == [False, True]
+
+    def test_allocated_claim_pins_node(self):
+        """A pre-allocated claim restricts the pod to the allocation's
+        node (PreFilter shortcut)."""
+        from kubernetes_tpu.api.types import DeviceAllocation
+        api, sched = _cluster(gpus_on=("n1", "n2"))
+        c = _claim("pinned")
+        c.allocation = DeviceAllocation(
+            node_name="n2",
+            results={"req-0": (("gpu.example.com", "n2-gpu0"),)})
+        api.create_resource_claim(c)
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("pinned").obj())
+        assert sched.schedule_pending() == 1
+        assert api.pods["default/p0"].spec.node_name == "n2"
+
+    def test_missing_claim_unschedulable_until_created(self):
+        api, sched = _cluster(gpus_on=("n1",))
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("later").obj())
+        assert sched.schedule_pending() == 0
+        # claim arrival requeues via the ResourceClaim event
+        api.create_resource_claim(_claim("later"))
+        import time
+        time.sleep(1.1)   # pod backoff
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
+
+    def test_no_devices_no_fit(self):
+        api, sched = _cluster(gpus_on=())
+        api.create_resource_claim(_claim("c0"))
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("c0").obj())
+        assert sched.schedule_pending() == 0
+
+    def test_gate_removes_plugin(self):
+        from kubernetes_tpu.config import (KubeSchedulerConfiguration,
+                                           build_profiles)
+        cfg = KubeSchedulerConfiguration(
+            feature_gates={"DynamicResourceAllocation": False})
+        profs = build_profiles(cfg, APIServer())
+        names = [p.name() for p in profs[0].framework.plugins]
+        assert "DynamicResources" not in names
+
+    def test_claimless_pods_keep_fast_path(self):
+        """DRA in the default plugin set must not push claim-free pods
+        onto the per-pod hook chain."""
+        from kubernetes_tpu.scheduler import _needs_per_pod_hooks
+        api, sched = _cluster()
+        prof = next(iter(sched.profiles.values()))
+        assert prof.gang_only_hooks
+        pod = make_pod("plain").req({"cpu": "1", "memory": "1Gi"}).obj()
+        assert not _needs_per_pod_hooks(prof, pod.spec)
+        claimed = make_pod("claimed").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("c").obj()
+        assert _needs_per_pod_hooks(prof, claimed.spec)
+
+
+class TestReviewRegressions:
+    def test_one_pod_two_claims_cannot_double_book_a_device(self):
+        """Review finding: Filter/Reserve must thread occupancy across a
+        pod's OWN claims."""
+        api, sched = _cluster(gpus_on=())
+        api.create_resource_slice(_gpu_slice("n1", count=1))
+        for i in range(2):
+            api.create_resource_claim(_claim(f"c{i}", count=1))
+        api.create_pod(make_pod("p0").req(
+            {"cpu": "1", "memory": "1Gi"}).claim("c0", "c1").obj())
+        assert sched.schedule_pending() == 0   # 1 device can't serve 2 claims
+        # and with 2 devices it fits, on distinct devices
+        api.create_resource_slice(_gpu_slice("n2", count=2))
+        import time; time.sleep(1.1)
+        sched.flush_queues()
+        assert sched.schedule_pending() == 1
+        c0 = api.get_resource_claim("default", "c0")
+        c1 = api.get_resource_claim("default", "c1")
+        assert not (c0.allocation.device_ids() & c1.allocation.device_ids())
+
+    def test_plugin_args_without_strategy_keep_profile_strategy(self):
+        """Review finding: pluginArgs lacking scoringStrategy must not
+        reset the profile-level MostAllocated."""
+        from kubernetes_tpu.config import (KubeSchedulerConfiguration,
+                                           build_profiles)
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [{
+            "scoringStrategy": "MostAllocated",
+            "pluginArgs": {"NodeResourcesFit": {
+                "ignoredResources": ["example.com/foo"]}}}]})
+        cfg.validate()
+        profs = build_profiles(cfg, APIServer())
+        assert profs[0].score_config.strategy == "MostAllocated"
+        fit = next(p for p in profs[0].framework.plugins
+                   if p.name() == "NodeResourcesFit")
+        assert fit.args.scoring_strategy == "MostAllocated"
+        assert "example.com/foo" in fit.args.ignored_resources
+
+    def test_pdb_change_requeues_unschedulable_pod(self):
+        """Review finding: the PDB watch must actually move pods."""
+        api, sched = _cluster(n_nodes=1, gpus_on=())
+        filler = make_pod("filler").req(
+            {"cpu": "8", "memory": "1Gi"}).label("app", "f").obj()
+        api.create_pod(filler)
+        api.bind(filler, "n0")
+        from kubernetes_tpu.api.types import (LabelSelector, ObjectMeta,
+                                              PodDisruptionBudget)
+        api.create_pdb(PodDisruptionBudget(
+            metadata=ObjectMeta(name="block"),
+            selector=LabelSelector.of(match_labels={"app": "f"}),
+            min_available=1))
+        api.create_pod(make_pod("vip").req(
+            {"cpu": "8", "memory": "1Gi"}).priority(100).obj())
+        sched.schedule_pending()
+        # preemption proceeds despite the PDB (best effort) OR parks the
+        # pod; either way deleting the PDB must requeue, not strand
+        api.delete_pdb("default/block")
+        assert ("default/vip" not in sched.queue.unschedulable_pods
+                or sched.queue.unschedulable_pods["default/vip"].gated
+                or "default/vip" in sched.queue.backoff_q
+                or "default/vip" in sched.queue.active_q)

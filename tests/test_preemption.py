@@ -166,3 +166,70 @@ class TestNominatedPods:
         sched.schedule_pending()
         assert api.pods["default/vip"].spec.node_name == "n0"
         assert api.pods["default/sneak"].spec.node_name == ""
+
+
+class TestPDB:
+    """PDB-aware victim selection (preemption.go:658 step 1 +
+    filterPodsWithPDBViolation; default_preemption.go:640 reprieve order)."""
+
+    def _pdb(self, name, labels, min_available=None, max_unavailable=None):
+        from kubernetes_tpu.api.types import (LabelSelector, ObjectMeta,
+                                              PodDisruptionBudget)
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name=name),
+            selector=LabelSelector.of(match_labels=labels),
+            min_available=min_available, max_unavailable=max_unavailable)
+
+    def test_disruptions_allowed_status(self):
+        api = APIServer()
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 16, "memory": "32Gi", "pods": 10}).obj())
+        for i in range(4):
+            p = make_pod(f"a{i}").label("app", "a").obj()
+            api.create_pod(p)
+            api.bind(p, "n0")
+        api.create_pdb(self._pdb("pdb-min", {"app": "a"}, min_available=3))
+        api.create_pdb(self._pdb("pdb-max", {"app": "a"}, max_unavailable=1))
+        api.create_pdb(self._pdb("pdb-pct", {"app": "a"}, min_available="50%"))
+        allowed = {p.name: p.disruptions_allowed for p in api.list_pdbs()}
+        assert allowed == {"pdb-min": 1, "pdb-max": 1, "pdb-pct": 2}
+
+    def test_violation_partition_consumes_budget(self):
+        from kubernetes_tpu.framework.types import PodInfo
+        pdb = self._pdb("pdb", {"app": "a"}, min_available=1)
+        pdb.disruptions_allowed = 1
+        pods = [PodInfo.of(make_pod(f"p{i}").label("app", "a").obj())
+                for i in range(3)]
+        violating, ok = Evaluator._filter_pods_with_pdb_violation(pods, [pdb])
+        # budget 1: first pod consumes it, the rest violate
+        assert [pi.pod.name for pi in ok] == ["p0"]
+        assert [pi.pod.name for pi in violating] == ["p1", "p2"]
+
+    def test_pdb_changes_picked_node(self):
+        """Two identical candidates; the victim on n0 is PDB-protected
+        (0 allowed disruptions) → pick prefers n1 (fewest violations)."""
+        api, sched = _cluster(n_nodes=2, cpu=4)
+        api.create_pod(make_pod("guarded").req({"cpu": "4", "memory": "1Gi"})
+                       .label("app", "guarded").node("n0").obj())
+        api.create_pod(make_pod("plain").req({"cpu": "4", "memory": "1Gi"})
+                       .label("app", "plain").node("n1").obj())
+        api.create_pdb(self._pdb("pdb", {"app": "guarded"}, min_available=1))
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        assert api.pods["default/vip"].status.nominated_node_name == "n1"
+        assert "default/plain" not in api.pods       # plain evicted
+        assert "default/guarded" in api.pods         # guarded survived
+
+    def test_pdb_violated_when_no_alternative(self):
+        """With every victim PDB-protected, preemption still proceeds
+        (PDBs are best-effort in preemption, preemption.go:640)."""
+        api, sched = _cluster(n_nodes=1, cpu=4)
+        api.create_pod(make_pod("guarded").req({"cpu": "4", "memory": "1Gi"})
+                       .label("app", "g").node("n0").obj())
+        api.create_pdb(self._pdb("pdb", {"app": "g"}, min_available=1))
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        assert api.pods["default/vip"].status.nominated_node_name == "n0"
+        assert "default/guarded" not in api.pods
