@@ -1,0 +1,636 @@
+"""Host-side vectorized greedy for SAME-SIGNATURE group runs.
+
+The device scan (ops/program.py run_batch) pays ~0.4ms of tunneled-TPU
+execution per sequential step, which caps spread/anti-affinity workloads at
+a few thousand pods/s regardless of host speed. But a run of same-signature
+pods has a tiny per-step state delta: one node count, one topology-domain
+count vector, one inter-pod-affinity surface update — all O(N) numpy work.
+This module is the generalization of the closed-form uniform path
+(run_uniform / reference runtime/batch.go:97) to group constraints: the
+sequential greedy executes on the HOST over the numpy staging arrays, with
+vectorized per-step updates, in exact oracle semantics.
+
+Exactness contract: every formula here mirrors the HOST PLUGINS (the
+framework's decision oracle — podtopologyspread/scoring.go port,
+interpodaffinity/scoring.go port, least_allocated.go, filtering.go skew
+check), which the device scan is itself fuzz-verified against
+(tests/test_groups_parity.py). tests/test_hostgreedy_parity.py closes the
+triangle by fuzzing this path against the scan.
+
+Eligibility (the caller checks): single signature row, sig != 0 (no host
+ports), LeastAllocated strategy, no PreferNoSchedule taints and no
+preferred-node-affinity weight on the row (their normalization constants
+would shift as nodes saturate — same preconditions as run_uniform's
+norm_ok), single device (mesh off), OpportunisticBatching gate on.
+
+After the run the caller commits the assignments through the normal bulk
+path and INVALIDATES the device carry: the next device batch reseeds from
+the host snapshot, which the commits already updated — no device-side
+count reconciliation is needed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+MAX_NODE_SCORE = 100
+INT32_MAX = np.int32(2**31 - 1)
+
+# selector op codes (state/batch.py)
+OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT = 1, 2, 3, 4, 5, 6
+TOL_EXISTS = 2
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+NON_NUMERIC = np.iinfo(np.int64).min
+
+
+# ---------------------------------------------------------------------------
+# static (carry-independent) parts — numpy mirrors of ops/program.py kernels
+
+
+def _taint_filter_mask(a, row) -> np.ndarray:
+    """taint_filter_mask: no untolerated NoSchedule/NoExecute taint."""
+    tk, tv, te = a.taint_key, a.taint_val, a.taint_eff      # [N, T]
+    ok_key = (row.tol_key[None, None, :] == 0) | (
+        row.tol_key[None, None, :] == tk[:, :, None])
+    ok_eff = (row.tol_eff[None, None, :] == 0) | (
+        row.tol_eff[None, None, :] == te[:, :, None])
+    ok_val = (row.tol_op[None, None, :] == TOL_EXISTS) | (
+        row.tol_val[None, None, :] == tv[:, :, None])
+    tolerated = ((row.tol_op[None, None, :] != 0)
+                 & ok_key & ok_eff & ok_val).any(axis=2)    # [N, T]
+    hard = (te == EFFECT_NO_SCHEDULE) | (te == EFFECT_NO_EXECUTE)
+    return ~(hard & ~tolerated).any(axis=1)
+
+
+def _requirements_ok(a, keys, ops, nums, vals) -> np.ndarray:
+    """[Q] requirements ANDed, for every node → bool[N]."""
+    N = a.label_key.shape[0]
+    out = np.ones((N,), bool)
+    for q in range(keys.shape[0]):
+        op = int(ops[q])
+        if op == 0:
+            continue
+        key_hit = (a.label_key == keys[q]) & (keys[q] != 0)   # [N, L]
+        key_present = key_hit.any(axis=1)
+        if op == OP_IN or op == OP_NOT_IN:
+            v = vals[q]
+            kv_match = ((a.label_kv[:, :, None] == v[None, None, :])
+                        & (v[None, None, :] != 0)).any(axis=(1, 2))
+            out &= kv_match if op == OP_IN else ~kv_match
+        elif op == OP_EXISTS:
+            out &= key_present
+        elif op == OP_DOES_NOT_EXIST:
+            out &= ~key_present
+        else:  # Gt / Lt
+            numeric = np.where(key_hit, a.label_num, NON_NUMERIC).max(axis=1)
+            has = key_present & (numeric != NON_NUMERIC)
+            out &= has & ((numeric > nums[q]) if op == OP_GT
+                          else (numeric < nums[q]))
+    return out
+
+
+def _selector_mask(a, row) -> np.ndarray:
+    """nodeSelector conjuncts AND required nodeAffinity terms (ORed)."""
+    sel = row.ns_sel_val
+    active = sel != 0
+    if active.any():
+        present = (sel[None, :, None] == a.label_kv[:, None, :]).any(axis=2)
+        sel_ok = (~active[None, :] | present).all(axis=1)
+    else:
+        sel_ok = np.ones((a.label_kv.shape[0],), bool)
+    if not row.aff_has:
+        return sel_ok
+    any_term = np.zeros_like(sel_ok)
+    for t in range(row.aff_term_valid.shape[0]):
+        if not row.aff_term_valid[t]:
+            continue
+        any_term |= _requirements_ok(a, row.aff_key[t], row.aff_op[t],
+                                     row.aff_num[t], row.aff_val[t])
+    return sel_ok & any_term
+
+
+def _image_score(a, row) -> np.ndarray:
+    """image_locality_score numpy mirror (image_locality.go:95-131)."""
+    from ..plugins.imagelocality import (MAX_CONTAINER_THRESHOLD,
+                                         MIN_THRESHOLD)
+    if row.img_containers <= 0:
+        return np.zeros((a.image_id.shape[0],), np.int64)
+    match = (a.image_id[:, :, None] == row.img_ids[None, None, :]) & (
+        row.img_ids[None, None, :] != 0)
+    size_c = np.where(match, a.image_size[:, :, None], 0).sum(axis=1)
+    present_c = match.any(axis=1)
+    num_with = (present_c & a.valid[:, None]).sum(axis=0)
+    total = max(int(a.valid.sum()), 1)
+    spread = num_with.astype(np.float64) / float(total)
+    scaled = (size_c.astype(np.float64) * spread[None, :]).astype(np.int64)
+    sum_scores = scaled.sum(axis=1)
+    nc = max(int(row.img_containers), 1)
+    max_thr = MAX_CONTAINER_THRESHOLD * nc
+    clamped = np.clip(sum_scores, MIN_THRESHOLD, max_thr)
+    return (MAX_NODE_SCORE * (clamped - MIN_THRESHOLD)
+            // max(max_thr - MIN_THRESHOLD, 1))
+
+
+class _Row:
+    """One signature row of the (numpy) PodTable, attribute access."""
+
+    def __init__(self, table, u: int):
+        for f in table._fields:
+            setattr(self, f, getattr(table, f)[u])
+        self.u = u
+
+
+# ---------------------------------------------------------------------------
+# the greedy
+
+
+class _DomTerm:
+    """Domain compression for one tv-valued row ([N] interned topology
+    values): dense domain ids + per-domain node index lists, so a
+    placement updates O(N/D) entries and domain-level scalars instead of
+    full [N] vectors."""
+
+    __slots__ = ("node_dom", "idx", "D", "tv_ok")
+
+    def __init__(self, tv: np.ndarray):
+        self.tv_ok = tv != 0
+        uniq = np.unique(tv[self.tv_ok])
+        self.D = len(uniq)
+        nd = np.searchsorted(uniq, tv)
+        nd = np.where(self.tv_ok, nd, self.D)   # sentinel slot D
+        self.node_dom = nd.astype(np.int32)
+        self.idx = [np.nonzero(nd == d)[0] for d in range(self.D)]
+
+    def dom_of(self, b: int) -> int:
+        return int(self.node_dom[b])
+
+
+class HostGreedy:
+    """One run's state. Build once per same-signature run, then `run(k)`
+    produces the exact sequential-greedy assignment of k pods.
+
+    All group state is domain-compressed (_DomTerm): spread/affinity
+    counts live per topology DOMAIN, masks and scores are gathered [N]
+    vectors, and a placement's update cost is O(nodes-in-domain) — the
+    node-level formulas of ops/groups.py evaluated sparsely."""
+
+    def __init__(self, cfg, arrays, table, u: int, gd, gc,
+                 n_eff: Optional[int] = None):
+        """`n_eff`: live node-slot count — every [N]-shaped op runs on the
+        occupied prefix of the pow2 node bucket (slots are allocated
+        contiguously from 0; freed slots are reused before growth)."""
+        self.cfg = cfg
+        if n_eff is not None and n_eff < arrays.cap.shape[0]:
+            # slice the node axis by FIELD NAME (GroupsDev/GroupCarry
+            # specs) — a shape[-1]==N heuristic mis-truncates per-row
+            # tensors whenever the row count U happens to equal N
+            gd_node = {"spr_f_tv", "spr_f_elig", "spr_s_tv", "spr_s_elig",
+                       "spr_s_keys_ok", "spr_s_dom", "ipa_ra_tv",
+                       "ipa_raa_tv", "ipa_stc_tv", "ipa_stp_tv"}
+            gc_node = {"spr_f_cnt", "spr_s_cnt", "ipa_veto", "ipa_a_cnt",
+                       "ipa_aa_cnt", "ipa_score"}
+            arrays = type(arrays)(*(x[:n_eff] for x in arrays))
+            gd = type(gd)(*(
+                x[..., :n_eff] if name in gd_node else x
+                for name, x in zip(gd._fields, gd)))
+            gc = type(gc)(*(
+                x[..., :n_eff] if name in gc_node else x
+                for name, x in zip(gc._fields, gc)))
+        self.a = arrays
+        self.row = _Row(table, u)
+        self.u = u
+        a, row = self.a, self.row
+        N = a.cap.shape[0]
+        self.N = N
+
+        # -- static feasibility (the SigCache static_mask parts)
+        m = a.valid.copy()
+        if row.node_name_id != 0:
+            m &= a.name_id == row.node_name_id
+        m &= ~a.unschedulable | bool(row.tolerates_unsched)
+        m &= _taint_filter_mask(a, row)
+        m &= _selector_mask(a, row)
+        self.static_mask = m
+        self.s_img = _image_score(a, row)
+
+        # -- exactness preconditions (run_uniform norm_ok analog)
+        prefer = ((a.taint_eff == EFFECT_PREFER_NO_SCHEDULE)
+                  & a.valid[:, None]).any()
+        self.ok = (not prefer) and (not row.pref_weight.any())
+
+        # -- fit state (python scalars per update; vectors at init)
+        self.req = row.req.astype(np.int64)
+        self.nzreq = row.nonzero_req.astype(np.int64)
+        self.j = np.zeros((N,), np.int64)
+        cols = np.array(cfg.score_cols, np.int32)
+        self.cols = cols
+        self.col_w = np.array(cfg.col_weights, np.int64)
+        self.col_nz = np.array(cfg.col_nonzero, bool)
+        self.nz_slot = np.array(cfg.nonzero_slot, np.int32)
+        self.cap_cols = a.cap[:, cols].astype(np.int64)
+        self.used_cols0 = a.used[:, cols].astype(np.int64)
+        self.nz_used0 = a.nonzero_used[:, self.nz_slot].astype(np.int64)
+        self.npods0 = a.npods.astype(np.int64)
+        self.allowed = a.allowed_pods.astype(np.int64)
+        self.fit_ok = self._fit_ok_vec()
+        self.s_fit = self._s_fit_vec()
+        self.s_bal = self._s_bal_vec()
+        # static part of the total score; s_fit/s_bal entries update at b
+        self._static_total = (cfg.w_fit * self.s_fit
+                              + cfg.w_balanced * self.s_bal
+                              + cfg.w_image * self.s_img)
+
+        # -- spread DoNotSchedule (domain-level)
+        self.spr_f = []   # (dom, dom_cnt[D], dom_elig[D], skew, self_n, m_self, elig_node, min_zero)
+        for c in np.nonzero(gd.spr_f_active[u])[0]:
+            dt = _DomTerm(gd.spr_f_tv[u, c])
+            elig = gd.spr_f_elig[u, c]
+            dom_cnt = np.zeros((dt.D,), np.int64)
+            dom_elig = np.zeros((dt.D,), bool)
+            cnt = gc.spr_f_cnt[u, c]
+            for d in range(dt.D):
+                nodes = dt.idx[d]
+                dom_cnt[d] = cnt[nodes[0]] if len(nodes) else 0
+                dom_elig[d] = elig[nodes].any() if len(nodes) else False
+            self.spr_f.append({
+                "dom": dt, "cnt": dom_cnt, "elig_dom": dom_elig,
+                "skew": int(gd.spr_f_max_skew[u, c]),
+                "selfn": int(gd.spr_f_self[u, c]),
+                "m_self": bool(gd.m_spr_f[u, u, c]),
+                "elig_node": elig,
+                "min_zero": bool(gc.spr_f_min_zero[u, c]),
+                "ok_buf": np.zeros((dt.D + 1,), bool)})
+
+        # -- spread ScheduleAnyway (score): host constraints stay
+        # node-level (per-node counts); topology constraints domain-level
+        self.spr_s = []
+        self._raw = np.zeros((N,), np.float64)   # un-normalized spread sum
+        self._raw_dirty = True
+        for c in np.nonzero(gd.spr_s_active[u])[0]:
+            is_host = bool(gd.spr_s_is_host[u, c])
+            dt = _DomTerm(gd.spr_s_tv[u, c])
+            cnt_node = gc.spr_s_cnt[u, c].astype(np.float64).copy()
+            dom_cnt = np.zeros((dt.D,), np.float64)
+            for d in range(dt.D):
+                nodes = dt.idx[d]
+                dom_cnt[d] = cnt_node[nodes[0]] if len(nodes) else 0.0
+            self.spr_s.append({
+                "dom": dt, "is_host": is_host,
+                "cnt_node": cnt_node, "cnt_dom": dom_cnt,
+                "skew": int(gd.spr_s_max_skew[u, c]),
+                "m_self": bool(gd.m_spr_s[u, u, c]),
+                "elig_node": gd.spr_s_elig[u, c],
+                "weight": 0.0})
+        self.has_spr_s = bool(self.spr_s)
+        self.spr_s_keys_ok = gd.spr_s_keys_ok[u]
+        self.spr_s_dom_rows = gd.spr_s_dom[u]
+        self._prev_scored = None
+        self._npart = 0
+        self._dom_scored_cnt = np.zeros((0,), np.int64)
+        if len(self.spr_s) == 1 and not self.spr_s[0]["is_host"]:
+            self._norm_buf = np.zeros(
+                (self.spr_s[0]["dom"].D + 1,), np.int64)
+
+        # -- inter-pod affinity (domain-level counters, node-level caches)
+        self.ipa_veto = gc.ipa_veto[u].astype(np.int64).copy()
+        self.ipa_raa = []
+        for t in range(gd.ipa_raa_tv.shape[1]):
+            active = bool(gd.ipa_raa_active[u, t])
+            exist_self = bool(gd.m_ipa_exist[u, u, t])
+            aa_self = bool(gd.m_ipa_aa[u, u, t])
+            if not (active or exist_self or aa_self):
+                continue
+            dt = _DomTerm(gd.ipa_raa_tv[u, t])
+            self.ipa_raa.append({
+                "dom": dt, "active": active, "exist_self": exist_self,
+                "aa_self": aa_self,
+                "aa_cnt_node": gc.ipa_aa_cnt[u, t].astype(np.int64).copy()})
+        self.ipa_ra = []
+        for t in np.nonzero(gd.ipa_ra_active[u])[0]:
+            dt = _DomTerm(gd.ipa_ra_tv[u, t])
+            self.ipa_ra.append({
+                "dom": dt,
+                "a_cnt_node": gc.ipa_a_cnt[u, t].astype(np.int64).copy()})
+        self.m_ipa_a_self = bool(gd.m_ipa_a[u, u])
+        self.ipa_a_total = int(gc.ipa_a_total[u])
+        self.ipa_self_all = bool(gd.ipa_self_all[u])
+        self.ipa_score = gc.ipa_score[u].astype(np.int64).copy()
+        self.ipa_sc_terms = []   # symmetric score surface contributions
+        for t in np.nonzero(gd.w_stc[u, u])[0]:
+            self.ipa_sc_terms.append((_DomTerm(gd.ipa_stc_tv[u, t]),
+                                      int(gd.w_stc[u, u, t])))
+        for t in np.nonzero(gd.w_stp[u, u])[0]:
+            self.ipa_sc_terms.append((_DomTerm(gd.ipa_stp_tv[u, t]),
+                                      int(gd.w_stp[u, u, t])))
+        self.has_ipa_score = bool(
+            (self.ipa_score != 0).any() or self.ipa_sc_terms)
+        self.has_ipa_mask = bool(
+            self.ipa_raa or self.ipa_ra or self.ipa_veto.any())
+
+    # -- fit / balanced score vectors (least_allocated.go / balanced_*) ------
+
+    def _used_cols(self, j):
+        used_nz = self.nz_used0 + j[:, None] * self.nzreq[self.nz_slot][None, :]
+        used_pl = self.used_cols0 + j[:, None] * self.req[self.cols][None, :]
+        return np.where(self.col_nz[None, :], used_nz, used_pl), used_pl
+
+    def _fit_ok_vec(self):
+        j = self.j
+        pods_ok = self.npods0 + j + 1 <= self.allowed
+        used1 = self.a.used.astype(np.int64) + (j[:, None] + 1) * self.req[None, :]
+        cols_ok = ((self.req[None, :] == 0)
+                   | (used1 <= self.a.cap)).all(axis=1)
+        return pods_ok & cols_ok
+
+    def _s_fit_vec(self):
+        used_cols, _ = self._used_cols(self.j + 1)
+        cap = self.cap_cols
+        ok = cap > 0
+        if self.cfg.strategy == "MostAllocated":
+            raw = np.where((cap == 0) | (used_cols > cap), 0,
+                           used_cols * MAX_NODE_SCORE // np.maximum(cap, 1))
+        else:
+            raw = np.where((cap == 0) | (used_cols > cap), 0,
+                           (cap - used_cols) * MAX_NODE_SCORE
+                           // np.maximum(cap, 1))
+        ssum = np.where(ok, raw * self.col_w[None, :], 0).sum(axis=1)
+        wsum = np.where(ok, self.col_w[None, :], 0).sum(axis=1)
+        return np.where(wsum > 0, ssum // np.maximum(wsum, 1), 0)
+
+    def _s_bal_vec(self):
+        if self.row.skip_balanced:
+            return np.zeros((self.N,), np.int64)
+        _, used_pl = self._used_cols(self.j + 1)
+        cap = self.cap_cols
+        ok = cap > 0
+        frac = np.where(ok, np.minimum(used_pl / np.maximum(cap, 1), 1.0), 0.0)
+        cnt = ok.sum(axis=1)
+        mean = frac.sum(axis=1) / np.maximum(cnt, 1)
+        var = np.where(ok, (frac - mean[:, None]) ** 2, 0.0).sum(axis=1) \
+            / np.maximum(cnt, 1)
+        std = np.sqrt(var)
+        return np.floor((1.0 - std) * MAX_NODE_SCORE + 1e-9).astype(np.int64)
+
+    def _refresh_node(self, b: int) -> None:
+        """Python-scalar recompute of fit_ok/s_fit/s_bal/_static_total for
+        the one node a placement touched."""
+        cfg = self.cfg
+        j1 = int(self.j[b]) + 1
+        # fit_ok
+        ok = int(self.npods0[b]) + j1 <= int(self.allowed[b])
+        if ok:
+            used_row = self.a.used[b]
+            cap_row = self.a.cap[b]
+            req = self.req
+            for r in range(req.shape[0]):
+                rq = int(req[r])
+                if rq and int(used_row[r]) + j1 * rq > int(cap_row[r]):
+                    ok = False
+                    break
+        self.fit_ok[b] = ok
+        # s_fit / s_bal over the score columns
+        C = len(self.cfg.score_cols)
+        ssum = wsum = 0
+        fracs = []
+        nok = 0
+        fsum = 0.0
+        most = cfg.strategy == "MostAllocated"
+        for ci in range(C):
+            cap = int(self.cap_cols[b, ci])
+            used_pl = int(self.used_cols0[b, ci]) + j1 * int(self.req[self.cols[ci]])
+            if self.col_nz[ci]:
+                used = int(self.nz_used0[b, ci]) + j1 * int(self.nzreq[self.nz_slot[ci]])
+            else:
+                used = used_pl
+            if cap > 0:
+                w = int(self.col_w[ci])
+                if used <= cap:
+                    raw = (used * MAX_NODE_SCORE // cap if most
+                           else (cap - used) * MAX_NODE_SCORE // cap)
+                else:
+                    raw = 0
+                ssum += raw * w
+                wsum += w
+                f = min(used_pl / cap, 1.0)
+                fracs.append(f)
+                fsum += f
+                nok += 1
+        s_fit = ssum // wsum if wsum > 0 else 0
+        self.s_fit[b] = s_fit
+        if self.row.skip_balanced:
+            s_bal = 0
+        else:
+            mean = fsum / max(nok, 1)
+            var = sum((f - mean) ** 2 for f in fracs) / max(nok, 1)
+            s_bal = int(math.floor((1.0 - math.sqrt(var)) * MAX_NODE_SCORE
+                                   + 1e-9))
+            self.s_bal[b] = s_bal
+        self._static_total[b] = (cfg.w_fit * s_fit
+                                 + cfg.w_balanced * s_bal
+                                 + cfg.w_image * int(self.s_img[b]))
+
+    # -- group mask / scores (ops/groups.py formulas, domain-level) ----------
+
+    def _group_mask(self) -> np.ndarray:
+        mask = None
+        for c in self.spr_f:
+            dom_cnt, elig = c["cnt"], c["elig_dom"]
+            minv = 0 if c["min_zero"] else (
+                int(dom_cnt[elig].min()) if elig.any() else int(INT32_MAX))
+            ok_dom = c["ok_buf"]
+            np.less_equal(dom_cnt + (c["selfn"] - minv), c["skew"],
+                          out=ok_dom[:-1])
+            part = ok_dom[c["dom"].node_dom]   # sentinel slot stays False
+            mask = part if mask is None else (mask & part)
+        if self.has_ipa_mask:
+            part = self.ipa_veto == 0
+            mask = part if mask is None else (mask & part)
+            for t in self.ipa_raa:
+                if t["active"]:
+                    mask &= ~(t["dom"].tv_ok & (t["aa_cnt_node"] > 0))
+            if self.ipa_ra:
+                escape = (self.ipa_a_total == 0) and self.ipa_self_all
+                for t in self.ipa_ra:
+                    if escape:
+                        mask &= t["dom"].tv_ok
+                    else:
+                        mask &= t["dom"].tv_ok & (t["a_cnt_node"] > 0)
+        if mask is None:
+            mask = np.ones((self.N,), bool)
+        return mask
+
+    def _scored_stats(self, scored: np.ndarray):
+        """(npart, distinct, weights, per-domain scored counts) with
+        incremental updates (the scored set flips rarely)."""
+        if self._prev_scored is None or not np.array_equal(
+                scored, self._prev_scored):
+            self._npart = int(scored.sum())
+            for c in self.spr_s:
+                if not c["is_host"]:
+                    hist = np.bincount(c["dom"].node_dom[scored],
+                                       minlength=c["dom"].D + 1)[:c["dom"].D]
+                    c["dom_scored"] = hist
+                    c["distinct"] = int((hist > 0).sum())
+                size = self._npart if c["is_host"] else c["distinct"]
+                c["weight"] = math.log(float(size) + 2.0)
+            self._prev_scored = scored.copy()
+            self._raw_dirty = True
+            if len(self.spr_s) == 1 and not self.spr_s[0]["is_host"]:
+                self._dom_scored_cnt = self.spr_s[0]["dom_scored"]
+        return self._npart
+
+    def _rebuild_raw(self) -> None:
+        """Un-normalized spread score sum (scoring.go:199-271): rebuilt
+        when weights changed (scored-set flip), else maintained by _apply's
+        sparse adds."""
+        self._raw.fill(0.0)
+        for c in self.spr_s:
+            npart = self._npart
+            size = npart if c["is_host"] else c.get("distinct", 0)
+            w = math.log(float(size) + 2.0)
+            c["weight"] = w
+            add = np.where(c["dom"].tv_ok,
+                           c["cnt_node"] * w + float(c["skew"] - 1), 0.0)
+            self._raw += add
+        self._raw_dirty = False
+
+    def _group_scores(self, feasible: np.ndarray) -> np.ndarray:
+        total = np.zeros((self.N,), np.int64)
+        cfg = self.cfg
+        if self.has_spr_s:
+            scored = feasible & self.spr_s_keys_ok
+            self._scored_stats(scored)
+            c = self.spr_s[0]
+            if len(self.spr_s) == 1 and not c["is_host"]:
+                # single topology constraint: raw is domain-constant, so
+                # normalize at DOMAIN level (D scalars) and gather — the
+                # common一-constraint case drops every [N] float op
+                dt = c["dom"]
+                w = c["weight"]
+                raw_dom = np.round(c["cnt_dom"] * w
+                                   + float(c["skew"] - 1)).astype(np.int64)
+                present = self._dom_scored_cnt > 0
+                if present.any():
+                    minv = int(raw_dom[present].min())
+                    maxv = int(raw_dom[present].max())
+                else:
+                    minv, maxv = int(INT32_MAX), 0
+                buf = self._norm_buf
+                if maxv == 0:
+                    buf[:-1] = MAX_NODE_SCORE
+                else:
+                    buf[:-1] = (MAX_NODE_SCORE * (maxv + minv - raw_dom)
+                                // maxv)
+                total += cfg.w_spread * np.where(scored,
+                                                 buf[dt.node_dom], 0)
+            else:
+                if self._raw_dirty:
+                    self._rebuild_raw()
+                raw = np.round(self._raw).astype(np.int64)
+                if scored.any():
+                    minv = int(raw[scored].min())
+                    maxv = int(raw[scored].max())
+                else:
+                    minv, maxv = int(INT32_MAX), 0
+                if maxv == 0:
+                    norm = np.full((self.N,), MAX_NODE_SCORE, np.int64)
+                else:
+                    norm = MAX_NODE_SCORE * (maxv + minv - raw) // maxv
+                total += cfg.w_spread * np.where(scored, norm, 0)
+        if self.has_ipa_score:
+            s = self.ipa_score
+            if feasible.any():
+                minv = int(s[feasible].min())
+                maxv = int(s[feasible].max())
+            else:
+                minv, maxv = 0, 0
+            diff = maxv - minv
+            if diff > 0:
+                ipa = (MAX_NODE_SCORE * (s - minv).astype(np.float64)
+                       / float(diff)).astype(np.int64)
+                total += cfg.w_ipa * ipa
+        return total
+
+    def _apply(self, b: int) -> None:
+        """State update after placing one run-pod on node b (group_update's
+        u==consumer slice + fit bookkeeping) — sparse domain updates."""
+        self.j[b] += 1
+        self._refresh_node(b)
+        for c in self.spr_f:
+            if c["m_self"] and c["elig_node"][b]:
+                d = c["dom"].dom_of(b)
+                if d < c["dom"].D:
+                    c["cnt"][d] += 1
+        raw_touched = None
+        for c in self.spr_s:
+            if not c["m_self"]:
+                continue
+            if c["is_host"]:
+                c["cnt_node"][b] += 1.0
+                raw_touched = (np.array([b]) if raw_touched is None
+                               else np.union1d(raw_touched, [b]))
+            elif c["elig_node"][b]:
+                d = c["dom"].dom_of(b)
+                if d < c["dom"].D:
+                    idx = c["dom"].idx[d]
+                    c["cnt_node"][idx] += 1.0
+                    c["cnt_dom"][d] += 1.0
+                    raw_touched = (idx if raw_touched is None
+                                   else np.union1d(raw_touched, idx))
+        if raw_touched is not None and not self._raw_dirty:
+            # recompute (not increment) the touched rows: the device/oracle
+            # evaluates cnt·w fresh each step, and an accumulated w+w+…
+            # can drift an ulp from cnt·w at a round() boundary
+            acc = np.zeros((len(raw_touched),), np.float64)
+            for c in self.spr_s:
+                acc += np.where(c["dom"].tv_ok[raw_touched],
+                                c["cnt_node"][raw_touched] * c["weight"]
+                                + float(c["skew"] - 1), 0.0)
+            self._raw[raw_touched] = acc
+        for t in self.ipa_raa:
+            d = t["dom"].dom_of(b)
+            if d >= t["dom"].D:
+                continue
+            idx = t["dom"].idx[d]
+            if t["exist_self"]:
+                self.ipa_veto[idx] += 1
+            if t["aa_self"]:
+                t["aa_cnt_node"][idx] += 1
+        if self.m_ipa_a_self:
+            bumped = 0
+            for t in self.ipa_ra:
+                d = t["dom"].dom_of(b)
+                if d < t["dom"].D:
+                    t["a_cnt_node"][t["dom"].idx[d]] += 1
+                    bumped += 1
+            self.ipa_a_total += bumped
+        for dt, w in self.ipa_sc_terms:
+            d = dt.dom_of(b)
+            if d < dt.D:
+                self.ipa_score[dt.idx[d]] += w
+
+    # -- the run --------------------------------------------------------------
+
+    def run(self, k: int) -> np.ndarray:
+        """Assign k same-signature pods sequentially; returns int32[k]
+        (-1 = unschedulable). A failed step leaves state untouched, so
+        every later identical step fails too — fill and stop."""
+        out = np.full((k,), -1, np.int32)
+        base = self.static_mask
+        for i in range(k):
+            feasible = base & self.fit_ok & self._group_mask()
+            if not feasible.any():
+                break
+            total = self._static_total + self._group_scores(feasible)
+            masked = np.where(feasible, total, -1)
+            b = int(masked.argmax())
+            if masked[b] < 0:
+                break
+            out[i] = b
+            self._apply(b)
+        return out

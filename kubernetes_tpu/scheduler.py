@@ -883,6 +883,10 @@ class Scheduler:
             self.builder.groups.any_groups()
             or bool(self.snapshot.have_pods_with_affinity_list)
             or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
+        if groups_needed:
+            bound = self._try_host_greedy(qpis, profile, segment_batch)
+            if bound is not None:
+                return bound
         table_reset = self.builder.reset_count != self._builder_reset_seen
         self._builder_reset_seen = self.builder.reset_count
         capacity = (self.builder.groups.device_rows(), na.used.shape[0])
@@ -959,6 +963,57 @@ class Scheduler:
 
     # below this run length the scan's per-step cost beats the matrix setup
     UNIFORM_RUN_MIN = 16
+
+    def _try_host_greedy(self, qpis: list[QueuedPodInfo], profile: Profile,
+                         batch) -> Optional[int]:
+        """Host-side vectorized greedy for a SAME-SIGNATURE drain with
+        group constraints (ops/hostgreedy.py) — the group analog of the
+        closed-form uniform path. The device scan pays ~0.4ms of tunneled
+        execution per sequential step; the host replays the exact oracle
+        formulas at ~40µs/step. Returns binds committed, or None when the
+        drain isn't eligible (caller continues on the device path)."""
+        n = len(qpis)
+        if (self.mesh is not None
+                or not self.feature_gates.enabled("OpportunisticBatching")
+                or profile.score_config.strategy != "LeastAllocated"
+                or n < self.UNIFORM_RUN_MIN):
+            return None
+        sig = batch.sig[:n]
+        if sig[0] == 0 or not (sig == sig[0]).all():
+            return None
+        # cheap precondition pre-checks BEFORE quiescing the pipeline: a
+        # cluster with PreferNoSchedule taints (or a row with preferred
+        # node affinity) would fail hg.ok after paying the full drain +
+        # snapshot + group-tensor build on every single drain
+        if (self._cluster_has_prefer_taints()
+                or batch.table.pref_weight[int(batch.tidx[0])].any()):
+            return None
+        from .ops.hostgreedy import HostGreedy
+        # commits mutate the host cache the greedy reads — quiesce first
+        self._drain_pending()
+        self.cache.update_snapshot(self.snapshot)
+        self.state.apply_snapshot(self.snapshot)
+        self.state.ensure_arrays()
+        gd, gc = self.builder.groups.build_dev(self.snapshot)
+        t0 = _time.perf_counter()
+        hg = HostGreedy(profile.score_config, self.state.arrays,
+                        batch.table, int(batch.tidx[0]), gd, gc,
+                        n_eff=len(self.state.node_names))
+        if not hg.ok:
+            return None   # normalization preconditions failed: scan path
+        with self.tracer.span("host_greedy", pods=n):
+            out = hg.run(n)
+        # placements live only in the upcoming commits; the resident device
+        # carry (if any) knows nothing of them
+        self._invalidate_device_state()
+        self.device_batches += 1
+        self.metrics.device_batch_size.observe(n)
+        self.metrics.device_batch_duration.observe(
+            max(_time.perf_counter() - t0, 0.0))
+        pd = _PendingDrain(qpis=qpis, profile=profile, batch=batch,
+                           table=None, na=None, n=n, groups_needed=True,
+                           records=[], dispatched_at=t0)
+        return self._commit_assignments(pd, out)
 
     def _node_arrays(self):
         """Device (or mesh-placed) node arrays, cached until the staging

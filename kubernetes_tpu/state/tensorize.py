@@ -115,6 +115,9 @@ class ClusterState:
     # scheduler's mesh-sharded copy — can invalidate without sharing the
     # single-device cache's consume-on-read flag
     staging_gen: int = 0
+    # name → the Node object whose static fields row `name` reflects
+    # (strong refs: identity comparison is only safe while we hold them)
+    _row_node: dict = field(default_factory=dict)
 
     # -- index management -----------------------------------------------------
 
@@ -164,6 +167,7 @@ class ClusterState:
             if name not in schedulable_names:
                 idx = self.node_index.pop(name, None)
                 self.row_gen.pop(name, None)
+                self._row_node.pop(name, None)
                 if idx is not None:
                     self.arrays.valid[idx] = False
                     self.node_names[idx] = ""
@@ -172,14 +176,51 @@ class ClusterState:
         # the host iteration order (argmax tie-breaks then usually agree)
         dirty_writes = False
         for ni in snapshot.node_info_list:
-            if not full and self.row_gen.get(ni.name) == ni.generation:
+            prev_gen = self.row_gen.get(ni.name)
+            if not full and prev_gen == ni.generation:
                 continue
-            self._write_row(self._slot(ni.name), ni)
+            idx = self._slot(ni.name)
+            # fast path: the Node OBJECT is unchanged (labels/taints/
+            # capacity/images identical by identity — _row_node holds a
+            # strong ref so the id can't be recycled), so only the pod
+            # aggregates moved (assume/add/remove): rewrite those alone.
+            # This is the common per-drain case — every commit bumps its
+            # node's generation, and a full row rewrite costs ~7× the
+            # aggregate update.
+            if (not full and prev_gen is not None
+                    and self._row_node.get(ni.name) is ni.node):
+                self._write_row_aggregates(idx, ni)
+            else:
+                self._write_row(idx, ni)
+                self._row_node[ni.name] = ni.node
             self.row_gen[ni.name] = ni.generation
             dirty_writes = True
         if dirty_writes or full:
             self._device_dirty = True
             self.staging_gen += 1
+
+    def _write_row_aggregates(self, idx: int, ni: NodeInfo) -> None:
+        """Pod-aggregate-only row refresh (used/nonzero/npods/ports) —
+        valid only when the Node object itself is unchanged."""
+        a = self.arrays
+        used_row = self.rtable.vector(ni.requested)
+        if len(used_row) > a.used.shape[1]:
+            self._write_row(idx, ni)   # resource table grew: full path
+            return
+        a.used[idx, :len(used_row)] = used_row
+        a.used[idx, len(used_row):] = 0
+        a.nonzero_used[idx, 0] = ni.non_zero_cpu
+        a.nonzero_used[idx, 1] = ni.non_zero_mem
+        a.npods[idx] = len(ni.pods)
+        if ni.used_ports.ports or a.ports[idx, 0]:
+            port_ids = sorted({self.interner.port_id(p, pt)
+                               for (p, pt, _ip) in ni.used_ports.ports})
+            if len(port_ids) > self.dims.ports:
+                raise CapacityError(
+                    f"node {ni.name}: {len(port_ids)} ports > "
+                    f"{self.dims.ports}")
+            a.ports[idx] = 0
+            a.ports[idx, :len(port_ids)] = port_ids
 
     def _write_row(self, idx: int, ni: NodeInfo) -> None:
         a = self.arrays

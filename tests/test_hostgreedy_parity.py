@@ -1,0 +1,216 @@
+"""HostGreedy ↔ device-scan parity (ops/hostgreedy.py vs ops/program.py).
+
+The host greedy is the fast path for same-signature group runs; its
+contract is BIT-IDENTICAL assignments to the device scan (which is itself
+oracle-verified in test_groups_parity.py). The fuzz feeds both paths the
+same pre-populated clusters and identical pod runs across every group
+constraint family.
+"""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.ops.groups import to_device
+from kubernetes_tpu.ops.hostgreedy import HostGreedy
+from kubernetes_tpu.ops.program import (ScoreConfig, initial_carry,
+                                        pod_rows_from_batch, run_batch)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def scan_vs_greedy(nodes, existing, batch_pods, cfg=ScoreConfig()):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for pod, node_name in existing:
+        pod.spec.node_name = node_name
+        cache.add_pod(pod)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    builder = BatchBuilder(state)
+    batch = builder.build(batch_pods)
+    assert not batch.host_fallback.any()
+    sig = batch.sig[:len(batch_pods)]
+    assert (sig == sig[0]).all() and sig[0] != 0, "fuzz needs one signature"
+
+    gd_np, gc_np = builder.groups.build_dev(snap)
+    # scan
+    gd, gc = to_device(gd_np), to_device(gc_np)
+    na = state.device_arrays()
+    xs, table = pod_rows_from_batch(batch)
+    _, scan_out = run_batch(cfg, na, initial_carry(na, gc), xs, table,
+                            groups=gd)
+    scan_out = np.asarray(scan_out)[:len(batch_pods)]
+    # greedy — n_eff exercises the production node-axis slicing whenever
+    # the live node count is below the pow2 bucket
+    hg = HostGreedy(cfg, state.ensure_arrays(), builder.table,
+                    int(batch.tidx[0]), gd_np, gc_np,
+                    n_eff=len(state.node_names))
+    assert hg.ok
+    greedy_out = hg.run(len(batch_pods))
+    assert (scan_out == greedy_out).all(), (scan_out.tolist(),
+                                            greedy_out.tolist())
+    return greedy_out
+
+
+def _nodes(n, zones, cpu=16, seed_caps=None):
+    out = []
+    for i in range(n):
+        cap = cpu if seed_caps is None else seed_caps[i]
+        out.append(make_node(f"n{i}")
+                   .capacity({"cpu": cap, "memory": "32Gi", "pods": 40})
+                   .zone(f"z{i % zones}")
+                   .label(HOSTNAME, f"n{i}").obj())
+    return out
+
+
+class TestSpread:
+    def test_zone_do_not_schedule(self):
+        nodes = _nodes(9, zones=3)
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "a"})
+                .obj() for i in range(12)]
+        out = scan_vs_greedy(nodes, [], pods)
+        assert (out >= 0).all()
+
+    def test_zone_and_hostname(self):
+        nodes = _nodes(8, zones=4)
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "a"})
+                .spread_constraint(2, HOSTNAME, "ScheduleAnyway", {"app": "a"})
+                .obj() for i in range(16)]
+        scan_vs_greedy(nodes, [], pods)
+
+    def test_with_existing_pods(self):
+        nodes = _nodes(6, zones=3)
+        existing = [(make_pod(f"e{i}").req({"cpu": "2", "memory": "1Gi"})
+                     .label("app", "a").obj(), f"n{i % 3}")
+                    for i in range(5)]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .spread_constraint(2, ZONE, "DoNotSchedule", {"app": "a"})
+                .obj() for i in range(10)]
+        scan_vs_greedy(nodes, existing, pods)
+
+
+class TestInterPodAffinity:
+    def test_self_anti_affinity(self):
+        nodes = _nodes(8, zones=8)
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .pod_affinity(ZONE, {"app": "a"}, anti=True)
+                .obj() for i in range(10)]
+        out = scan_vs_greedy(nodes, [], pods)
+        # 8 zones → exactly 8 land, 2 fail
+        assert int((out >= 0).sum()) == 8
+
+    def test_required_affinity_escape_hatch(self):
+        """First pod of a series allows itself (filtering.go:381-397)."""
+        nodes = _nodes(6, zones=3)
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .pod_affinity(ZONE, {"app": "a"})
+                .obj() for i in range(6)]
+        out = scan_vs_greedy(nodes, [], pods)
+        assert (out >= 0).all()
+        # all pods co-locate in ONE zone (affinity to self-series)
+        zones = {int(out[i]) % 3 for i in range(6)}
+        assert len(zones) == 1
+
+    def test_preferred_affinity_scores(self):
+        nodes = _nodes(6, zones=3)
+        existing = [(make_pod("seed").req({"cpu": "1", "memory": "1Gi"})
+                     .label("app", "a").obj(), "n2")]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .preferred_pod_affinity(ZONE, {"app": "a"}, weight=10)
+                .obj() for i in range(4)]
+        scan_vs_greedy(nodes, existing, pods)
+
+    def test_anti_affinity_with_existing(self):
+        nodes = _nodes(6, zones=3)
+        existing = [(make_pod("e0").req({"cpu": "1", "memory": "1Gi"})
+                     .label("app", "a")
+                     .pod_affinity(ZONE, {"app": "a"}, anti=True)
+                     .obj(), "n0")]
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "a")
+                .pod_affinity(ZONE, {"app": "a"}, anti=True)
+                .obj() for i in range(4)]
+        out = scan_vs_greedy(nodes, existing, pods)
+        # z0 vetoed by the existing pod: 2 remaining zones fit
+        assert int((out >= 0).sum()) == 2
+
+
+class TestFuzz:
+    def test_randomized_clusters(self):
+        rng = random.Random(7)
+        for trial in range(12):
+            n = rng.randint(4, 12)
+            zones = rng.randint(2, 4)
+            caps = [rng.choice([4, 8, 16]) for _ in range(n)]
+            nodes = _nodes(n, zones=zones, seed_caps=caps)
+            existing = []
+            for i in range(rng.randint(0, 6)):
+                existing.append((
+                    make_pod(f"e{i}").req({"cpu": str(rng.randint(1, 3)),
+                                           "memory": "1Gi"})
+                    .label("app", rng.choice(["a", "b"])).obj(),
+                    f"n{rng.randrange(n)}"))
+            kind = rng.choice(["spread", "anti", "both"])
+            w = make_pod("proto").req({"cpu": "1", "memory": "1Gi"}) \
+                .label("app", "a")
+            if kind in ("spread", "both"):
+                w = w.spread_constraint(rng.choice([1, 2]), ZONE,
+                                        rng.choice(["DoNotSchedule",
+                                                    "ScheduleAnyway"]),
+                                        {"app": "a"})
+            if kind in ("anti", "both"):
+                w = w.pod_affinity(HOSTNAME, {"app": "a"}, anti=True)
+            proto = w.obj()
+            pods = []
+            for i in range(rng.randint(3, 14)):
+                import copy
+                p = copy.deepcopy(proto)
+                p.metadata.name = f"p{trial}-{i}"
+                p.metadata.uid = f"default/p{trial}-{i}"
+                pods.append(p)
+            scan_vs_greedy(nodes, existing, pods)
+
+
+class TestSchedulerIntegration:
+    def test_greedy_path_matches_scan_path_end_to_end(self):
+        """Same workload through two Schedulers — host greedy on vs off —
+        must produce identical binds."""
+        def build(greedy_on):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            if not greedy_on:
+                sched._try_host_greedy = lambda *a, **k: None
+            for i in range(9):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 8, "memory": "16Gi", "pods": 20})
+                    .zone(f"z{i % 3}").label(HOSTNAME, f"n{i}").obj())
+            for i in range(24):
+                api.create_pod(make_pod(f"p{i}")
+                               .req({"cpu": "1", "memory": "1Gi"})
+                               .label("app", "a")
+                               .spread_constraint(1, ZONE, "DoNotSchedule",
+                                                  {"app": "a"})
+                               .obj())
+            sched.schedule_pending()
+            return {uid: p.spec.node_name for uid, p in api.pods.items()}
+
+        assert build(True) == build(False)
