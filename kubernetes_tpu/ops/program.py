@@ -402,11 +402,17 @@ def _fit_scores(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow):
 
 
 def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
-                axis: str | None = None):
+                axis: str | None = None, overlay=None):
     """The full kernel set: everything SigCache caches, freshly computed.
     ports_mask folds into static_mask — pods eligible for the fast path
     carry no host ports (BatchBuilder gives them sig 0 otherwise), so the
-    cached value is vacuously true whenever it can be reused."""
+    cached value is vacuously true whenever it can be reused.
+
+    `overlay` = (ovl_used [N,R], ovl_npods [N]) or None: nominated
+    (preemptor) pods' resources folded into the FIT check only — the
+    with-nominated pass of RunFilterPluginsWithNominatedPods
+    (runtime/framework.go:1158); scoring stays overlay-free exactly like
+    the reference's prioritizeNodes, which never sees nominated pods."""
     m = na.valid
     m &= (pod.node_name_id == 0) | (na.name_id == pod.node_name_id)
     m &= ~na.unschedulable | pod.tolerates_unsched
@@ -416,14 +422,19 @@ def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     taint_raw = taint_prefer_count(na, pod)
     na_raw = preferred_affinity_score(na, pod)
     s_img = image_locality_score(na, pod, axis=axis)
-    fit_ok = fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods, pod.req)
+    if overlay is None:
+        fit_used, fit_npods = carry.used, carry.npods
+    else:
+        fit_used = carry.used + overlay[0]
+        fit_npods = carry.npods + overlay[1]
+    fit_ok = fit_mask(na.cap, fit_used, fit_npods, na.allowed_pods, pod.req)
     s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
     return m, taint_raw, na_raw, s_img, fit_ok, s_fit, s_bal
 
 
 def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
-                 best: jnp.ndarray, gate: jnp.ndarray, cache: SigCache
-                 ) -> SigCache:
+                 best: jnp.ndarray, gate: jnp.ndarray, cache: SigCache,
+                 overlay=None) -> SigCache:
     """Recompute fit_ok/s_fit/s_bal for the single row the placement touched
     (everything else in the cache is carry-independent)."""
     cols = jnp.array(cfg.score_cols, jnp.int32)
@@ -431,8 +442,11 @@ def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
     slots = jnp.array(cfg.nonzero_slot, jnp.int32)
     cap_row = na.cap[best]
     used_row = c2.used[best]
-    fit_ok_b = ((c2.npods[best] + 1 <= na.allowed_pods[best])
-                & jnp.all((pod.req == 0) | (used_row + pod.req <= cap_row)))
+    fit_used_row = used_row if overlay is None else used_row + overlay[0][best]
+    fit_npods = (c2.npods[best] if overlay is None
+                 else c2.npods[best] + overlay[1][best])
+    fit_ok_b = ((fit_npods + 1 <= na.allowed_pods[best])
+                & jnp.all((pod.req == 0) | (fit_used_row + pod.req <= cap_row)))
     cap_r = cap_row[cols][None, :]
     used_nz_r = c2.nonzero_used[best][slots] + pod.nonzero_req[slots]
     used_pl_r = used_row[cols] + pod.req[cols]
@@ -458,7 +472,7 @@ def _row_refresh(cfg: ScoreConfig, na: NodeArrays, c2: Carry, pod: PodRow,
 def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
               axis: str | None = None, groups: GroupsDev | None = None,
               tidx=None, n_global: int | None = None,
-              fam: GroupFamilies | None = None):
+              fam: GroupFamilies | None = None, overlay=None):
     """Feasibility + total score for one pod over all nodes → (mask, score,
     parts). Consults the signature cache: a pod whose sig matches the carry's
     reuses every carry-independent kernel (the expensive ones). Group kernels
@@ -472,7 +486,7 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
         use_fast,
         lambda: (cache.static_mask, cache.taint_raw, cache.na_raw,
                  cache.s_img, cache.fit_ok, cache.s_fit, cache.s_bal),
-        lambda: _slow_parts(cfg, na, carry, pod, axis=axis))
+        lambda: _slow_parts(cfg, na, carry, pod, axis=axis, overlay=overlay))
 
     feasible = m & fit_ok
     if groups is not None:
@@ -522,7 +536,7 @@ def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("cfg", "fam"))
 def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
               table: PodTableDev, groups: GroupsDev | None = None,
-              fam: GroupFamilies | None = None):
+              fam: GroupFamilies | None = None, overlay=None):
     """Scan the batch; returns (final carry, assignments int32[B] (-1 = none)).
 
     `groups` (with `carry.groups`) enables the PodTopologySpread /
@@ -537,13 +551,14 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
     def step(c: Carry, x: PodXs):
         pod = _gather_row(table, x)
         mask, score, parts = _eval_pod(cfg, na, c, pod, groups=groups,
-                                       tidx=x.tidx, fam=fam)
+                                       tidx=x.tidx, fam=fam, overlay=overlay)
         masked = jnp.where(mask, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)
         assigned = (masked[best] >= 0) & pod.valid
         c2 = _apply_assignment(c, pod, best, assigned)
         c2 = c2._replace(cache=_row_refresh(cfg, na, c2, pod, best,
-                                            assigned, parts))
+                                            assigned, parts,
+                                            overlay=overlay))
         if groups is not None:
             c2 = c2._replace(groups=group_update(
                 groups, c2.groups, x.tidx,
@@ -558,7 +573,8 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
 
 @functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
 def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
-                table: PodTableDev, n_actual, L: int, K: int, J: int):
+                table: PodTableDev, n_actual, L: int, K: int, J: int,
+                overlay=None):
     """Closed-form batch assignment for a run of SAME-SIGNATURE pods — the
     top-k trick of reference runtime/batch.go:97 (sortedNodes.Pop) taken to
     its TPU limit: the whole run becomes ONE top_k instead of L scan steps.
@@ -597,7 +613,8 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     (no host ports — the ports carry is untouched) and a lean carry
     (groups is None)."""
     pod = _gather_row(table, x)
-    feasible0, total0, parts = _eval_pod(cfg, na, carry, pod)
+    feasible0, total0, parts = _eval_pod(cfg, na, carry, pod,
+                                         overlay=overlay)
     masked0 = jnp.where(feasible0, total0, jnp.int64(-1))
     # scores are bounded by 100·Σweights — int32 keys keep TPU sorts cheap
     _, cand = lax.top_k(masked0.astype(jnp.int32), K)  # ties → lowest index
@@ -619,13 +636,16 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     # every device op is a 2-D [K, J] elementwise — no [K, J, C] tensors
     # with a tiny minor dim that would waste the 8×128 vector tiles.
     j1 = jnp.arange(1, J + 1, dtype=jnp.int64)[None, :]        # [1, J]
-    npods_kj = (carry.npods[cand][:, None]
+    fit_npods = (carry.npods if overlay is None
+                 else carry.npods + overlay[1])
+    fit_used = carry.used if overlay is None else carry.used + overlay[0]
+    npods_kj = (fit_npods[cand][:, None]
                 + j1.astype(carry.npods.dtype))
     fit_kj = npods_kj <= na.allowed_pods[cand][:, None]
     R = na.cap.shape[1]
     for r in range(R):
         cap_r = na.cap[cand, r][:, None]
-        used_r = carry.used[cand, r][:, None] + j1 * pod.req[r]
+        used_r = fit_used[cand, r][:, None] + j1 * pod.req[r]
         fit_kj &= (pod.req[r] == 0) | (used_r <= cap_r)
 
     # LeastAllocated / MostAllocated (least_allocated.go:30-60) unrolled

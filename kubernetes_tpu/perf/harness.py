@@ -163,6 +163,8 @@ def _pod_from_template(name: str, template: Optional[dict], seq: int = 0,
     w = make_pod(name)
     t = template or {}
     w = w.req({"cpu": t.get("cpu", "900m"), "memory": t.get("memory", "1Gi")})
+    if t.get("priority"):
+        w = w.priority(int(t["priority"]))
     for k, v in t.get("labels", {}).items():
         w = w.label(k, v)
     if t.get("nodeSelectorZone"):

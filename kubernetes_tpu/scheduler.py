@@ -202,6 +202,9 @@ class _PendingDrain:
     groups_needed: bool
     records: list = field(default_factory=list)
     dispatched_at: float = 0.0
+    # nominated-pod resource overlay active at dispatch (None = none);
+    # replays must reproduce the dispatch-time overlay
+    ovl: object = None
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -777,12 +780,16 @@ class Scheduler:
         self.state.ensure_arrays()
 
     def _schedule_batch(self, qpis: list[QueuedPodInfo]) -> int:
-        if self.queue.nominator.nominated_pods:
+        if (self.queue.nominator.nominated_pods
+                and not self._overlay_eligible(qpis)):
             # nominated (preemptor) pods change OTHER pods' filter results
-            # (two-pass RunFilterPluginsWithNominatedPods); the device
-            # program doesn't model nominations, so the host oracle takes
-            # over until they resolve — nominations are short-lived (victim
-            # deletes flush at the end of the previous cycle)
+            # (two-pass RunFilterPluginsWithNominatedPods). The device
+            # path models them as a fit-only resource OVERLAY; drains the
+            # overlay cannot represent exactly (host-port or
+            # lower-priority nominated pods, a nominated pod inside the
+            # drain itself, a sharded mesh) take the host oracle —
+            # nominations are short-lived (victim deletes flush at the
+            # end of the previous cycle)
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         # route per profile (profile.go:46 Map lookup): a drain can mix
@@ -947,22 +954,74 @@ class Scheduler:
             self._table_dev_version = segment_batch.table_version
         table = self._table_dev
         n = len(qpis)
+        ovl = None
+        if self.queue.nominator.nominated_pods:
+            # re-validate at the DISPATCH site: interleaved host-path
+            # scheduling (mixed drains, fallback segments) can nominate
+            # mid-batch, after _schedule_batch's entry check ran
+            if groups_needed or not self._overlay_eligible(qpis):
+                # groups: nominated pods' labels feed group counts, which
+                # the resource-only overlay cannot represent
+                self._drain_pending()
+                return sum(1 if self._schedule_one_host(q) else 0
+                           for q in qpis)
+            ovl = self._build_overlay(na)
         t0 = _time.perf_counter()
         with self.tracer.span("device_dispatch", pods=n,
                               groups=groups_needed):
             carry, records = self._dispatch_runs(
-                profile, na, carry, segment_batch, table, n, groups_needed)
+                profile, na, carry, segment_batch, table, n, groups_needed,
+                ovl=ovl)
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
-            dispatched_at=t0))
+            dispatched_at=t0, ovl=ovl))
         return 0
 
     # below this run length the scan's per-step cost beats the matrix setup
     UNIFORM_RUN_MIN = 16
+
+    def _overlay_eligible(self, qpis: list[QueuedPodInfo]) -> bool:
+        """True when the nominated pods' effect on this drain reduces to a
+        fit-only resource overlay (the reference adds nominated pods with
+        priority >= the incoming pod's to the NodeInfo,
+        runtime/framework.go:1183-1200): every nominated pod outranks every
+        drain pod, none carries host ports (the ports carry isn't
+        overlaid), and no drain pod IS a nominated pod (the reference
+        skips the pod's own nomination; the overlay can't)."""
+        if self.mesh is not None:
+            return False
+        nom = self.queue.nominator
+        max_prio = max(q.pod.spec.priority for q in qpis)
+        for qlist in nom.nominated_per_node.values():
+            for q in qlist:
+                if q.pod.spec.priority < max_prio:
+                    return False
+                for c in q.pod.spec.containers:
+                    for p in c.ports:
+                        if p.host_port > 0:
+                            return False
+        nominated = nom.nominated_pods
+        return not any(q.pod.uid in nominated for q in qpis)
+
+    def _build_overlay(self, na):
+        """(ovl_used [N,R], ovl_npods [N]) from the current nominations —
+        fresh per dispatch (nominations are few and short-lived)."""
+        N, R = na.used.shape
+        ovl_used = np.zeros((N, R), np.int64)
+        ovl_npods = np.zeros((N,), np.int32)
+        for node_name, qlist in self.queue.nominator.nominated_per_node.items():
+            idx = self.state.node_index.get(node_name)
+            if idx is None or idx >= N:
+                continue
+            for q in qlist:
+                vec = self.state.rtable.vector(q.pod_info.requests)
+                ovl_used[idx, :len(vec)] += vec
+                ovl_npods[idx] += 1
+        return (jnp.asarray(ovl_used), jnp.asarray(ovl_npods))
 
     def _try_host_greedy(self, qpis: list[QueuedPodInfo], profile: Profile,
                          batch) -> Optional[int]:
@@ -974,6 +1033,7 @@ class Scheduler:
         drain isn't eligible (caller continues on the device path)."""
         n = len(qpis)
         if (self.mesh is not None
+                or self.queue.nominator.nominated_pods
                 or not self.feature_gates.enabled("OpportunisticBatching")
                 or profile.score_config.strategy != "LeastAllocated"
                 or n < self.UNIFORM_RUN_MIN):
@@ -1062,7 +1122,7 @@ class Scheduler:
         return runs
 
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
-                       n: int, groups_needed: bool):
+                       n: int, groups_needed: bool, ovl=None):
         """Dispatch the drain through the fastest exact program with ZERO
         host synchronization — results stream back asynchronously and the
         carry chains device-side.
@@ -1085,7 +1145,8 @@ class Scheduler:
             spans = [(0, n, False)]
         else:
             spans = self._classify_runs(batch, n)
-        return self._dispatch_spans(cfg, na, batch, table, spans, carry)
+        return self._dispatch_spans(cfg, na, batch, table, spans, carry,
+                                    ovl=ovl)
 
     def _uniform_shape(self, na) -> tuple[int, int, int]:
         """(L, K, J) for run_uniform, chosen to be STABLE across drains:
@@ -1101,7 +1162,7 @@ class Scheduler:
         return L, K, J
 
     def _dispatch_spans(self, cfg: ScoreConfig, na, batch, table,
-                        spans, carry):
+                        spans, carry, ovl=None):
         """Dispatch the given (i, j, uniform) spans back-to-back, chaining
         the carry on device; issues async host copies so the tunnel
         transfer overlaps whatever the host does next."""
@@ -1111,12 +1172,12 @@ class Scheduler:
                 L, K, J = self._uniform_shape(na)
                 c2, packed = run_uniform(
                     cfg, na, carry, self._xone(batch, i), table,
-                    np.int32(j - i), L, K, J)
+                    np.int32(j - i), L, K, J, overlay=ovl)
                 records.append(_RunRec("uniform", i, j, carry, packed,
                                        L, J, True))
             else:
                 c2, assigns = self._scan_dispatch(cfg, na, carry, batch,
-                                                  i, j, table)
+                                                  i, j, table, ovl=ovl)
                 records.append(_RunRec("scan", i, j, carry, assigns))
             carry = c2
         for rec in records:
@@ -1159,15 +1220,17 @@ class Scheduler:
             if exact:
                 carry = self._uniform_escalate(cfg, pd.na, carry, pd.batch,
                                                rec.i, rec.j, pd.table, out,
-                                               rec.J)
+                                               rec.J, ovl=pd.ovl)
             else:
                 carry, a = self._scan_dispatch(cfg, pd.na, carry, pd.batch,
-                                               rec.i, rec.j, pd.table)
+                                               rec.i, rec.j, pd.table,
+                                               ovl=pd.ovl)
                 out[rec.i:rec.j] = np.asarray(a)[:m]
             # re-dispatch the rest of this drain ...
             spans = [(q.i, q.j, q.uniform) for q in pd.records[idx + 1:]]
             carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
-                                                   pd.table, spans, carry)
+                                                   pd.table, spans, carry,
+                                                   ovl=pd.ovl)
             pd.records[idx + 1:] = new_recs
             # ... and every later pending drain, against the new chain
             prev_profile = pd.profile
@@ -1178,7 +1241,7 @@ class Scheduler:
                     prev_profile = pd2.profile
                 carry, pd2.records = self._dispatch_runs(
                     pd2.profile, pd2.na, carry, pd2.batch, pd2.table,
-                    pd2.n, pd2.groups_needed)
+                    pd2.n, pd2.groups_needed, ovl=pd2.ovl)
             if self._device_carry is not None:
                 self._device_carry = carry
             idx += 1
@@ -1307,7 +1370,8 @@ class Scheduler:
                      tidx=np.int32(batch.tidx[i]))
 
     def _uniform_escalate(self, cfg: ScoreConfig, na, carry, batch,
-                          i: int, j: int, table, out, j_failed: int):
+                          i: int, j: int, table, out, j_failed: int,
+                          ovl=None):
         """Depth-J overflow recovery: retry the run with a deeper matrix
         (synchronous — this path is rare, and the only one that mints
         non-standard J shapes), falling back to the scan if even J=L+1
@@ -1318,19 +1382,21 @@ class Scheduler:
         while J < L + 1:
             J = min(8 * J, L + 1)
             c2, packed = run_uniform(cfg, na, carry, self._xone(batch, i),
-                                     table, np.int32(j - i), L, K, J)
+                                     table, np.int32(j - i), L, K, J,
+                                     overlay=ovl)
             r = np.asarray(packed)
             if r[L] and r[L + 1]:
                 out[i:j] = r[:j - i]
                 return c2
             if not r[L]:
                 break
-        carry, a = self._scan_dispatch(cfg, na, carry, batch, i, j, table)
+        carry, a = self._scan_dispatch(cfg, na, carry, batch, i, j, table,
+                                       ovl=ovl)
         out[i:j] = np.asarray(a)[:j - i]
         return carry
 
     def _scan_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
-                       j: int, table):
+                       j: int, table, ovl=None):
         """Dispatch run_batch over pods [i:j) padded to a pow2 bucket;
         returns (carry, device assignments) without synchronizing."""
         bucket = pow2_at_least(j - i)
@@ -1347,7 +1413,7 @@ class Scheduler:
             return run_batch_sharded(cfg, self.mesh, na, carry, xs, table,
                                      groups=self._gd_dev, fam=self._gd_fam)
         return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev,
-                         fam=self._gd_fam)
+                         fam=self._gd_fam, overlay=ovl)
 
     def reconcile(self) -> list:
         """Debug/divergence check (cache debugger analog): pull the resident
