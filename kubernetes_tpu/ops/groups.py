@@ -912,23 +912,39 @@ def to_device(tree):
 
 
 def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
-                     mgr: GroupManager, snapshot, lo: int, hi: int):
+                     mgr: GroupManager, snapshot, lo: int, hi: int,
+                     mesh=None):
     """Seed rows [lo, hi) into resident device group state: node-dependent
     tensors and counts scatter into the row slice; the small per-row scalars
     and pairwise matrices (which gained entries against OLD rows too) are
-    re-uploaded whole."""
+    re-uploaded whole.
+
+    With `mesh`, the resident tensors are node-axis sharded
+    (parallel/sharding.py): each update ships pre-sharded so the row
+    scatter stays an in-place per-shard write instead of forcing a
+    gather/reshard — the incremental path SURVEY §7.3 calls for, now
+    first-class under multi-chip."""
+    import jax
     import jax.numpy as jnp
 
     rows = range(lo, hi)
     U = gd_dev.spr_f_active.shape[0]   # device row axis (compact, pow2)
     nd = mgr.node_data(snapshot, rows)
     seeds = mgr.seed_counts(snapshot, rows)
-    gd_kw = {name: getattr(gd_dev, name).at[lo:hi].set(jnp.asarray(nd[name]))
+
+    def put(update, like):
+        if mesh is None:
+            return jnp.asarray(update)
+        return jax.device_put(update, like.sharding)
+
+    gd_kw = {name: getattr(gd_dev, name).at[lo:hi].set(
+                 put(nd[name], getattr(gd_dev, name)))
              for name in nd}
     for name in GroupManager._ROW_FIELDS:
-        gd_kw[name] = jnp.asarray(getattr(mgr, name)[:U])
+        gd_kw[name] = put(getattr(mgr, name)[:U], getattr(gd_dev, name))
     for name in GroupManager._PAIRWISE_FIELDS:
-        gd_kw[name] = jnp.asarray(getattr(mgr, name)[:U, :U])
-    gc_kw = {name: getattr(gc_dev, name).at[lo:hi].set(jnp.asarray(seeds[name]))
+        gd_kw[name] = put(getattr(mgr, name)[:U, :U], getattr(gd_dev, name))
+    gc_kw = {name: getattr(gc_dev, name).at[lo:hi].set(
+                 put(seeds[name], getattr(gc_dev, name)))
              for name in seeds}
     return gd_dev._replace(**gd_kw), gc_dev._replace(**gc_kw)

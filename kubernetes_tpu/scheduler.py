@@ -403,6 +403,7 @@ class Scheduler:
         self.unschedulable_count = 0
         self.error_count = 0
         self.device_batches = 0
+        self.host_greedy_runs = 0
         self.host_scheduled = 0
         self.preemption_attempts = 0
         # per-pod consecutive bind-error count → escalating error backoff
@@ -901,11 +902,7 @@ class Scheduler:
                 table_reset   # every signature id / group row invalidated
                 or carry.used.shape != na.used.shape
                 or groups_needed != (carry.groups is not None)
-                or (groups_needed and capacity != self._gd_capacity)
-                # sharded group tensors reseed whole: the in-place row
-                # scatter is a single-device optimization
-                or (groups_needed and self.mesh is not None
-                    and self.builder.table_used > self._seeded_rows)):
+                or (groups_needed and capacity != self._gd_capacity)):
             # structural change: reseed from the host snapshot
             carry = None
             self._drain_pending()
@@ -944,7 +941,8 @@ class Scheduler:
             self.cache.update_snapshot(self.snapshot)
             self._gd_dev, gcarry = scatter_new_rows(
                 self._gd_dev, carry.groups, self.builder.groups,
-                self.snapshot, self._seeded_rows, self.builder.table_used)
+                self.snapshot, self._seeded_rows, self.builder.table_used,
+                mesh=self.mesh)
             self._gd_fam = self.builder.groups.families(self.snapshot)
             carry = carry._replace(groups=gcarry)
             self._seeded_rows = self.builder.table_used
@@ -1032,8 +1030,10 @@ class Scheduler:
         formulas at ~40µs/step. Returns binds committed, or None when the
         drain isn't eligible (caller continues on the device path)."""
         n = len(qpis)
-        if (self.mesh is not None
-                or self.queue.nominator.nominated_pods
+        # mesh mode is NOT excluded: the greedy reads the full numpy
+        # staging arrays, which the host owns regardless of how the device
+        # copies are sharded; the post-run invalidation reseeds the shards
+        if (self.queue.nominator.nominated_pods
                 or not self.feature_gates.enabled("OpportunisticBatching")
                 or profile.score_config.strategy != "LeastAllocated"
                 or n < self.UNIFORM_RUN_MIN):
@@ -1067,6 +1067,7 @@ class Scheduler:
         # carry (if any) knows nothing of them
         self._invalidate_device_state()
         self.device_batches += 1
+        self.host_greedy_runs += 1
         self.metrics.device_batch_size.observe(n)
         self.metrics.device_batch_duration.observe(
             max(_time.perf_counter() - t0, 0.0))

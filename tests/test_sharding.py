@@ -246,3 +246,81 @@ def test_scheduler_mesh_mode_matches_single_device():
     sharded = run(make_mesh(4))
     assert single == sharded
     assert single[0] == 20
+
+
+def test_mesh_incremental_group_row_scatter():
+    """A NEW spread signature arriving while the sharded carry is resident
+    takes the incremental row scatter (ops/groups.py scatter_new_rows with
+    mesh) instead of a wholesale reseed; decisions must still match
+    single-device exactly."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    from kubernetes_tpu.backend.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+
+    def run(mesh):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=32, mesh=mesh)
+        for i in range(8):
+            api.create_node(make_node(f"n{i}")
+                            .capacity({"cpu": 16, "memory": "32Gi", "pods": 40})
+                            .zone(f"z{i % 2}")
+                            .label("kubernetes.io/hostname", f"n{i}").obj())
+        # wave 1: spread signature A mixed with plain pods (multi-sig →
+        # scan path, group tensors seeded)
+        for i in range(8):
+            w = make_pod(f"a{i}").req({"cpu": "500m", "memory": "512Mi"})
+            if i % 2 == 0:
+                w = w.label("app", "a").spread_constraint(
+                    2, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "a"})
+            api.create_pod(w.obj())
+        sched.schedule_pending()
+        # wave 2: NEW spread signature B while the carry is resident →
+        # incremental row scatter (sharded when mesh is set)
+        for i in range(8):
+            w = make_pod(f"b{i}").req({"cpu": "250m", "memory": "256Mi"})
+            if i % 2 == 0:
+                w = w.label("app", "b").spread_constraint(
+                    1, "kubernetes.io/hostname", "ScheduleAnyway",
+                    {"app": "b"})
+            api.create_pod(w.obj())
+        sched.schedule_pending()
+        assert sched.reconcile() == []
+        return {p.name: p.spec.node_name for p in api.pods.values()}
+
+    assert run(None) == run(make_mesh(4))
+
+
+def test_mesh_host_greedy_parity():
+    """The host greedy serves same-signature group drains under a mesh
+    too (the staging arrays are host-resident regardless of device
+    sharding); decisions match single-device."""
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough virtual devices")
+    from kubernetes_tpu.backend.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+
+    def run(mesh):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, mesh=mesh)
+        for i in range(8):
+            api.create_node(make_node(f"n{i}")
+                            .capacity({"cpu": 16, "memory": "32Gi", "pods": 40})
+                            .zone(f"z{i % 4}")
+                            .label("kubernetes.io/hostname", f"n{i}").obj())
+        for i in range(24):   # >= UNIFORM_RUN_MIN, single signature
+            api.create_pod(make_pod(f"p{i}")
+                           .req({"cpu": "500m", "memory": "512Mi"})
+                           .label("app", "s")
+                           .spread_constraint(1, "topology.kubernetes.io/zone",
+                                              "DoNotSchedule", {"app": "s"})
+                           .obj())
+        assert sched.schedule_pending() == 24
+        # the feature under test must actually engage — a silent fallback
+        # to the scan would make this parity check vacuous
+        assert sched.host_greedy_runs > 0
+        assert sched.reconcile() == []
+        return {p.name: p.spec.node_name for p in api.pods.values()}
+
+    assert run(None) == run(make_mesh(4))
