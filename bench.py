@@ -12,14 +12,14 @@ Prints ONE JSON line:
   TopologySpreading        ≥ 85   (topology_spreading/performance-config.yaml:20)
   SchedulingPodAntiAffinity ≥ 60  (affinity/performance-config.yaml:57-80)
 
-Compile exclusion: each workload runs TWICE in this process — the first
-(unmeasured) pass drives the scheduler through the exact same padded device
-shapes (node bucket, batch bucket, uniform-run L/K/J variants, group
-tensors), so every XLA executable the measured pass needs is already in the
-in-process cache. The measured pass then re-runs the workload on a fresh
-Scheduler/APIServer; a shape bucket compiled in pass one is a cache hit in
-pass two regardless of the new Scheduler instance (the reported
-warm_pass_s / measured_pass_s gap makes any residual compile visible).
+Compile exclusion: each workload first runs an UNMEASURED warm pass that
+drives the scheduler through the exact same padded device shapes (node
+bucket, batch bucket, uniform L/K/J variants, group tensors), so every XLA
+executable the measured passes need is already in the in-process cache.
+Then THREE measured passes run on fresh Scheduler/APIServer instances and
+the MEDIAN is reported (the tunneled device's per-execution latency
+jitters ±20% against sub-second windows); warm_pass_s records the
+cold-start compile cost separately.
 
 Each measured run also appends its full Prometheus exposition to
 `bench_metrics.prom` (the reference benchmark scrapes /metrics the same
@@ -141,19 +141,29 @@ def main() -> None:
         import gc
         gc.collect()
         gc.freeze()   # pin the warm pass's survivors out of future cycles
-        t0 = time.perf_counter()
-        got = run_config(cfg, case, workload, verbose=verbose,
-                         metrics_path="bench_metrics.prom")
-        measured_s = time.perf_counter() - t0
-        if not got:
-            raise SystemExit(f"workload {case}/{workload} not found")
-        item, _ = got[0]
+        # the measured window is sub-second while the tunneled device's
+        # per-execution latency jitters ±20%: report the MEDIAN of 3
+        # measured passes (each a full fresh-scheduler run) so the
+        # headline reflects the configuration, not one draw of the tunnel
+        passes = []
+        measured_s = 0.0
+        for _ in range(1 if small else 3):
+            t0 = time.perf_counter()
+            got = run_config(cfg, case, workload, verbose=verbose,
+                             metrics_path="bench_metrics.prom")
+            measured_s += time.perf_counter() - t0
+            if not got:
+                raise SystemExit(f"workload {case}/{workload} not found")
+            passes.append(got[0][0])
+        passes.sort(key=lambda it: it.average)
+        item = passes[len(passes) // 2]
         results[f"{case}_{workload}"] = {
             "value": round(item.average, 1),
             "vs_baseline": round(item.average / threshold, 2),
             "p50": round(item.perc50), "p95": round(item.perc95),
             "p99": round(item.perc99), "samples": item.samples,
             "pods": item.pods,
+            "passes": [round(it.average, 1) for it in passes],
             "warm_pass_s": round(warm_s, 1),      # cold-start incl. compiles
             "measured_pass_s": round(measured_s, 1),
         }

@@ -31,11 +31,14 @@ class NotFound(Exception):
 
 @dataclass
 class WatchHandlers:
-    """The informer event-handler triple (client-go ResourceEventHandler)."""
+    """The informer event-handler triple (client-go ResourceEventHandler).
+    `on_add_bulk` is an optional batch form consumed by create_pods —
+    semantically equivalent to per-pod on_add calls in order."""
 
     on_add: Optional[Callable] = None
     on_update: Optional[Callable] = None
     on_delete: Optional[Callable] = None
+    on_add_bulk: Optional[Callable] = None
 
 
 @dataclass
@@ -102,6 +105,24 @@ class APIServer:
                 h.on_add(pod)
         return pod
 
+    def create_pods(self, pods: list[Pod]) -> None:
+        """Bulk create: one store pass, then one fan-out pass per handler.
+        A handler exposing `on_add_bulk` receives the whole list (the
+        scheduler's ingest fast path); others get per-pod on_add."""
+        store = self.pods
+        for pod in pods:    # validate BEFORE inserting: a mid-batch
+            if pod.uid in store:   # Conflict must not strand stored pods
+                raise Conflict(f"pod {pod.uid} exists")  # unannounced
+        for pod in pods:
+            store[pod.uid] = pod
+        for h in self.pod_handlers:
+            bulk = getattr(h, "on_add_bulk", None)
+            if bulk is not None:
+                bulk(pods)
+            elif h.on_add:
+                for pod in pods:
+                    h.on_add(pod)
+
     def update_pod(self, pod: Pod) -> Pod:
         old = self.pods.get(pod.uid)
         if old is None:
@@ -147,18 +168,21 @@ class APIServer:
             if h.on_update:
                 h.on_update(old, new)
 
-    def bind_all(self, pods: list[Pod]) -> list[tuple[Pod, Exception]]:
-        """Bulk Binding subresource: each pod arrives with spec.node_name
-        already set (the scheduler's assumed copy). The stored object is
-        derived from `current` exactly like bind() — a client update that
-        landed after the scheduler drained the pod must survive the bind,
-        only nodeName/phase change. Store updates apply first, then
-        handlers fan out. Returns per-pod failures."""
+    def bind_all(self, pairs: list[tuple[Pod, Pod]]
+                 ) -> list[tuple[Pod, Exception]]:
+        """Bulk Binding subresource: (assumed pod with node set, the
+        original object it was derived from). When the stored object IS
+        that original (identity — the common case), no interleaved client
+        update can have landed and the assumed copy becomes the stored
+        object directly; otherwise the stored object is derived from
+        `current` exactly like bind(), so a post-drain update survives
+        with only nodeName/phase changing. Store updates apply first,
+        then handlers fan out. Returns per-pod failures."""
         failures: list[tuple[Pod, Exception]] = []
         updates: list[tuple[Pod, Pod]] = []
         store = self.pods
         nodes = self.nodes
-        for pod in pods:
+        for pod, original in pairs:
             uid = pod.uid
             current = store.get(uid)
             node_name = pod.spec.node_name
@@ -173,7 +197,7 @@ class APIServer:
             if node_name not in nodes:
                 failures.append((pod, NotFound(f"node {node_name}")))
                 continue
-            new = current.with_node_name(node_name)
+            new = pod if current is original else current.with_node_name(node_name)
             new.status.phase = "Running"
             store[uid] = new
             updates.append((current, new))

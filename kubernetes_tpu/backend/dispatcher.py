@@ -53,7 +53,8 @@ class APIDispatcher:
     on_bind_error: Optional[Callable[[Pod, str, Exception], None]] = None
     metrics: Optional[object] = None  # SchedulerMetrics (api_dispatcher_calls)
     _queue: dict[str, APICall] = field(default_factory=dict)  # uid → pending
-    _binds: list[Pod] = field(default_factory=list)  # bulk fast path (bound pods)
+    # bulk fast path: (bound pod, the original object it was derived from)
+    _binds: list[tuple[Pod, Pod]] = field(default_factory=list)
     executed: int = 0
     errors: int = 0
 
@@ -74,22 +75,24 @@ class APIDispatcher:
                     call.condition = pending.condition
         self._queue[uid] = call
 
-    def add_binds(self, pods: list) -> None:
-        """Bulk enqueue of bind calls for already-assumed pods (each pod
-        carries its node in spec.node_name). The hot path of the batch
-        commit: one list extend instead of B dict transactions."""
+    def add_binds(self, pairs: list) -> None:
+        """Bulk enqueue of bind calls: (assumed pod with node set, the
+        original object it was derived from). The hot path of the batch
+        commit: one list extend instead of B dict transactions. The
+        original lets bind_all prove by identity that no interleaved
+        update landed, and reuse the assumed copy as the stored object."""
         if self._queue:
             # a bind supersedes a pending patch — but never a DELETE,
             # which outranks it (same relevance ordering as add())
-            for p in pods:
-                pending = self._queue.get(p.uid)
+            for pair in pairs:
+                pending = self._queue.get(pair[0].uid)
                 if pending is not None:
                     if pending.call_type == CallType.DELETE:
                         continue
-                    del self._queue[p.uid]
-                self._binds.append(p)
+                    del self._queue[pair[0].uid]
+                self._binds.append(pair)
             return
-        self._binds.extend(pods)
+        self._binds.extend(pairs)
 
     def flush(self) -> int:
         """Execute all pending calls; returns count executed."""
@@ -102,7 +105,7 @@ class APIDispatcher:
                 failures = self.client.bind_all(binds)
             else:
                 failures = []
-                for p in binds:
+                for p, _orig in binds:
                     try:
                         self.client.bind(p, p.spec.node_name)
                     except Exception as e:

@@ -90,13 +90,16 @@ EVENT_FORCE_ACTIVATE = ClusterEvent(EventResource.WILDCARD, ActionType.ALL, "For
 # required affinity terms pre-parsed once at ingest)
 
 
-@dataclass
+@dataclass(slots=True)
 class PodInfo:
     pod: Pod
     # flattened request vectors, computed once
     requests: dict[str, int] = field(default_factory=dict)
     cpu_nonzero: int = 0
     mem_nonzero: int = 0
+    # lazy parse cache (interpodaffinity existing-anti fast path); slots
+    # forbid ad-hoc attributes, so the cache slot is declared here
+    _parsed_req_anti_affinity: Optional[tuple] = None
 
     @staticmethod
     def of(pod: Pod) -> "PodInfo":
@@ -119,7 +122,7 @@ class PodInfo:
 # QueuedPodInfo (reference types.go QueuedPodInfo)
 
 
-@dataclass
+@dataclass(slots=True)
 class QueuedPodInfo:
     pod_info: PodInfo
     timestamp: float = 0.0          # when added to queue (for queue-sort tie)

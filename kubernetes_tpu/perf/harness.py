@@ -270,12 +270,12 @@ class WorkloadRunner:
                     col.begin(sched.scheduled_count)
                 created = 0
                 create_batch = int(op.get("createBatch", self.create_batch))
-                create_pod = api.create_pod
+                make = factory.make
                 while created < count:
                     n = min(create_batch, count - created)
                     base = pod_seq + created
-                    for i in range(n):
-                        create_pod(factory.make(f"pod-{base + i}", base + i))
+                    api.create_pods([make(f"pod-{base + i}", base + i)
+                                     for i in range(n)])
                     created += n
                     # dispatch without waiting: the device results of this
                     # chunk commit while the next chunk is being created

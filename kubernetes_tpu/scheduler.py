@@ -557,7 +557,8 @@ class Scheduler:
             on_delete=self._on_node_delete))
         self.client.watch_pods(WatchHandlers(
             on_add=self._on_pod_add, on_update=self._on_pod_update,
-            on_delete=self._on_pod_delete))
+            on_delete=self._on_pod_delete,
+            on_add_bulk=self._on_pod_add_bulk))
         if hasattr(self.client, "watch_workloads"):
             self.client.watch_workloads(WatchHandlers(
                 on_add=self._on_workload_add))
@@ -610,6 +611,26 @@ class Scheduler:
                 ref = pod.spec.workload_ref
                 self.queue.retry_gated(
                     predicate=lambda p: p.spec.workload_ref == ref)
+
+    def _on_pod_add_bulk(self, pods: list[Pod]) -> None:
+        """Batch ingest (create_pods fan-out): plain unbound pods owned by
+        this scheduler take the queue's bulk add; anything else — bound,
+        gang-labeled, foreign schedulerName — falls back to the per-pod
+        path, preserving its semantics exactly."""
+        plain: list[Pod] = []
+        for pod in pods:
+            if (pod.spec.node_name or pod.spec.workload_ref
+                    or not self._responsible(pod)):
+                self._on_pod_add(pod)
+            else:
+                self.workload_manager.add_pod(pod)
+                plain.append(pod)
+        if plain:
+            n = self.queue.add_bulk(plain)
+            self.metrics.queue_incoming_pods.inc("active", "PodAdd",
+                                                 by=len(plain) - n)
+            if n:
+                self.metrics.queue_incoming_pods.inc("gated", "PodAdd", by=n)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
         self.workload_manager.update_pod(old, new)
@@ -735,8 +756,10 @@ class Scheduler:
                 # (each device execution costs ~100ms wall through the
                 # tunnel regardless of size — execution COUNT is the cost).
                 # Dispatch early only to fill an idle pipeline, and only
-                # once a minimum worth of pods is available.
-                if self._pending or qlen < max(self.batch_size // 4, 1):
+                # once half a drain is available: a lower bar fragments
+                # the workload into more executions than the latency they
+                # hide is worth.
+                if self._pending or qlen < max(self.batch_size // 2, 1):
                     break
             # device shapes are drain-size independent (uniform L comes
             # from batch_size, scan buckets from pow2 padding), so take
@@ -1328,7 +1351,7 @@ class Scheduler:
         nominated = self.queue.nominator.nominated_pods
         in_flight = self.queue.in_flight_pods
         now = self.clock()
-        bound_pods: list[Pod] = []
+        bound_pods: list[tuple[Pod, Pod]] = []
         sli_by_attempts: dict[int, list] = {}
         for qpi, node_name in pairs:
             pod = qpi.pod
@@ -1349,7 +1372,7 @@ class Scheduler:
             if nominated:
                 self.queue.nominator.delete(pod)
             in_flight.pop(uid, None)
-            bound_pods.append(assumed)
+            bound_pods.append((assumed, pod))
             sli_by_attempts.setdefault(qpi.attempts or 1, []).append(
                 now - (qpi.initial_attempt_timestamp or qpi.timestamp))
             if qpi.unschedulable_plugins:
