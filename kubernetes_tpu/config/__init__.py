@@ -110,8 +110,13 @@ class KubeSchedulerConfiguration:
 
     # -- round trip ----------------------------------------------------------
 
+    API_VERSION = "kubescheduler.config.k8s.io/v1"
+    KIND = "KubeSchedulerConfiguration"
+
     def to_dict(self) -> dict:
         return {
+            "apiVersion": self.API_VERSION,
+            "kind": self.KIND,
             "profiles": [{
                 "schedulerName": p.scheduler_name,
                 "plugins": {"enabled": list(p.plugins.enabled),
@@ -129,6 +134,18 @@ class KubeSchedulerConfiguration:
 
     @staticmethod
     def from_dict(d: dict) -> "KubeSchedulerConfiguration":
+        # versioned-scheme envelope (apis/config/scheme): tolerate its
+        # absence (internal form), reject a WRONG group/version — the
+        # failure mode strict decoding exists for
+        api_version = d.get("apiVersion")
+        if api_version is not None and api_version != \
+                KubeSchedulerConfiguration.API_VERSION:
+            raise ValueError(
+                f"unsupported apiVersion {api_version!r} (want "
+                f"{KubeSchedulerConfiguration.API_VERSION!r})")
+        kind = d.get("kind")
+        if kind is not None and kind != KubeSchedulerConfiguration.KIND:
+            raise ValueError(f"unsupported kind {kind!r}")
         profiles = [
             KubeSchedulerProfile(
                 scheduler_name=pd.get("schedulerName",

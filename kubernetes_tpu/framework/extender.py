@@ -44,6 +44,10 @@ class CallableExtender:
     weight: int = 1
     # bind(pod, node_name) → None (raises on failure)
     bind_fn: Optional[Callable] = None
+    # preempt(pod, {node: [victim pods]}) → reduced {node: [victim pods]}
+    # (extender.go ProcessPreemption: the extender drops nodes whose
+    # victims it refuses, or trims victim sets)
+    preempt_fn: Optional[Callable] = None
     ignorable: bool = False
 
     def is_filter(self) -> bool:
@@ -55,8 +59,14 @@ class CallableExtender:
     def is_binder(self) -> bool:
         return self.bind_fn is not None
 
+    def supports_preemption(self) -> bool:
+        return self.preempt_fn is not None
+
     def is_ignorable(self) -> bool:
         return self.ignorable
+
+    def process_preemption(self, pod: Pod, node_to_victims: dict):
+        return self.preempt_fn(pod, node_to_victims)
 
     def filter(self, pod: Pod, nodes: list[NodeInfo]):
         """→ (feasible, failed) or (feasible, failed, unresolvable)."""
@@ -81,6 +91,7 @@ class HTTPExtender:
     filter_verb: str = ""
     prioritize_verb: str = ""
     bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     ignorable: bool = False
     timeout_s: float = 5.0
@@ -99,8 +110,37 @@ class HTTPExtender:
     def is_binder(self) -> bool:
         return bool(self.bind_verb)
 
+    def supports_preemption(self) -> bool:
+        return bool(self.preempt_verb)
+
     def is_ignorable(self) -> bool:
         return self.ignorable
+
+    def process_preemption(self, pod: Pod, node_to_victims: dict
+                           ) -> dict:
+        """extender.go ProcessPreemption wire form: victims ship as pod
+        identifiers; the response keeps the accepted subset."""
+        payload = {
+            "Pod": {"name": pod.name, "namespace": pod.namespace,
+                    "uid": pod.uid},
+            "NodeNameToVictims": {
+                node: [{"name": v.pod.name, "uid": v.pod.uid}
+                       for v in victims]
+                for node, victims in node_to_victims.items()},
+        }
+        result = self._post(self.preempt_verb, payload)
+        if result.get("Error"):
+            raise RuntimeError(result["Error"])
+        accepted = result.get("NodeNameToVictims")
+        if accepted is None:
+            return node_to_victims
+        out = {}
+        for node, victims in node_to_victims.items():
+            if node not in accepted:
+                continue
+            keep = {v["uid"] for v in (accepted[node] or [])}
+            out[node] = [v for v in victims if v.pod.uid in keep]
+        return out
 
     def _post(self, verb: str, payload: dict) -> dict:
         req = urllib.request.Request(

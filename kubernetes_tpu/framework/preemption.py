@@ -51,7 +51,8 @@ class Evaluator:
                  min_candidate_nodes_percentage: int = 10,
                  min_candidate_nodes_absolute: int = 100,
                  is_delete_pending: Optional[Callable[[str], bool]] = None,
-                 pdb_lister: Optional[Callable[[], list]] = None):
+                 pdb_lister: Optional[Callable[[], list]] = None,
+                 extenders: tuple = ()):
         self.fwk = framework
         self.nominator = nominator
         self.min_pct = min_candidate_nodes_percentage
@@ -60,6 +61,9 @@ class Evaluator:
         # () → [PodDisruptionBudget] with fresh disruptionsAllowed; the
         # reference uses a PDB informer lister (preemption.go:700)
         self.pdb_lister = pdb_lister
+        # extenders with the preempt verb adjust/veto candidates
+        # (preemption.go:316 callExtenders)
+        self.extenders = tuple(extenders)
 
     # -- entry (preemption.go:268 Preempt) ------------------------------------
 
@@ -82,8 +86,41 @@ class Evaluator:
             return None, Status.unschedulable(
                 "no preemption victims found for incoming pod",
                 plugin="DefaultPreemption")
+        candidates = self.call_extenders(pod, candidates)
+        if not candidates:
+            return None, Status.unschedulable(
+                "no preemption candidates survived the extenders",
+                plugin="DefaultPreemption")
         best = self.pick_one_node(candidates)
         return best, Status.success()
+
+    def call_extenders(self, pod: Pod,
+                       candidates: list[Candidate]) -> list[Candidate]:
+        """preemption.go:316 callExtenders: each preemption-capable
+        extender sees {node: victims} and returns the accepted subset;
+        ignorable extender failures are skipped."""
+        exts = [e for e in self.extenders if e.supports_preemption()]
+        if not exts:
+            return candidates
+        by_node = {c.node_name: c for c in candidates}
+        victims = {c.node_name: list(c.victims) for c in candidates}
+        for ext in exts:
+            try:
+                victims = ext.process_preemption(pod, victims)
+            except Exception:
+                if ext.is_ignorable():
+                    continue
+                raise
+            if not victims:
+                return []
+        out = []
+        for node, vs in victims.items():
+            c = by_node.get(node)
+            if c is None:
+                continue
+            c.victims = list(vs)
+            out.append(c)
+        return out
 
     # -- eligibility (preemption.go:431) ---------------------------------------
 

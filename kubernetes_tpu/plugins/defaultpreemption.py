@@ -25,11 +25,12 @@ from ..framework.preemption import Evaluator
 
 class DefaultPreemption:
     def __init__(self, dispatcher=None, nominator=None, snapshot=None,
-                 pdb_lister=None):
+                 pdb_lister=None, extenders=()):
         self.dispatcher = dispatcher
         self.nominator = nominator
         self.snapshot = snapshot
         self.pdb_lister = pdb_lister
+        self.extenders = tuple(extenders)
         self._evaluator: Optional[Evaluator] = None
         self._fwk = None
 
@@ -44,7 +45,8 @@ class DefaultPreemption:
             fwk, nominator=self.nominator,
             is_delete_pending=(self.dispatcher.is_delete_pending
                                if self.dispatcher is not None else None),
-            pdb_lister=self.pdb_lister)
+            pdb_lister=self.pdb_lister,
+            extenders=self.extenders)
 
     def post_filter(self, state: CycleState, pod: Pod,
                     filtered_node_status_map) -> tuple[Optional[str], Status]:

@@ -394,6 +394,7 @@ class Scheduler:
             dp.snapshot = self.snapshot
             if hasattr(client, "list_pdbs"):
                 dp.pdb_lister = client.list_pdbs
+            dp.extenders = tuple(prof.extenders)
             dp.set_framework(fwk)
 
         self._register_event_handlers()

@@ -300,3 +300,61 @@ class TestDeviceOverlayUnderNomination:
         sched.schedule_pending()        # nominates at priority 10
         qpi_like = [type("Q", (), {"pod": make_pod("hi").priority(100).obj()})]
         assert not sched._overlay_eligible(qpi_like)
+
+
+class TestExtenderPreemptVerb:
+    """extender.go ProcessPreemption (:107-110) + preemption.go:316
+    callExtenders: a preemption-capable extender vetoes candidates."""
+
+    def test_extender_veto_changes_picked_node(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+        from kubernetes_tpu.scheduler import Profile, Scheduler
+        from kubernetes_tpu.scheduler import default_plugins
+        from kubernetes_tpu.framework.runtime import Framework
+
+        api = APIServer()
+        clock = FakeClock()
+        ext = CallableExtender(
+            name="veto-n0",
+            preempt_fn=lambda pod, victims: {
+                n: v for n, v in victims.items() if n != "n0"})
+        fwk = Framework("default-scheduler", default_plugins(api))
+        prof = Profile(framework=fwk, extenders=(ext,))
+        sched = Scheduler(api, profiles=[prof], batch_size=64, clock=clock)
+        for i in range(2):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        for i in range(2):
+            p = make_pod(f"low{i}").req({"cpu": "4", "memory": "1Gi"}).obj()
+            api.create_pod(p)
+            api.bind(p, f"n{i}")
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        # without the extender both nodes tie and n0 (first) wins; the
+        # preempt verb vetoes n0, so n1 must be nominated
+        assert api.pods["default/vip"].status.nominated_node_name == "n1"
+        assert "default/low1" not in api.pods
+        assert "default/low0" in api.pods
+
+    def test_extender_total_veto_blocks_preemption(self):
+        from kubernetes_tpu.framework.extender import CallableExtender
+        from kubernetes_tpu.framework.runtime import Framework
+        from kubernetes_tpu.scheduler import Profile, Scheduler, default_plugins
+
+        api = APIServer()
+        ext = CallableExtender(name="veto-all",
+                               preempt_fn=lambda pod, victims: {})
+        fwk = Framework("default-scheduler", default_plugins(api))
+        prof = Profile(framework=fwk, extenders=(ext,))
+        sched = Scheduler(api, profiles=[prof], batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        p = make_pod("low").req({"cpu": "4", "memory": "1Gi"}).obj()
+        api.create_pod(p)
+        api.bind(p, "n0")
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        assert api.pods["default/vip"].status.nominated_node_name == ""
+        assert "default/low" in api.pods
