@@ -639,11 +639,13 @@ class PodDisruptionBudget:
         return self.selector.matches(pod.metadata.labels)
 
 
-def _resolve_maybe_percent(value: int | str, total: int) -> int:
-    """IntOrString fields: "25%" rounds UP for maxUnavailable-style use in
-    the disruption controller; we follow GetScaledValueFromIntOrPercent
-    with round-up=False for minAvailable and the controller's defaults —
-    scoped here to round-down for both, documented divergence."""
+def _resolve_maybe_percent(value: int | str, total: int,
+                           round_up: bool = False) -> int:
+    """IntOrString fields (GetScaledValueFromIntOrPercent): the disruption
+    controller resolves percentage minAvailable with roundUp=true — a "50%"
+    of 3 pods protects 2 — while maxUnavailable keeps the floor. Callers
+    pick the direction."""
     if isinstance(value, str) and value.endswith("%"):
-        return int(value[:-1]) * total // 100
+        pct = int(value[:-1]) * total
+        return (pct + 99) // 100 if round_up else pct // 100
     return int(value)

@@ -386,7 +386,11 @@ class APIServer:
             expected = len(matched)
             healthy = sum(1 for p in matched if p.spec.node_name)
             if pdb.min_available is not None:
-                want = _resolve_maybe_percent(pdb.min_available, expected)
+                # percentage minAvailable rounds UP (the reference
+                # disruption controller's GetScaledValueFromIntOrPercent
+                # roundUp=true), so budgets are never overstated
+                want = _resolve_maybe_percent(pdb.min_available, expected,
+                                              round_up=True)
                 allowed = healthy - want
             elif pdb.max_unavailable is not None:
                 cap = _resolve_maybe_percent(pdb.max_unavailable, expected)

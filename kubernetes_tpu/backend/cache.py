@@ -344,8 +344,11 @@ class Cache:
         if update_all or self._tree_generation != snapshot.tree_generation:
             self._rebuild_lists(snapshot)
             snapshot.tree_generation = self._tree_generation
-        else:
-            # refresh references in the flat lists for dirty nodes
+        elif snapshot.dirty_nodes:
+            # refresh references in the flat lists for dirty nodes; the
+            # clean case must not walk the lists at all — update_snapshot
+            # runs once per scheduling failure, and a 5k-node walk per
+            # call was ~3s of a 200-preemptor wave
             for lst in (snapshot.node_info_list,
                         snapshot.have_pods_with_affinity_list,
                         snapshot.have_pods_with_required_anti_affinity_list):

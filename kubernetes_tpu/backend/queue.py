@@ -167,6 +167,10 @@ class Nominator:
     def __init__(self) -> None:
         self.nominated_pods: dict[str, str] = {}       # uid → node name
         self.nominated_per_node: dict[str, list[QueuedPodInfo]] = {}
+        # monotonic mutation counter: consumers that bake nominations into
+        # cached state (the scheduler's resident SigCache overlay) compare
+        # this to detect that their overlay went stale
+        self.version = 0
 
     def add(self, qpi: QueuedPodInfo, node_name: str = "") -> None:
         node = node_name or qpi.pod.status.nominated_node_name
@@ -175,11 +179,13 @@ class Nominator:
         self.delete(qpi.pod)
         self.nominated_pods[qpi.pod.uid] = node
         self.nominated_per_node.setdefault(node, []).append(qpi)
+        self.version += 1
 
     def delete(self, pod: Pod) -> None:
         node = self.nominated_pods.pop(pod.uid, None)
         if node is None:
             return
+        self.version += 1
         lst = self.nominated_per_node.get(node, [])
         self.nominated_per_node[node] = [q for q in lst if q.pod.uid != pod.uid]
         if not self.nominated_per_node[node]:

@@ -260,15 +260,15 @@ def default_registry(client=None):
     Factories construct a FRESH instance per call: plugin objects carry
     per-scheduler handles (gang Handle, volume reserved-PV sets), so
     sharing one instance across profiles or Scheduler instances would
-    cross their state."""
+    cross their state. Each registered factory builds exactly ONE plugin —
+    one throwaway instantiation per plugin here learns the names (name()
+    is an instance method), after which lookups are O(1) instead of the
+    former build-the-whole-default-list-per-lookup O(n²)."""
     from ..framework.runtime import Registry
-    from ..scheduler import default_plugins
+    from ..scheduler import default_plugin_factories
     reg = Registry()
-    for name in [p.name() for p in default_plugins(client)]:
-        def factory(_name=name):
-            return next(p for p in default_plugins(client)
-                        if p.name() == _name)
-        reg.register(name, factory)
+    for factory in default_plugin_factories(client):
+        reg.register(factory().name(), factory)
     return reg
 
 

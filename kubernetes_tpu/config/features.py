@@ -48,6 +48,10 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     "NodeDeclaredFeatures": FeatureSpec(True, ALPHA),
     # dynamicresources plugin (structured parameters)
     "DynamicResourceAllocation": FeatureSpec(True, BETA),
+    # batched device preemption dry-run (SURVEY §7 step 8): the Evaluator's
+    # per-candidate-node host sweep becomes one gathered kernel; off =
+    # the host loop (still PreFilter-hoisted) for every preemption
+    "BatchedPreemptionDryRun": FeatureSpec(True, BETA),
 }
 
 

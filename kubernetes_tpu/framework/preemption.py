@@ -44,15 +44,59 @@ class Candidate:
     num_pdb_violations: int = 0
 
 
+@dataclass
+class DeviceDryRunContext:
+    """Live handles for the batched device dry-run, wired by the Scheduler
+    (the analog of frameworkImpl threading snapshot/cache handles into the
+    Evaluator). `state` is the tensorized ClusterState, `builder` the pod
+    signature BatchBuilder, `snapshot` the host Snapshot the candidates
+    come from."""
+
+    state: object
+    builder: object
+    snapshot: object
+
+
+@dataclass
+class _DryRunPlan:
+    """Per-(preemptor signature, cluster state) tensors for the batched dry
+    run. A preemptor WAVE (the common shape: many identical-priority pods
+    failing against the same snapshot) reuses one plan — only the
+    nominated-pod overlay changes between preemptors, so the wave costs one
+    tensor build plus one kernel execution per preemptor."""
+
+    key: tuple
+    # per candidate, in `nodes` iteration order:
+    #   (node_info, victims-in-reprieve-order, violating-prefix-length)
+    cands: list
+    cand_idx: object          # i32 [Cp] node-row indices
+    cand_pos: dict            # node name → candidate position
+    victim_req: object        # i64 [Cp, Vp, R]
+    victim_valid: object      # bool [Cp, Vp]
+    spread: object            # groups.DryRunSpread | None
+    constraints: list         # spread DoNotSchedule constraints (host objs)
+    # overlay-FREE kernel results for every candidate (np bool [Cp, Vp+1]),
+    # computed once per wave: a preemptor only re-evaluates the rows its
+    # nomination overlay actually touches (a tiny gathered kernel), so the
+    # full-candidate kernel runs once per wave, not once per preemptor
+    base_packed: object = None
+
+
 class Evaluator:
     """preemption.go:100 — drives one preemption attempt for one pod."""
+
+    # victim-axis cap for the batched path: a node with more potential
+    # victims than this (≫ any realistic pods-per-node delta) falls back
+    # to the host loop rather than minting huge tensors
+    MAX_BATCHED_VICTIMS = 128
 
     def __init__(self, framework, nominator=None,
                  min_candidate_nodes_percentage: int = 10,
                  min_candidate_nodes_absolute: int = 100,
                  is_delete_pending: Optional[Callable[[str], bool]] = None,
                  pdb_lister: Optional[Callable[[], list]] = None,
-                 extenders: tuple = ()):
+                 extenders: tuple = (),
+                 device_ctx: Optional[DeviceDryRunContext] = None):
         self.fwk = framework
         self.nominator = nominator
         self.min_pct = min_candidate_nodes_percentage
@@ -64,6 +108,11 @@ class Evaluator:
         # extenders with the preempt verb adjust/veto candidates
         # (preemption.go:316 callExtenders)
         self.extenders = tuple(extenders)
+        # batched device dry-run wiring (None = host loop only)
+        self.device_ctx = device_ctx
+        self._plan_cache: Optional[_DryRunPlan] = None
+        self.batched_dry_runs = 0
+        self.host_dry_runs = 0
 
     # -- entry (preemption.go:268 Preempt) ------------------------------------
 
@@ -172,12 +221,31 @@ class Evaluator:
         """`nodes` are the preemption candidates; `all_nodes` the FULL
         snapshot list — PreFilter state (spread counts etc.) must be seeded
         over every node exactly like a real scheduling cycle, not over the
-        resolvable subset."""
+        resolvable subset.
+
+        Two tiers (SURVEY §7 step 8): the batched device dry-run evaluates
+        every candidate node in one gathered kernel (ops/program.py
+        dry_run_select_victims) and is exact for the eligible subset; the
+        host loop remains the oracle for everything else, with PreFilter
+        seeded ONCE and cloned per candidate (the reference clones
+        CycleState the same way, preemption.go:775)."""
         pdbs = self.pdb_lister() if self.pdb_lister is not None else []
+        all_nodes = all_nodes or nodes
+        batched = self._dry_run_batched(pod, nodes, num_candidates,
+                                        all_nodes, pdbs)
+        if batched is not None:
+            self.batched_dry_runs += 1
+            return batched
+        self.host_dry_runs += 1
+        seeded = CycleState()
+        _, status = self.fwk.run_pre_filter_plugins(seeded, pod, all_nodes)
+        if not status.is_success():
+            return []
         candidates: list[Candidate] = []
         for ni in nodes:
             victims, pdb_violations, ok = self.select_victims_on_node(
-                pod, ni, all_nodes=all_nodes or nodes, pdbs=pdbs)
+                pod, ni, all_nodes=all_nodes, pdbs=pdbs,
+                seeded_state=seeded)
             if ok:
                 candidates.append(Candidate(
                     node_name=ni.name, victims=victims,
@@ -186,16 +254,265 @@ class Evaluator:
                     break
         return candidates
 
+    # -- batched device dry run ------------------------------------------------
+
+    def _dry_run_batched(self, pod: Pod, nodes: list[NodeInfo],
+                         num_candidates: int, all_nodes: list[NodeInfo],
+                         pdbs: list) -> Optional[list[Candidate]]:
+        """One kernel execution instead of |candidates| host filter sweeps.
+        Returns the candidate list, or None when the case has no tensor
+        form (caller falls back to the host loop). Exactness boundary:
+
+        - preemptor: no host ports (sig 0), no pod (anti-)affinity, no
+          volumes/claims/declared-features (the builder row gate);
+          DoNotSchedule spread constraints ARE handled via victim count
+          tensors (ops/groups.py spread_dry_run_tensors);
+        - cluster: no existing pods with required anti-affinity (their
+          removal could lift a veto the kernel does not model);
+        - nominations: ≥-priority nominated pods become a fit-only
+          resource overlay; a nominated pod that would move the
+          preemptor's spread counts or add anti-affinity vetoes falls
+          back."""
+        ctx = self.device_ctx
+        if ctx is None:
+            return None
+        spec = pod.spec
+        aff = spec.affinity
+        if aff is not None and (aff.pod_affinity is not None
+                                or aff.pod_anti_affinity is not None):
+            return None
+        snapshot = ctx.snapshot
+        if snapshot is None or snapshot.have_pods_with_required_anti_affinity_list:
+            return None
+        ent = ctx.builder._lookup(pod)
+        if ent[0] != "row" or ent[1] == 0:
+            return None
+        u = ent[2]
+        # staging rows must mirror the snapshot the candidates came from
+        ctx.state.apply_snapshot(snapshot)
+        arrays = ctx.state.ensure_arrays()
+        R = arrays.used.shape[1]
+        plan = self._dry_run_plan(pod, nodes, all_nodes, pdbs, u, R, ctx)
+        if plan is None:
+            return None
+        if not plan.cands:
+            return []
+        ovl = self._dry_run_overlay(pod, plan, R, ctx)
+        if ovl is None:
+            return None
+        overrides = self._dry_run_overrides(pod, plan, ovl, R, u, ctx)
+        base = plan.base_packed
+        out: list[Candidate] = []
+        for c, (ni, ordered, nviol) in enumerate(plan.cands):
+            row = overrides.get(c)
+            if row is None:
+                row = base[c]
+            if not row[0]:
+                continue
+            victims = [pi for v, pi in enumerate(ordered)
+                       if not row[1 + v]]
+            violations = sum(1 for v in range(nviol) if not row[1 + v])
+            out.append(Candidate(node_name=ni.name, victims=victims,
+                                 num_pdb_violations=violations))
+            if len(out) >= num_candidates:
+                break
+        return out
+
+    def _dry_run_overrides(self, pod: Pod, plan: _DryRunPlan, ovl: dict,
+                           R: int, u: int, ctx) -> dict:
+        """Re-evaluate ONLY the overlay-touched candidate rows: gather
+        their slices out of the device-resident plan tensors and run the
+        kernel over the (tiny) subset. Returns {cand_pos: packed row}."""
+        if not ovl:
+            return {}
+        import jax.numpy as jnp
+        import numpy as np
+        from ..ops.program import dry_run_select_victims, pod_row_from_table
+        from ..state.tensorize import pow2_at_least
+
+        sub = np.fromiter(ovl.keys(), np.int64, count=len(ovl))
+        s = len(sub)
+        s_pad = pow2_at_least(s)
+        sub_pad = np.zeros((s_pad,), np.int64)   # pad repeats row 0;
+        sub_pad[:s] = sub                        # padded outputs ignored
+        sub_j = jnp.asarray(sub_pad)
+        ovl_used = np.zeros((s_pad, R), np.int64)
+        ovl_npods = np.zeros((s_pad,), np.int32)
+        for i, c in enumerate(sub):
+            vec, cnt = ovl[int(c)]
+            ovl_used[i] = vec
+            ovl_npods[i] = cnt
+        spread = plan.spread
+        if spread is not None:
+            spread = spread._replace(
+                tv_ok=spread.tv_ok[sub_j], cnt0=spread.cnt0[sub_j],
+                other_min=spread.other_min[sub_j],
+                vic_match=spread.vic_match[sub_j])
+        prow = pod_row_from_table(ctx.builder.table, u)
+        packed = np.asarray(dry_run_select_victims(
+            ctx.state.device_arrays(), prow, plan.cand_idx[sub_j],
+            plan.victim_req[sub_j], plan.victim_valid[sub_j],
+            ovl_used, ovl_npods, spread))
+        return {int(c): packed[i] for i, c in enumerate(sub)}
+
+    def _dry_run_plan(self, pod: Pod, nodes: list[NodeInfo],
+                      all_nodes: list[NodeInfo], pdbs: list, u: int,
+                      R: int, ctx) -> Optional[_DryRunPlan]:
+        """Build (or reuse) the wave plan: candidate rows, victim request
+        tensors in reprieve order, PDB partition, spread delta tensors."""
+        import numpy as np
+        from ..state.tensorize import pow2_at_least
+
+        prio = pod.spec.priority
+        # cheap wave key: snapshot generations cover node content, NodeInfo
+        # identities cover the resolvable-subset membership — no per-node
+        # tuple building on the per-preemptor path
+        key = (u, prio, R,
+               tuple((p.uid, p.disruptions_allowed) for p in pdbs),
+               id(self.device_ctx.snapshot),
+               self.device_ctx.snapshot.generation,
+               self.device_ctx.snapshot.tree_generation,
+               hash(tuple(map(id, nodes))))
+        cached = self._plan_cache
+        if cached is not None and cached.key == key:
+            return cached
+        # one PreFilter over ALL nodes — exactly the host seeding, run once
+        # per wave instead of once per candidate node
+        cs = CycleState()
+        _, status = self.fwk.run_pre_filter_plugins(cs, pod, all_nodes)
+        if not status.is_success():
+            plan = _DryRunPlan(key=key, cands=[], cand_idx=None,
+                               cand_pos={}, victim_req=None,
+                               victim_valid=None, spread=None,
+                               constraints=[])
+            self._plan_cache = plan
+            return plan
+        from ..plugins import podtopologyspread as pts_mod
+        spread_state = cs.read_or_none(pts_mod._PRE_FILTER_KEY)
+        constraints = list(spread_state.constraints) if spread_state else []
+
+        key_fn = lambda pi: (-pi.pod.spec.priority,
+                             pi.pod.metadata.creation_index)
+        cands = []
+        idxs = []
+        vmax = 0
+        for ni in nodes:
+            potential = [pi for pi in ni.pods
+                         if pi.pod.spec.priority < prio]
+            if not potential:
+                continue
+            idx = ctx.state.node_index.get(ni.name)
+            if idx is None:
+                return None   # staging out of sync: host path
+            violating, non_violating = self._filter_pods_with_pdb_violation(
+                potential, pdbs)
+            ordered = (sorted(violating, key=key_fn)
+                       + sorted(non_violating, key=key_fn))
+            cands.append((ni, ordered, len(violating)))
+            idxs.append(idx)
+            vmax = max(vmax, len(ordered))
+        if not cands:
+            plan = _DryRunPlan(key=key, cands=[], cand_idx=None,
+                               cand_pos={}, victim_req=None,
+                               victim_valid=None, spread=None,
+                               constraints=constraints)
+            self._plan_cache = plan
+            return plan
+        if vmax > self.MAX_BATCHED_VICTIMS:
+            return None
+        c_pad = pow2_at_least(len(cands))
+        v_pad = pow2_at_least(vmax)
+        cand_idx = np.zeros((c_pad,), np.int32)
+        cand_idx[:len(idxs)] = idxs
+        victim_req = np.zeros((c_pad, v_pad, R), np.int64)
+        victim_valid = np.zeros((c_pad, v_pad), bool)
+        for c, (_ni, ordered, _nv) in enumerate(cands):
+            for v, pi in enumerate(ordered):
+                vec = ctx.state.request_vector(pi.requests)
+                if vec is None:
+                    return None   # resource outside the staging table
+                victim_req[c, v] = vec
+                victim_valid[c, v] = True
+        spread = None
+        if constraints:
+            from ..ops.groups import spread_dry_run_tensors
+            spread = spread_dry_run_tensors(
+                spread_state, pod, [c[0] for c in cands],
+                [c[1] for c in cands], c_pad, v_pad)
+        # ship the wave-constant tensors to the device ONCE and run the
+        # full-candidate kernel overlay-free: every preemptor in the wave
+        # then pays only a tiny overlay-subset kernel
+        import jax.numpy as jnp
+        from ..ops.program import dry_run_select_victims, pod_row_from_table
+        plan = _DryRunPlan(
+            key=key, cands=cands, cand_idx=jnp.asarray(cand_idx),
+            cand_pos={ni.name: c for c, (ni, _o, _n) in enumerate(cands)},
+            victim_req=jnp.asarray(victim_req),
+            victim_valid=jnp.asarray(victim_valid),
+            spread=(None if spread is None
+                    else type(spread)(*(jnp.asarray(x) for x in spread))),
+            constraints=constraints)
+        prow = pod_row_from_table(ctx.builder.table, u)
+        plan.base_packed = np.asarray(dry_run_select_victims(
+            ctx.state.device_arrays(), prow, plan.cand_idx,
+            plan.victim_req, plan.victim_valid,
+            np.zeros((c_pad, R), np.int64), np.zeros((c_pad,), np.int32),
+            plan.spread))
+        self._plan_cache = plan
+        return plan
+
+    def _dry_run_overlay(self, pod: Pod, plan: _DryRunPlan, R: int, ctx):
+        """Nominated-pod overlay for the with-nominated filter pass
+        (runtime/framework.go:1158): ≥-priority nominations (self excluded)
+        fold their resources into the candidate rows. Returns a SPARSE
+        {cand_pos: [summed request vec, count]} map — nominations touch few
+        nodes, and only those rows deviate from the wave's base kernel
+        results — or None when a nomination has effects the overlay cannot
+        represent."""
+        out: dict = {}
+        nom = self.nominator
+        if nom is None or not nom.nominated_pods:
+            return out
+        for node_name, qlist in nom.nominated_per_node.items():
+            for q in qlist:
+                qpod = q.pod
+                if qpod.uid == pod.uid or qpod.spec.priority < pod.spec.priority:
+                    continue
+                qaff = qpod.spec.affinity
+                if (qaff is not None and qaff.pod_anti_affinity is not None
+                        and qaff.pod_anti_affinity.required):
+                    return None   # would add existing-anti vetoes
+                if (plan.spread is not None
+                        and qpod.namespace == pod.namespace
+                        and any(c.selector.matches(qpod.metadata.labels)
+                                for c in plan.constraints)):
+                    return None   # would move the preemptor's spread counts
+                c = plan.cand_pos.get(node_name)
+                if c is None:
+                    continue
+                vec = ctx.state.request_vector(q.pod_info.requests)
+                if vec is None:
+                    return None
+                cur = out.get(c)
+                if cur is None:
+                    out[c] = [vec, 1]   # request_vector returns a fresh row
+                else:
+                    cur[0] += vec
+                    cur[1] += 1
+        return out
+
     def select_victims_on_node(self, pod: Pod, node_info: NodeInfo,
                                all_nodes: list[NodeInfo],
-                               pdbs: Optional[list] = None
+                               pdbs: Optional[list] = None,
+                               seeded_state: Optional[CycleState] = None
                                ) -> tuple[list[PodInfo], int, bool]:
         """default_preemption.go:583. Returns (victims, pdbViolations, fits).
 
-        Simulation runs on a structural copy of the NodeInfo and a FRESH
-        CycleState re-seeded by PreFilter (the reference clones CycleState;
-        re-running PreFilter yields the same plugin state without requiring
-        every plugin's state object to implement Clone). The cheap
+        Simulation runs on a structural copy of the NodeInfo and a CLONE of
+        the seeded CycleState (the reference clones CycleState the same
+        way; plugin states that AddPod/RemovePod mutate — spread, inter-pod
+        affinity, volumes, DRA — all implement clone()). Callers that don't
+        pass `seeded_state` pay a fresh PreFilter per call. The cheap
         potential-victims check runs FIRST so nodes with nothing to preempt
         — the common case when a full cluster rejects a default-priority
         pod — cost no PreFilter work."""
@@ -206,10 +523,13 @@ class Evaluator:
         # the clone shares the immutable PodInfo objects: `potential` stays
         # valid against it
         ni = node_info.snapshot_clone()
-        state = CycleState()
-        _, status = self.fwk.run_pre_filter_plugins(state, pod, all_nodes)
-        if not status.is_success():
-            return [], 0, False
+        if seeded_state is not None:
+            state = seeded_state.clone()
+        else:
+            state = CycleState()
+            _, status = self.fwk.run_pre_filter_plugins(state, pod, all_nodes)
+            if not status.is_success():
+                return [], 0, False
         for pi in potential:
             self._remove_pod(state, pod, pi, ni)
         # preemptor must fit with ALL lower-priority pods gone
@@ -243,21 +563,24 @@ class Evaluator:
                                         ) -> tuple[list[PodInfo], list[PodInfo]]:
         """preemption.go filterPodsWithPDBViolation: a pod is 'violating'
         if evicting it would push some matching PDB past its
-        disruptionsAllowed budget, accounting for earlier pods in this
-        call consuming the same budgets."""
+        disruptionsAllowed budget. Exactly like the reference, EVERY
+        matching PDB's budget is decremented for EVERY pod — including
+        pods already classified violating — so with multi-PDB pods a
+        violating pod still consumes the budgets of its other PDBs."""
         if not pdbs:
             return [], list(pods)
         remaining = {id(pdb): pdb.disruptions_allowed for pdb in pdbs}
         violating: list[PodInfo] = []
         non_violating: list[PodInfo] = []
         for pi in pods:
-            matching = [pdb for pdb in pdbs if pdb.matches(pi.pod)]
-            if any(remaining[id(pdb)] <= 0 for pdb in matching):
-                violating.append(pi)
-            else:
-                for pdb in matching:
-                    remaining[id(pdb)] -= 1
-                non_violating.append(pi)
+            violates = False
+            for pdb in pdbs:
+                if not pdb.matches(pi.pod):
+                    continue
+                remaining[id(pdb)] -= 1
+                if remaining[id(pdb)] < 0:
+                    violates = True
+            (violating if violates else non_violating).append(pi)
         return violating, non_violating
 
     def _fits(self, state: CycleState, pod: Pod, ni: NodeInfo) -> bool:

@@ -36,8 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.groups import (GroupCarry, GroupFamilies, GroupsDev, group_mask,
-                          group_scores, group_update)
+from ..ops.groups import (DryRunSpread, GroupCarry, GroupFamilies, GroupsDev,
+                          group_mask, group_scores, group_update)
 from ..state.batch import (OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN,
                            OP_LT, OP_NOT_IN, TOL_EQUAL, TOL_EXISTS)
 from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
@@ -334,6 +334,12 @@ class PodXs(NamedTuple):
     valid: jnp.ndarray   # bool [B]
     sig: jnp.ndarray     # i32 [B]
     tidx: jnp.ndarray    # i32 [B] — row into PodTableDev
+    # node row of the pod's OWN pending nomination (-1 = none): the overlay
+    # must exclude the pod's own nominated resources exactly like the
+    # reference two-pass skips the pod's own entry
+    # (runtime/framework.go:1183). Nominated pods carry sig 0 so the
+    # signature cache neither serves nor stores their per-pod fit.
+    nom_idx: jnp.ndarray = None
 
 
 class PodRow(NamedTuple):
@@ -365,12 +371,13 @@ class PodRow(NamedTuple):
     skip_balanced: jnp.ndarray
     img_ids: jnp.ndarray
     img_containers: jnp.ndarray
+    nom_idx: jnp.ndarray = None   # see PodXs.nom_idx
 
 
 def _gather_row(table: PodTableDev, x) -> PodRow:
     fields = {name: getattr(table, name)[x.tidx]
               for name in PodTableDev._fields}
-    return PodRow(valid=x.valid, sig=x.sig, **fields)
+    return PodRow(valid=x.valid, sig=x.sig, nom_idx=x.nom_idx, **fields)
 
 
 def table_from_batch(batch) -> PodTableDev:
@@ -425,6 +432,9 @@ def _slow_parts(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     if overlay is None:
         fit_used, fit_npods = carry.used, carry.npods
     else:
+        # NOTE: no per-pod self-exclusion here — the cached fit_ok must be
+        # signature-pure so same-sig pods with different nominations share
+        # it; _eval_pod applies the one-row exclusion delta on top
         fit_used = carry.used + overlay[0]
         fit_npods = carry.npods + overlay[1]
     fit_ok = fit_mask(na.cap, fit_used, fit_npods, na.allowed_pods, pod.req)
@@ -488,7 +498,23 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
                  cache.s_img, cache.fit_ok, cache.s_fit, cache.s_bal),
         lambda: _slow_parts(cfg, na, carry, pod, axis=axis, overlay=overlay))
 
-    feasible = m & fit_ok
+    fit_ok_eff = fit_ok
+    if overlay is not None and pod.nom_idx is not None:
+        # per-pod self-exclusion delta (framework.go:1183 skips the pod's
+        # own nomination): recompute fit at the ONE row the pod's own
+        # nomination occupies, minus its own contribution. Applied to the
+        # EFFECTIVE mask only — the cached fit_ok stays signature-pure so
+        # same-sig pods with different nominations share the fast path.
+        safe = jnp.maximum(pod.nom_idx, 0)
+        own_used = carry.used[safe] + overlay[0][safe] - pod.req
+        own_npods = carry.npods[safe] + overlay[1][safe] - 1
+        own_fit = ((own_npods + 1 <= na.allowed_pods[safe])
+                   & jnp.all((pod.req == 0)
+                             | (own_used + pod.req <= na.cap[safe])))
+        fit_ok_eff = fit_ok.at[safe].set(
+            jnp.where(pod.nom_idx >= 0, own_fit, fit_ok[safe]))
+
+    feasible = m & fit_ok_eff
     if groups is not None:
         # fold in BEFORE normalization: the host runtime normalizes over the
         # fully-filtered node list, so a group-filtered node must not set the
@@ -547,27 +573,39 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
     compute (≈5-8× per step on TPU); see groups.GroupFamilies."""
 
     n = na.npods.shape[0]
+    consume_nom = overlay is not None and pods.nom_idx is not None
 
-    def step(c: Carry, x: PodXs):
+    def step(state, x: PodXs):
+        c, ovl = state
         pod = _gather_row(table, x)
         mask, score, parts = _eval_pod(cfg, na, c, pod, groups=groups,
-                                       tidx=x.tidx, fam=fam, overlay=overlay)
+                                       tidx=x.tidx, fam=fam, overlay=ovl)
         masked = jnp.where(mask, score, -1)
         best = jnp.argmax(masked).astype(jnp.int32)
         assigned = (masked[best] >= 0) & pod.valid
         c2 = _apply_assignment(c, pod, best, assigned)
+        if consume_nom:
+            # a bound pod's nomination is deleted (the commit calls
+            # nominator.delete): consume its contribution so later pods in
+            # the scan see the same overlay the host sequential path would
+            safe = jnp.maximum(pod.nom_idx, 0)
+            gate = assigned & (pod.nom_idx >= 0)
+            ovl = (ovl[0].at[safe].add(
+                       jnp.where(gate, -pod.req, 0)),
+                   ovl[1].at[safe].add(
+                       jnp.where(gate, -1, 0).astype(ovl[1].dtype)))
         c2 = c2._replace(cache=_row_refresh(cfg, na, c2, pod, best,
                                             assigned, parts,
-                                            overlay=overlay))
+                                            overlay=ovl))
         if groups is not None:
             c2 = c2._replace(groups=group_update(
                 groups, c2.groups, x.tidx,
                 pick=lambda arr: arr[..., best],
                 is_chosen=jnp.arange(n, dtype=jnp.int32) == best,
                 gate=assigned, fam=fam))
-        return c2, jnp.where(assigned, best, -1)
+        return (c2, ovl), jnp.where(assigned, best, -1)
 
-    final, assignments = lax.scan(step, carry, pods)
+    (final, _ovl), assignments = lax.scan(step, (carry, overlay), pods)
     return final, assignments
 
 
@@ -750,6 +788,110 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
         assignments,
         jnp.stack([mono_ok & norm_ok, depth_ok]).astype(jnp.int32)])
     return new_carry, packed
+
+
+# ---------------------------------------------------------------------------
+# preemption dry-run kernel family (preemption.go:775 DryRunPreemption,
+# SURVEY §7 step 8): the per-candidate-node host loop becomes one gathered
+# program over the candidate axis
+
+
+def pod_row_from_table(table, u: int, sig: int = 0) -> PodRow:
+    """One signature row of a (numpy) PodTable as the kernels' PodRow."""
+    import numpy as np
+    fields = {name: getattr(table, name)[u] for name in PodTableDev._fields}
+    return PodRow(valid=np.bool_(True), sig=np.int32(sig), **fields)
+
+
+def _dry_run_spread_ok(sp: DryRunSpread, removed):
+    """Spread feasibility for the preemptor on every candidate, given
+    `removed` i32 [C, SC] matching victims currently removed. Mirrors the
+    host filter (podtopologyspread.py filter): missing key → infeasible;
+    matchNum + selfMatch − min > maxSkew → infeasible, with the
+    criticalPaths closed form min(x, other) (groups.spread_dry_run_tensors)
+    and the minDomains zero-floor."""
+    x = sp.cnt0 - removed
+    min_eff = jnp.where(sp.min_zero[None, :], 0,
+                        jnp.minimum(x, sp.other_min))
+    ok = x + sp.self_match[None, :] - min_eff <= sp.max_skew[None, :]
+    return jnp.all(sp.tv_ok & ok, axis=1)
+
+
+@jax.jit
+def dry_run_select_victims(na: NodeArrays, pod: PodRow, cand,
+                           victim_req, victim_valid, ovl_used, ovl_npods,
+                           spread: DryRunSpread | None = None):
+    """Batched select_victims_on_node (default_preemption.go:583) over the
+    candidate-node axis.
+
+    cand         i32 [C]      node-row indices into `na` (padding repeats a
+                              real row; the caller ignores padded outputs)
+    victim_req   i64 [C,V,R]  potential victims' request vectors, REPRIEVE
+                              order (PDB-violating first, then by priority
+                              desc / creation asc — built host-side)
+    victim_valid bool [C,V]
+    ovl_used     i64 [C,R]    nominated-pod resources (the two-pass
+    ovl_npods    i32 [C]      RunFilterPluginsWithNominatedPods overlay:
+                              only ≥-priority nominations, self excluded)
+    spread       victim count tensors when the preemptor carries
+                 DoNotSchedule spread constraints (groups.DryRunSpread)
+
+    Returns bool [C, V+1]: column 0 = the preemptor fits with every victim
+    removed (candidate viable); column 1+v = victim v was reprieved (added
+    back most-important-first while the preemptor still fits). The caller
+    must only pass preemptors without host ports (the ports carry is not
+    simulated), without pod (anti-)affinity, and on clusters without
+    existing required-anti-affinity pods — everything else is exact.
+
+    Monotonicity argument for the overlay: the host runs the filter twice
+    (with and without nominated pods); resources and spread counts are
+    additive, so with-nominated feasibility implies without-nominated —
+    one overlaid pass is exact for the eligible subset."""
+    na_c = NodeArrays(*(x[cand] for x in na))
+    m = na_c.valid
+    m &= (pod.node_name_id == 0) | (na_c.name_id == pod.node_name_id)
+    m &= ~na_c.unschedulable | pod.tolerates_unsched
+    m &= taint_filter_mask(na_c, pod)
+    m &= selector_mask(na_c, pod)
+    nv = jnp.sum(victim_valid, axis=1).astype(na_c.npods.dtype)
+    total_req = jnp.sum(jnp.where(victim_valid[:, :, None], victim_req, 0),
+                        axis=1)
+    base_used = na_c.used + ovl_used - total_req
+    base_npods = na_c.npods + ovl_npods - nv
+    fits = m & fit_mask(na_c.cap, base_used, base_npods, na_c.allowed_pods,
+                        pod.req)
+    if spread is not None:
+        vm = spread.vic_match.astype(jnp.int32)          # [C, V, SC]
+        rm0 = jnp.sum(jnp.where(victim_valid[:, :, None], vm, 0), axis=1)
+        fits &= _dry_run_spread_ok(spread, rm0)
+        xs = (jnp.swapaxes(victim_req, 0, 1), victim_valid.T,
+              jnp.swapaxes(vm, 0, 1))
+        removed0 = rm0
+    else:
+        xs = (jnp.swapaxes(victim_req, 0, 1), victim_valid.T,
+              jnp.zeros((victim_valid.shape[1], victim_valid.shape[0], 0),
+                        jnp.int32))
+        removed0 = jnp.zeros((victim_valid.shape[0], 0), jnp.int32)
+
+    def step(carry, x):
+        used, npods, removed = carry
+        req_v, valid_v, match_v = x
+        t_used = used + req_v
+        t_npods = npods + 1
+        ok = valid_v & (t_npods + 1 <= na_c.allowed_pods)
+        ok &= jnp.all((pod.req[None, :] == 0)
+                      | (t_used + pod.req[None, :] <= na_c.cap), axis=1)
+        t_removed = removed - match_v
+        if spread is not None:
+            ok &= _dry_run_spread_ok(spread, t_removed)
+        used = jnp.where(ok[:, None], t_used, used)
+        npods = jnp.where(ok, t_npods, npods)
+        removed = jnp.where(ok[:, None], t_removed, removed)
+        return (used, npods, removed), ok
+
+    carry0 = (base_used, base_npods, removed0)
+    _, reprieved = lax.scan(step, carry0, xs)
+    return jnp.concatenate([fits[:, None], reprieved.T], axis=1)
 
 
 def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:

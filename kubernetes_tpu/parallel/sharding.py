@@ -150,7 +150,9 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
     n_global = na.cap.shape[0]
     node_sharded_na = NodeArrays(*(P(NODE_AXIS) for _ in na))
     node_sharded_carry = _carry_spec(carry)
-    replicated_pods = PodXs(*(P() for _ in pods))
+    # optional leaves (nom_idx=None — overlays are single-device-only)
+    # keep their None spec: a P() over a None leaf breaks tree matching
+    replicated_pods = PodXs(*(P() if x is not None else None for x in pods))
     replicated_table = PodTableDev(*(P() for _ in table))
     groups_spec = (_last_axis_spec(groups, _GD_NODE_FIELDS)
                    if groups is not None else None)

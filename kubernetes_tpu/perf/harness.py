@@ -88,6 +88,10 @@ class DataItem:
     pods: int = 0
     duration_s: float = 0.0
     samples: int = 0        # rate windows behind the percentiles
+    # per-op wall times for the WHOLE workload run, as ("opcode[i]", s)
+    # pairs — lets bench.py report phases OUTSIDE the measured window
+    # (e.g. PreemptionChurn's preemptor wave) without widening it
+    op_seconds: list = field(default_factory=list)
 
 
 class ThroughputCollector:
@@ -248,8 +252,10 @@ class WorkloadRunner:
         items: list[DataItem] = []
         node_seq = 0
         pod_seq = 0
-        for op in tc.workload_template:
+        op_times: list[tuple[str, float]] = []
+        for op_i, op in enumerate(tc.workload_template):
             code = op["opcode"]
+            t_op = time.perf_counter()
             if code == "createNodes":
                 count = int(_resolve(op, "count", params))
                 _make_nodes(api, count, node_seq, params)
@@ -323,6 +329,10 @@ class WorkloadRunner:
                 time.sleep(float(op.get("duration", op.get("seconds", 0.1))))
             else:
                 raise ValueError(f"unknown opcode {code}")
+            op_times.append((f"{code}[{op_i}]", time.perf_counter() - t_op))
+        self.last_op_seconds = op_times
+        for item in items:
+            item.op_seconds = list(op_times)
         return items
 
 

@@ -25,12 +25,15 @@ from ..framework.preemption import Evaluator
 
 class DefaultPreemption:
     def __init__(self, dispatcher=None, nominator=None, snapshot=None,
-                 pdb_lister=None, extenders=()):
+                 pdb_lister=None, extenders=(), device_ctx=None):
         self.dispatcher = dispatcher
         self.nominator = nominator
         self.snapshot = snapshot
         self.pdb_lister = pdb_lister
         self.extenders = tuple(extenders)
+        # framework.preemption.DeviceDryRunContext — enables the batched
+        # device dry-run (SURVEY §7 step 8); None keeps the host loop
+        self.device_ctx = device_ctx
         self._evaluator: Optional[Evaluator] = None
         self._fwk = None
 
@@ -46,7 +49,8 @@ class DefaultPreemption:
             is_delete_pending=(self.dispatcher.is_delete_pending
                                if self.dispatcher is not None else None),
             pdb_lister=self.pdb_lister,
-            extenders=self.extenders)
+            extenders=self.extenders,
+            device_ctx=self.device_ctx)
 
     def post_filter(self, state: CycleState, pod: Pod,
                     filtered_node_status_map) -> tuple[Optional[str], Status]:

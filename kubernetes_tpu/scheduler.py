@@ -109,7 +109,13 @@ DEFAULT_WEIGHTS = {
 }
 
 
-def default_plugins(client=None, ns_lister=None) -> list:
+def default_plugin_factories(client=None, ns_lister=None) -> list:
+    """Ordered ZERO-ARG factories for the default plugin set. Each factory
+    call constructs ONE fresh plugin (plugin objects carry per-scheduler
+    handles, so instances must never be shared across profiles), without
+    building the whole list — config.default_registry previously rebuilt
+    the full default_plugins list per lookup (O(n²) across a registry
+    walk)."""
     from .plugins.defaultbinder import DefaultBinder
     from .plugins.gangscheduling import GangScheduling
     from .plugins.volume_basics import (NodeVolumeLimits, VolumeRestrictions,
@@ -118,19 +124,27 @@ def default_plugins(client=None, ns_lister=None) -> list:
     from .plugins.dynamicresources import DynamicResources
     # filter order mirrors apis/config/v1/default_plugins.go:30
     from .plugins.node_basics import NodeDeclaredFeatures
-    plugins = [
-        SchedulingGates(), GangScheduling(), PrioritySort(),
-        NodeDeclaredFeatures(),
-        NodeUnschedulable(), NodeName(), TaintToleration(), NodeAffinity(),
-        NodePorts(), nr.Fit(), VolumeRestrictions(client),
-        NodeVolumeLimits(client), VolumeBinding(client), VolumeZone(client),
-        DynamicResources(client),
-        nr.BalancedAllocation(), PodTopologySpread(),
-        InterPodAffinity(ns_lister=ns_lister), ImageLocality(),
+    factories = [
+        SchedulingGates, GangScheduling, PrioritySort,
+        NodeDeclaredFeatures,
+        NodeUnschedulable, NodeName, TaintToleration, NodeAffinity,
+        NodePorts, nr.Fit,
+        lambda: VolumeRestrictions(client),
+        lambda: NodeVolumeLimits(client),
+        lambda: VolumeBinding(client),
+        lambda: VolumeZone(client),
+        lambda: DynamicResources(client),
+        nr.BalancedAllocation, PodTopologySpread,
+        lambda: InterPodAffinity(ns_lister=ns_lister),
+        ImageLocality,
     ]
     if client is not None:
-        plugins.append(DefaultBinder(client))
-    return plugins
+        factories.append(lambda: DefaultBinder(client))
+    return factories
+
+
+def default_plugins(client=None, ns_lister=None) -> list:
+    return [f() for f in default_plugin_factories(client, ns_lister)]
 
 
 @dataclass
@@ -205,6 +219,8 @@ class _PendingDrain:
     # nominated-pod resource overlay active at dispatch (None = none);
     # replays must reproduce the dispatch-time overlay
     ovl: object = None
+    # per-pod self-nomination rows (i32 [n], -1 = none) paired with ovl
+    nom: object = None
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -304,6 +320,15 @@ class Scheduler:
         self.percentage_of_nodes_to_score = (
             100 if percentage_of_nodes_to_score is None
             else percentage_of_nodes_to_score)
+        if self.percentage_of_nodes_to_score < 100:
+            # startup honesty: a config asking for sampling gets the full
+            # vectorized pass, which changes decisions vs a sampling
+            # reference (different node subset → different winner)
+            klog.warning(
+                "percentageOfNodesToScore below 100 is treated as 100: "
+                "the device program filters and scores every node in one "
+                "vectorized pass (SURVEY §7: sampling deliberately dropped)",
+                requested=self.percentage_of_nodes_to_score)
         if profiles is None:
             fwk = Framework(DEFAULT_SCHEDULER_NAME, default_plugins(client),
                             weights=dict(DEFAULT_WEIGHTS))
@@ -395,6 +420,16 @@ class Scheduler:
             if hasattr(client, "list_pdbs"):
                 dp.pdb_lister = client.list_pdbs
             dp.extenders = tuple(prof.extenders)
+            # batched device dry-run (SURVEY §7 step 8): the Evaluator's
+            # candidate sweep runs as one gathered kernel against the
+            # tensorized state; single-device only (the gathered node rows
+            # live on one chip) and gated for config parity
+            if (mesh is None
+                    and self.feature_gates.enabled("BatchedPreemptionDryRun")):
+                from .framework.preemption import DeviceDryRunContext
+                dp.device_ctx = DeviceDryRunContext(
+                    state=self.state, builder=self.builder,
+                    snapshot=self.snapshot)
             dp.set_framework(fwk)
 
         self._register_event_handlers()
@@ -417,6 +452,12 @@ class Scheduler:
         # segment reseeds from the host snapshot.
         self._device_carry = None
         self._carry_profile = None   # profile whose cfg filled the sig cache
+        # nominator version the resident carry's SigCache overlay was
+        # computed under (-1 = no nominations): _slow_parts/_row_refresh
+        # bake the dispatch-time overlay into the cached fit_ok, so any
+        # nomination change must zero the sig exactly like a profile
+        # switch (ADVICE r5 high)
+        self._carry_ovl_fp = -1
         # dispatched-but-uncommitted drains (async commit pipeline). Depth
         # bounds the optimism: device results stream back via
         # copy_to_host_async while later drains are created/dispatched, so
@@ -880,13 +921,18 @@ class Scheduler:
         from .ops.groups import scatter_new_rows, to_device
 
         carry = self._device_carry
-        if carry is not None and self._carry_profile != profile.name:
+        nominator = self.queue.nominator
+        ovl_fp = nominator.version if nominator.nominated_pods else -1
+        if carry is not None and (self._carry_profile != profile.name
+                                  or self._carry_ovl_fp != ovl_fp):
             # the signature cache's s_fit/s_bal were computed under another
-            # profile's ScoreConfig: invalidate (sig 0 never matches)
+            # profile's ScoreConfig — or its fit_ok under a different
+            # nominated-pod overlay: invalidate (sig 0 never matches)
             carry = carry._replace(
                 cache=carry.cache._replace(sig=jnp.int32(0)))
             self._device_carry = carry
         self._carry_profile = profile.name
+        self._carry_ovl_fp = ovl_fp
         if carry is None:
             # reseed device state from the host snapshot (first batch, or an
             # external event invalidated the resident carry). Pending
@@ -962,6 +1008,14 @@ class Scheduler:
                 # a bind error during the drain invalidated the carry:
                 # restart this dispatch against the reseeded state
                 return self._dispatch_device_drain(qpis, profile, prebuilt)
+            if (self.builder.groups.device_rows(),
+                    na.used.shape[0]) != self._gd_capacity:
+                # the commits above can intern NEW signature rows (e.g.
+                # preemption's batched dry-run row for a failed pod): a
+                # pow2 capacity crossing means the resident group tensors
+                # are too small to scatter into — reseed instead
+                self._invalidate_device_state()
+                return self._dispatch_device_drain(qpis, profile, prebuilt)
             self.cache.update_snapshot(self.snapshot)
             self._gd_dev, gcarry = scatter_new_rows(
                 self._gd_dev, carry.groups, self.builder.groups,
@@ -977,6 +1031,7 @@ class Scheduler:
         table = self._table_dev
         n = len(qpis)
         ovl = None
+        nom = None
         if self.queue.nominator.nominated_pods:
             # re-validate at the DISPATCH site: interleaved host-path
             # scheduling (mixed drains, fallback segments) can nominate
@@ -988,20 +1043,39 @@ class Scheduler:
                 return sum(1 if self._schedule_one_host(q) else 0
                            for q in qpis)
             ovl = self._build_overlay(na)
+            nom = self._nominated_rows(qpis)
         t0 = _time.perf_counter()
         with self.tracer.span("device_dispatch", pods=n,
                               groups=groups_needed):
             carry, records = self._dispatch_runs(
                 profile, na, carry, segment_batch, table, n, groups_needed,
-                ovl=ovl)
+                ovl=ovl, nom=nom)
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
-            dispatched_at=t0, ovl=ovl))
+            dispatched_at=t0, ovl=ovl, nom=nom))
         return 0
+
+    def _nominated_rows(self, qpis: list[QueuedPodInfo]):
+        """i32 [n] row index of each drain pod's OWN nomination (-1 =
+        none), or None when no drain pod is nominated — the device
+        self-exclusion companion to the overlay (PodXs.nom_idx)."""
+        nominated = self.queue.nominator.nominated_pods
+        out = None
+        for i, q in enumerate(qpis):
+            node = nominated.get(q.pod.uid)
+            if node is None:
+                continue
+            idx = self.state.node_index.get(node)
+            if idx is None:
+                continue
+            if out is None:
+                out = np.full((len(qpis),), -1, np.int32)
+            out[i] = idx
+        return out
 
     # below this run length the scan's per-step cost beats the matrix setup
     UNIFORM_RUN_MIN = 16
@@ -1011,9 +1085,12 @@ class Scheduler:
         fit-only resource overlay (the reference adds nominated pods with
         priority >= the incoming pod's to the NodeInfo,
         runtime/framework.go:1183-1200): every nominated pod outranks every
-        drain pod, none carries host ports (the ports carry isn't
-        overlaid), and no drain pod IS a nominated pod (the reference
-        skips the pod's own nomination; the overlay can't)."""
+        drain pod and none carries host ports (the ports carry isn't
+        overlaid). A drain pod that IS nominated — the renominated
+        preemptor wave, the hottest preemption shape — is handled by
+        per-pod self-exclusion (PodXs.nom_idx): its own nominated row is
+        subtracted back out of the overlay, mirroring the reference
+        skipping the pod's own nomination."""
         if self.mesh is not None:
             return False
         nom = self.queue.nominator
@@ -1026,8 +1103,7 @@ class Scheduler:
                     for p in c.ports:
                         if p.host_port > 0:
                             return False
-        nominated = nom.nominated_pods
-        return not any(q.pod.uid in nominated for q in qpis)
+        return True
 
     def _build_overlay(self, na):
         """(ovl_used [N,R], ovl_npods [N]) from the current nominations —
@@ -1147,7 +1223,7 @@ class Scheduler:
         return runs
 
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
-                       n: int, groups_needed: bool, ovl=None):
+                       n: int, groups_needed: bool, ovl=None, nom=None):
         """Dispatch the drain through the fastest exact program with ZERO
         host synchronization — results stream back asynchronously and the
         carry chains device-side.
@@ -1162,7 +1238,9 @@ class Scheduler:
         BalancedAllocation non-monotonicity, depth-J overflow) can rewind
         and replay. Returns (chain carry, [_RunRec])."""
         cfg = profile.score_config
-        fast_ok = (self.mesh is None
+        # nom != None → some drain pod needs per-pod self-exclusion, which
+        # the closed-form uniform path cannot express: scan the drain
+        fast_ok = (self.mesh is None and nom is None
                    and self.feature_gates.enabled("OpportunisticBatching")
                    and not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
@@ -1171,7 +1249,7 @@ class Scheduler:
         else:
             spans = self._classify_runs(batch, n)
         return self._dispatch_spans(cfg, na, batch, table, spans, carry,
-                                    ovl=ovl)
+                                    ovl=ovl, nom=nom)
 
     def _uniform_shape(self, na) -> tuple[int, int, int]:
         """(L, K, J) for run_uniform, chosen to be STABLE across drains:
@@ -1187,7 +1265,7 @@ class Scheduler:
         return L, K, J
 
     def _dispatch_spans(self, cfg: ScoreConfig, na, batch, table,
-                        spans, carry, ovl=None):
+                        spans, carry, ovl=None, nom=None):
         """Dispatch the given (i, j, uniform) spans back-to-back, chaining
         the carry on device; issues async host copies so the tunnel
         transfer overlaps whatever the host does next."""
@@ -1202,7 +1280,8 @@ class Scheduler:
                                        L, J, True))
             else:
                 c2, assigns = self._scan_dispatch(cfg, na, carry, batch,
-                                                  i, j, table, ovl=ovl)
+                                                  i, j, table, ovl=ovl,
+                                                  nom=nom)
                 records.append(_RunRec("scan", i, j, carry, assigns))
             carry = c2
         for rec in records:
@@ -1249,24 +1328,28 @@ class Scheduler:
             else:
                 carry, a = self._scan_dispatch(cfg, pd.na, carry, pd.batch,
                                                rec.i, rec.j, pd.table,
-                                               ovl=pd.ovl)
+                                               ovl=pd.ovl, nom=pd.nom)
                 out[rec.i:rec.j] = np.asarray(a)[:m]
             # re-dispatch the rest of this drain ...
             spans = [(q.i, q.j, q.uniform) for q in pd.records[idx + 1:]]
             carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
                                                    pd.table, spans, carry,
-                                                   ovl=pd.ovl)
+                                                   ovl=pd.ovl, nom=pd.nom)
             pd.records[idx + 1:] = new_recs
-            # ... and every later pending drain, against the new chain
+            # ... and every later pending drain, against the new chain. A
+            # profile OR overlay change between drains invalidates the sig
+            # cache, mirroring the dispatch-site checks.
             prev_profile = pd.profile
+            prev_ovl = pd.ovl
             for pd2 in self._pending:
-                if pd2.profile is not prev_profile:
+                if pd2.profile is not prev_profile or pd2.ovl is not prev_ovl:
                     carry = carry._replace(
                         cache=carry.cache._replace(sig=jnp.int32(0)))
                     prev_profile = pd2.profile
+                    prev_ovl = pd2.ovl
                 carry, pd2.records = self._dispatch_runs(
                     pd2.profile, pd2.na, carry, pd2.batch, pd2.table,
-                    pd2.n, pd2.groups_needed, ovl=pd2.ovl)
+                    pd2.n, pd2.groups_needed, ovl=pd2.ovl, nom=pd2.nom)
             if self._device_carry is not None:
                 self._device_carry = carry
             idx += 1
@@ -1421,7 +1504,7 @@ class Scheduler:
         return carry
 
     def _scan_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
-                       j: int, table, ovl=None):
+                       j: int, table, ovl=None, nom=None):
         """Dispatch run_batch over pods [i:j) padded to a pow2 bucket;
         returns (carry, device assignments) without synchronizing."""
         bucket = pow2_at_least(j - i)
@@ -1432,7 +1515,14 @@ class Scheduler:
         sig[:m] = batch.sig[i:j]
         tidx = np.full((bucket,), batch.tidx[j - 1], np.int32)
         tidx[:m] = batch.tidx[i:j]
-        xs = PodXs(valid=valid, sig=sig, tidx=tidx)
+        # self-nominated pods keep their signature: the cached fit_ok is
+        # overlay-pure and the per-pod exclusion is a one-row delta in
+        # _eval_pod, so the fast path still serves them
+        nom_idx = None
+        if nom is not None:
+            nom_idx = np.full((bucket,), -1, np.int32)
+            nom_idx[:m] = nom[i:j]
+        xs = PodXs(valid=valid, sig=sig, tidx=tidx, nom_idx=nom_idx)
         if self.mesh is not None:
             from .parallel.sharding import run_batch_sharded
             return run_batch_sharded(cfg, self.mesh, na, carry, xs, table,
@@ -1725,6 +1815,13 @@ class Scheduler:
         profile = self.profiles.get(pod.spec.scheduler_name)
         if (try_preempt and err.num_all_nodes > 0 and profile is not None
                 and profile.framework.post_filter_plugins):
+            if self._pending:
+                # never compute victims on optimistic state that excludes
+                # in-flight drains' assignments: an already-dispatched drain
+                # may be about to fill the very nodes the Evaluator would
+                # evict from (ADVICE r5 medium). Each nested commit pops
+                # before it handles failures, so the recursion terminates.
+                self._drain_pending()
             self.cache.update_snapshot(self.snapshot)
             result, status = profile.framework.run_post_filter_plugins(
                 state or CycleState(), pod, err.diagnosis.node_to_status)

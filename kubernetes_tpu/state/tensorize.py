@@ -118,6 +118,10 @@ class ClusterState:
     # name → the Node object whose static fields row `name` reflects
     # (strong refs: identity comparison is only safe while we hold them)
     _row_node: dict = field(default_factory=dict)
+    # (id(snapshot), generation, tree_generation) of the last fully
+    # consumed apply_snapshot: an unchanged snapshot skips the O(N) walk
+    # entirely (the preemption path applies per failed pod)
+    _applied_key: tuple = (0, -1, -1)
 
     # -- index management -----------------------------------------------------
 
@@ -159,6 +163,11 @@ class ClusterState:
         apply (pull-based incremental consumption: this consumer owns its own
         progress in `row_gen`, so it never depends on how often the host
         refreshed the snapshot in between)."""
+        applied_key = (id(snapshot), snapshot.generation,
+                       snapshot.tree_generation)
+        if not full and self.arrays is not None \
+                and applied_key == self._applied_key:
+            return
         self.ensure_arrays()
         list_order = {n.name: i for i, n in enumerate(snapshot.node_info_list)}
         schedulable_names = set(list_order)
@@ -198,6 +207,7 @@ class ClusterState:
         if dirty_writes or full:
             self._device_dirty = True
             self.staging_gen += 1
+        self._applied_key = applied_key
 
     def _write_row_aggregates(self, idx: int, ni: NodeInfo) -> None:
         """Pod-aggregate-only row refresh (used/nonzero/npods/ports) —
@@ -313,6 +323,24 @@ class ClusterState:
         if self.arrays is not None:
             self.arrays = _pad_cols(self.arrays, self.dims)
             self.staging_gen += 1
+
+    def request_vector(self, requests: dict[str, int]):
+        """Dense np.int64 request row at the CURRENT staging width, WITHOUT
+        interning side effects: returns None when a resource name is not in
+        the table (or sits past the staged width), letting the caller fall
+        back to the host path instead of triggering a mid-flight resource
+        growth/recompile. Used by the batched preemption dry-run for victim
+        and nominated-pod vectors."""
+        a = self.ensure_arrays()
+        width = a.used.shape[1]
+        row = np.zeros((width,), np.int64)
+        index = self.rtable.index
+        for name, v in requests.items():
+            i = index.get(name)
+            if i is None or i >= width:
+                return None
+            row[i] = v
+        return row
 
     # -- device transfer ------------------------------------------------------
 

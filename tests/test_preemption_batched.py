@@ -1,0 +1,286 @@
+"""Batched device preemption dry-run — parity vs the host Evaluator.
+
+The tentpole acceptance gate: across fuzzed (cluster, preemptor) cases —
+including PDB-violating victims, priority ties, spread-constrained
+preemptors and pending nominations — the batched kernel
+(ops/program.py dry_run_select_victims) must produce candidate lists with
+victim sets IDENTICAL to the host oracle loop (framework/preemption.py
+select_victims_on_node per candidate), which itself mirrors
+default_preemption.go:583 + preemption.go filterPodsWithPDBViolation.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import (LabelSelector, ObjectMeta,
+                                      PodDisruptionBudget)
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.framework.interface import CycleState
+from kubernetes_tpu.framework.types import Diagnosis, PodInfo, QueuedPodInfo
+from kubernetes_tpu.plugins.defaultpreemption import DefaultPreemption
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _evaluator(sched):
+    prof = next(iter(sched.profiles.values()))
+    dp = next(p for p in prof.framework.plugins
+              if isinstance(p, DefaultPreemption))
+    return dp._evaluator
+
+
+def _canon(candidates):
+    return [(c.node_name, [pi.pod.uid for pi in c.victims],
+             c.num_pdb_violations) for c in candidates]
+
+
+def _run_both(sched, pod, require_batched=True):
+    """dry_run via the batched kernel AND the host loop; returns both."""
+    sched.cache.update_snapshot(sched.snapshot)
+    nodes = sched.snapshot.node_info_list
+    ev = _evaluator(sched)
+    diagnosis = Diagnosis()
+    potential = ev.nodes_where_preemption_might_help(nodes, diagnosis)
+    num = ev.get_num_candidates(len(potential))
+    pdbs = ev.pdb_lister() if ev.pdb_lister is not None else []
+    batched = ev._dry_run_batched(pod, potential, num, nodes, pdbs)
+    if require_batched:
+        assert batched is not None, "case unexpectedly fell back to host"
+    ctx, ev.device_ctx = ev.device_ctx, None
+    try:
+        host = ev.dry_run_preemption(CycleState(), pod, potential, num,
+                                     all_nodes=nodes)
+    finally:
+        ev.device_ctx = ctx
+    return batched, host
+
+
+def _fuzz_cluster(rng, spread=False, pdb=False, nominate=False):
+    api = APIServer()
+    sched = Scheduler(api, batch_size=64)
+    n_nodes = rng.randint(3, 8)
+    zones = rng.randint(1, 3)
+    for i in range(n_nodes):
+        api.create_node(
+            make_node(f"n{i}")
+            .capacity({"cpu": rng.choice([4, 6, 8]), "memory": "16Gi",
+                       "pods": rng.choice([4, 110])})
+            .zone(f"z{i % zones}")
+            .obj())
+    # bound pods: random priorities WITH ties, random sizes, some labeled
+    uid = 0
+    for i in range(n_nodes):
+        for _ in range(rng.randint(0, 4)):
+            w = make_pod(f"p{uid}").req(
+                {"cpu": str(rng.choice([1, 2, 3])), "memory": "1Gi"})
+            w = w.priority(rng.choice([0, 0, 5, 5, 10, 50]))
+            if rng.random() < 0.6:
+                w = w.label("app", rng.choice(["a", "b"]))
+            if spread and rng.random() < 0.6:
+                w = w.label("sp", "yes")
+            p = w.obj()
+            api.create_pod(p)
+            api.bind(p, f"n{i}")
+            uid += 1
+    if pdb:
+        for j, sel in enumerate(rng.sample([{"app": "a"}, {"app": "b"},
+                                            {"app": "a"}], rng.randint(1, 2))):
+            api.create_pdb(PodDisruptionBudget(
+                metadata=ObjectMeta(name=f"pdb{j}"),
+                selector=LabelSelector.of(match_labels=sel),
+                min_available=rng.choice([1, 2, "50%", "100%"])))
+    # the preemptor: mid priority so some pods are victims and some not
+    w = make_pod("preemptor").req(
+        {"cpu": str(rng.choice([2, 4, 6])), "memory": "2Gi"}).priority(
+            rng.choice([7, 20, 100]))
+    if spread:
+        w = w.label("sp", "yes").spread_constraint(
+            rng.choice([1, 2]), ZONE, "DoNotSchedule", {"sp": "yes"})
+    preemptor = w.obj()
+    if nominate:
+        # a pending ≥-priority nomination occupies part of a node
+        nom = make_pod("nominated").req({"cpu": "2", "memory": "1Gi"}) \
+            .priority(200).obj()
+        qpi = QueuedPodInfo(pod_info=PodInfo.of(nom))
+        sched.queue.nominator.add(qpi, f"n{rng.randrange(n_nodes)}")
+    return api, sched, preemptor
+
+
+class TestBatchedParity:
+    @pytest.mark.parametrize("seed", range(80))
+    def test_basic_parity(self, seed):
+        rng = random.Random(seed)
+        api, sched, pod = _fuzz_cluster(rng)
+        batched, host = _run_both(sched, pod)
+        assert _canon(batched) == _canon(host)
+
+    @pytest.mark.parametrize("seed", range(80, 140))
+    def test_pdb_parity(self, seed):
+        rng = random.Random(seed)
+        api, sched, pod = _fuzz_cluster(rng, pdb=True)
+        batched, host = _run_both(sched, pod)
+        assert _canon(batched) == _canon(host)
+
+    @pytest.mark.parametrize("seed", range(140, 190))
+    def test_spread_parity(self, seed):
+        rng = random.Random(seed)
+        api, sched, pod = _fuzz_cluster(rng, spread=True,
+                                        pdb=rng.random() < 0.3)
+        batched, host = _run_both(sched, pod)
+        assert _canon(batched) == _canon(host)
+
+    @pytest.mark.parametrize("seed", range(190, 230))
+    def test_nominated_overlay_parity(self, seed):
+        rng = random.Random(seed)
+        api, sched, pod = _fuzz_cluster(rng, nominate=True,
+                                        pdb=rng.random() < 0.3)
+        batched, host = _run_both(sched, pod)
+        assert _canon(batched) == _canon(host)
+
+    def test_priority_tie_exact_order(self):
+        """Victims with equal priority reprieve in creation order; the
+        kernel must reproduce the host's exact victim LIST, not just the
+        set."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 6, "memory": "16Gi", "pods": 110}).obj())
+        for i in range(3):
+            p = make_pod(f"tie{i}").req({"cpu": "2", "memory": "1Gi"}) \
+                .priority(5).obj()
+            api.create_pod(p)
+            api.bind(p, "n0")
+        pod = make_pod("vip").req({"cpu": "4", "memory": "1Gi"}) \
+            .priority(50).obj()
+        batched, host = _run_both(sched, pod)
+        assert _canon(batched) == _canon(host)
+        # earliest-started tie pods are reprieved last → evicted
+        assert len(batched[0][1] if isinstance(batched[0], tuple)
+                   else batched[0].victims) == 2
+
+    def test_fallback_cases_use_host_loop(self):
+        """Preemptors with pod anti-affinity have no tensor form: the
+        batched path must decline (return None), not guess."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        p = make_pod("low").req({"cpu": "4", "memory": "1Gi"}).obj()
+        api.create_pod(p)
+        api.bind(p, "n0")
+        pod = make_pod("vip").req({"cpu": "4", "memory": "1Gi"}) \
+            .priority(50).label("x", "y") \
+            .pod_affinity(ZONE, {"x": "y"}, anti=True).obj()
+        sched.cache.update_snapshot(sched.snapshot)
+        ev = _evaluator(sched)
+        nodes = sched.snapshot.node_info_list
+        got = ev._dry_run_batched(pod, nodes, 10, nodes, [])
+        assert got is None
+        # and the full dry run still works through the host loop
+        host = ev.dry_run_preemption(CycleState(), pod, nodes, 10,
+                                     all_nodes=nodes)
+        assert [c.node_name for c in host] == ["n0"]
+
+    def test_end_to_end_uses_batched_path(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(3):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        for i in range(3):
+            api.create_pod(make_pod(f"low{i}").req(
+                {"cpu": "4", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 3
+        api.create_pod(make_pod("vip").req({"cpu": "4", "memory": "1Gi"})
+                       .priority(100).obj())
+        sched.schedule_pending()
+        ev = _evaluator(sched)
+        assert ev.batched_dry_runs == 1
+        assert ev.host_dry_runs == 0
+        assert api.pods["default/vip"].status.nominated_node_name != ""
+
+
+class TestPDBRegression:
+    """The two PDB divergences fixed to match preemption.go / the
+    disruption controller."""
+
+    def _pdb(self, name, labels, min_available=None, allowed=None):
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name=name),
+            selector=LabelSelector.of(match_labels=labels),
+            min_available=min_available)
+        if allowed is not None:
+            pdb.disruptions_allowed = allowed
+        return pdb
+
+    def test_violating_pod_still_consumes_other_budgets(self):
+        """filterPodsWithPDBViolation decrements EVERY matching PDB for
+        EVERY pod: a pod violating PDB A still consumes PDB B's budget,
+        so a later B-only pod is classified violating too."""
+        from kubernetes_tpu.framework.preemption import Evaluator
+        pdb_a = self._pdb("a", {"app": "a"}, allowed=0)
+        pdb_b = self._pdb("b", {"grp": "g"}, allowed=1)
+        p0 = PodInfo.of(make_pod("p0").label("app", "a")
+                        .label("grp", "g").obj())
+        p1 = PodInfo.of(make_pod("p1").label("grp", "g").obj())
+        violating, ok = Evaluator._filter_pods_with_pdb_violation(
+            [p0, p1], [pdb_a, pdb_b])
+        # p0 violates A (0 → −1) and consumes B (1 → 0); p1 then pushes
+        # B to −1 → violating as well. The old code reprieved p1 first.
+        assert [pi.pod.name for pi in violating] == ["p0", "p1"]
+        assert ok == []
+
+    def test_min_available_percent_rounds_up(self):
+        """"50%" of 3 pods protects ceil(1.5) = 2 (the disruption
+        controller's GetScaledValueFromIntOrPercent roundUp=true)."""
+        api = APIServer()
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 16, "memory": "32Gi", "pods": 10}).obj())
+        for i in range(3):
+            p = make_pod(f"a{i}").label("app", "a").obj()
+            api.create_pod(p)
+            api.bind(p, "n0")
+        api.create_pdb(self._pdb("pct", {"app": "a"}, min_available="50%"))
+        allowed = {p.name: p.disruptions_allowed for p in api.list_pdbs()}
+        assert allowed == {"pct": 1}   # floor would overstate it as 2
+
+
+class TestOverlayCarryInvalidation:
+    def test_nomination_change_invalidates_sig_cache(self):
+        """ADVICE r5 high: a nomination arriving between two same-signature
+        drains must zero the resident SigCache — otherwise the second
+        drain reuses fit_ok computed WITHOUT the overlay and a pod steals
+        the capacity reserved for the preemptor."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(2):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        api.create_pod(make_pod("a1").req({"cpu": "4", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1   # warm carry, sig cached
+        # a preemptor nomination lands on the still-free node — through the
+        # nominator only, which does NOT invalidate the device carry
+        nom = make_pod("vip").req({"cpu": "4", "memory": "1Gi"}) \
+            .priority(100).obj()
+        free_node = "n1" if api.pods["default/a1"].spec.node_name == "n0" \
+            else "n0"
+        sched.queue.nominator.add(QueuedPodInfo(pod_info=PodInfo.of(nom)),
+                                  free_node)
+        # same-signature pod: with a stale SigCache it would reuse the
+        # overlay-free fit_ok and bind onto the nominated node
+        api.create_pod(make_pod("a2").req({"cpu": "4", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        assert api.pods["default/a2"].spec.node_name == ""
+
+    def test_handle_failure_drains_pending_before_preemption(self):
+        sched = Scheduler(APIServer(), batch_size=64)
+        calls = []
+        sched._drain_pending = lambda: calls.append(True)
+        sched._pending.append(object())
+        from kubernetes_tpu.framework.types import FitError
+        qpi = QueuedPodInfo(pod_info=PodInfo.of(make_pod("x").obj()))
+        sched._handle_failure(qpi, FitError(qpi.pod, 1))
+        assert calls, "_handle_failure must quiesce the pipeline first"
