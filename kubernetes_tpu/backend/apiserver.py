@@ -21,12 +21,41 @@ from ..api.types import (Node, PersistentVolume, PersistentVolumeClaim,
                          _resolve_maybe_percent)
 
 
-class Conflict(Exception):
+class APIError(Exception):
+    """Base of the in-memory server's typed errors (apierrors analog)."""
+
+
+class Conflict(APIError):
     pass
 
 
-class NotFound(Exception):
+class NotFound(APIError):
     pass
+
+
+class ServerTimeout(APIError):
+    """The server timed out before the call took effect (504-shaped,
+    apierrors.IsServerTimeout). Retriable."""
+
+
+class TooManyRequests(APIError):
+    """429: the server sheds load (apierrors.IsTooManyRequests).
+    Retriable."""
+
+
+class ServiceUnavailable(APIError):
+    """503: transient unavailability. Retriable."""
+
+
+# the retriable set mirrors client-go's shouldRetry classification
+# (util/retry + apierrors.SuggestsClientDelay): the call did NOT take
+# effect, so re-issuing it is safe. Conflict/NotFound are terminal — they
+# describe state the caller must react to, not a server hiccup.
+RETRIABLE_ERRORS = (ServerTimeout, TooManyRequests, ServiceUnavailable)
+
+
+def is_retriable(err: Exception) -> bool:
+    return isinstance(err, RETRIABLE_ERRORS)
 
 
 @dataclass

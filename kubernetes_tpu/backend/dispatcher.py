@@ -12,17 +12,29 @@ Mirrors pkg/scheduler/backend/api_dispatcher/:
 - api_cache facade semantics: queue/cache observe call effects immediately
   because the scheduler assumes pods before enqueueing the bind.
 
-Failed binds invoke the scheduler's forget/requeue path exactly like
-bindingCycle error handling (schedule_one.go:361-393).
+Error handling mirrors client-go: retriable errors (ServerTimeout /
+TooManyRequests / ServiceUnavailable — the call did not take effect) retry
+with exponential backoff + jitter under a per-call attempt budget; terminal
+errors (Conflict, NotFound, anything untyped) route to the scheduler's
+forget/requeue path exactly like bindingCycle error handling
+(schedule_one.go:361-393). DELETE (preemption victim) calls retry too, so
+a transient hiccup cannot half-commit a preemptor wave.
+
+`flush()` executes pending DELETEs BEFORE the bulk binds: a preemptor
+wave's victims leave the store before their preemptors bind, matching the
+reference's relevance ordering end to end (not just within the queue).
 """
 
 from __future__ import annotations
 
 import enum
+import random
+import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.types import Pod
+from .apiserver import Conflict, is_retriable
 
 
 class CallType(str, enum.Enum):
@@ -52,18 +64,36 @@ class APIDispatcher:
     client: object  # APIServer-shaped
     on_bind_error: Optional[Callable[[Pod, str, Exception], None]] = None
     metrics: Optional[object] = None  # SchedulerMetrics (api_dispatcher_calls)
+    # retry policy (config knobs apiRetryMaxAttempts/apiRetryBaseSeconds):
+    # attempt budget INCLUDES the first try; base doubles per retry with
+    # equal jitter, capped at retry_max_delay_seconds
+    retry_max_attempts: int = 5
+    retry_base_seconds: float = 0.02
+    retry_max_delay_seconds: float = 1.0
+    sleep: Callable[[float], None] = _time.sleep
+    _rng: random.Random = field(default_factory=lambda: random.Random(0))
     _queue: dict[str, APICall] = field(default_factory=dict)  # uid → pending
     # bulk fast path: (bound pod, the original object it was derived from)
     _binds: list[tuple[Pod, Pod]] = field(default_factory=list)
     executed: int = 0
     errors: int = 0
+    retries: int = 0
 
     def add(self, call: APICall) -> None:
         uid = call.pod.uid
         pending = self._queue.get(uid)
         if pending is not None:
             if _RELEVANCE[call.call_type] < _RELEVANCE[pending.call_type]:
-                return  # less relevant than what's queued: suppress
+                # less relevant than what's queued: suppress. A BIND
+                # suppressed by a pending DELETE carries an assumed pod —
+                # silently dropping it would leak the assume; route it
+                # through the forget/requeue path like a failed bind.
+                if (call.call_type == CallType.BIND
+                        and pending.call_type == CallType.DELETE
+                        and self.on_bind_error is not None):
+                    self.on_bind_error(call.pod, call.node_name, Conflict(
+                        f"bind of {uid} superseded by pending delete"))
+                return
             if (call.call_type == CallType.STATUS_PATCH
                     and pending.call_type == CallType.STATUS_PATCH):
                 # merge, don't replace (reference call_queue.go Merge): the
@@ -83,71 +113,153 @@ class APIDispatcher:
         update landed, and reuse the assumed copy as the stored object."""
         if self._queue:
             # a bind supersedes a pending patch — but never a DELETE,
-            # which outranks it (same relevance ordering as add())
+            # which outranks it (same relevance ordering as add()). The
+            # superseded pod was already assumed: forget/requeue it
+            # instead of leaking the assume.
             for pair in pairs:
                 pending = self._queue.get(pair[0].uid)
                 if pending is not None:
                     if pending.call_type == CallType.DELETE:
+                        if self.on_bind_error is not None:
+                            self.on_bind_error(
+                                pair[0], pair[0].spec.node_name, Conflict(
+                                    f"bind of {pair[0].uid} superseded by "
+                                    "pending delete"))
                         continue
                     del self._queue[pair[0].uid]
                 self._binds.append(pair)
             return
         self._binds.extend(pairs)
 
-    def flush(self) -> int:
-        """Execute all pending calls; returns count executed."""
-        n_bulk = 0
-        if self._binds:
-            binds = self._binds
-            self._binds = []
-            n_bulk = len(binds)
+    # -- retry machinery ------------------------------------------------------
+
+    def _backoff(self, attempt: int) -> float:
+        """Exponential backoff with equal jitter (client-go wait.Backoff
+        shape): base·2^attempt capped, then scaled into [0.5, 1.0)."""
+        d = min(self.retry_base_seconds * (2.0 ** attempt),
+                self.retry_max_delay_seconds)
+        return d * (0.5 + 0.5 * self._rng.random())
+
+    def _count_retry(self, call_type: CallType) -> None:
+        self.retries += 1
+        if self.metrics is not None:
+            self.metrics.api_retries.inc(call_type.value)
+
+    def _execute_with_retry(self, call_type: CallType,
+                            fn: Callable[[], None]) -> Optional[Exception]:
+        """Run one API call under the retry policy; returns the terminal
+        exception (retriable exhausted or non-retriable) or None."""
+        attempt = 0
+        while True:
+            try:
+                fn()
+                return None
+            except Exception as e:
+                if not is_retriable(e) or attempt + 1 >= self.retry_max_attempts:
+                    return e
+                self._count_retry(call_type)
+                self.sleep(self._backoff(attempt))
+                attempt += 1
+
+    def _execute_binds(self, binds: list) -> list[tuple[Pod, Exception]]:
+        """Bulk bind with per-pod retry of the retriable failures; returns
+        the terminal failures."""
+        terminal: list[tuple[Pod, Exception]] = []
+        pending = binds
+        attempt = 0
+        while pending:
             if hasattr(self.client, "bind_all"):
-                failures = self.client.bind_all(binds)
+                failures = self.client.bind_all(pending)
             else:
                 failures = []
-                for p, _orig in binds:
+                for p, _orig in pending:
                     try:
                         self.client.bind(p, p.spec.node_name)
                     except Exception as e:
                         failures.append((p, e))
-            n_fail = len(failures)
-            self.executed += n_bulk - n_fail
-            self.errors += n_fail
-            if self.metrics is not None:
-                if n_bulk - n_fail:
-                    self.metrics.api_dispatcher_calls.inc(
-                        CallType.BIND.value, "success", by=n_bulk - n_fail)
-                if n_fail:
-                    self.metrics.api_dispatcher_calls.inc(
-                        CallType.BIND.value, "error", by=n_fail)
-            for pod, e in failures:
-                if self.on_bind_error is not None:
-                    self.on_bind_error(pod, pod.spec.node_name, e)
-        calls = list(self._queue.values())
-        self._queue.clear()
-        for call in calls:
-            try:
-                if call.call_type == CallType.BIND:
-                    self.client.bind(call.pod, call.node_name)
-                elif call.call_type == CallType.DELETE:
-                    self.client.delete_pod(call.pod.uid)
+            if not failures:
+                return terminal
+            by_uid = {pair[0].uid: pair for pair in pending}
+            retry = []
+            for p, e in failures:
+                if is_retriable(e) and attempt + 1 < self.retry_max_attempts:
+                    self._count_retry(CallType.BIND)
+                    retry.append(by_uid[p.uid])
                 else:
-                    self.client.patch_pod_status(
-                        call.pod, call.condition or {},
-                        call.nominated_node_name)
+                    terminal.append((p, e))
+            if retry:
+                self.sleep(self._backoff(attempt))
+                attempt += 1
+            pending = retry
+        return terminal
+
+    # -- flush ----------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Execute all pending calls; returns count executed. Order:
+        queued DELETEs (preemption victims) → bulk binds → everything
+        else (single binds, status patches)."""
+        n = 0
+        if self._queue:
+            deletes = [c for c in self._queue.values()
+                       if c.call_type == CallType.DELETE]
+            if deletes:
+                for c in deletes:
+                    del self._queue[c.pod.uid]
+                n += self._execute_calls(deletes)
+        n += self._flush_bulk_binds()
+        if self._queue:
+            calls = list(self._queue.values())
+            self._queue.clear()
+            n += self._execute_calls(calls)
+        return n
+
+    def _flush_bulk_binds(self) -> int:
+        if not self._binds:
+            return 0
+        binds = self._binds
+        self._binds = []
+        n_bulk = len(binds)
+        failures = self._execute_binds(binds)
+        n_fail = len(failures)
+        self.executed += n_bulk - n_fail
+        self.errors += n_fail
+        if self.metrics is not None:
+            if n_bulk - n_fail:
+                self.metrics.api_dispatcher_calls.inc(
+                    CallType.BIND.value, "success", by=n_bulk - n_fail)
+            if n_fail:
+                self.metrics.api_dispatcher_calls.inc(
+                    CallType.BIND.value, "error", by=n_fail)
+        for pod, e in failures:
+            if self.on_bind_error is not None:
+                self.on_bind_error(pod, pod.spec.node_name, e)
+        return n_bulk
+
+    def _execute_calls(self, calls: list[APICall]) -> int:
+        for call in calls:
+            if call.call_type == CallType.BIND:
+                fn = lambda c=call: self.client.bind(c.pod, c.node_name)
+            elif call.call_type == CallType.DELETE:
+                fn = lambda c=call: self.client.delete_pod(c.pod.uid)
+            else:
+                fn = lambda c=call: self.client.patch_pod_status(
+                    c.pod, c.condition or {}, c.nominated_node_name)
+            err = self._execute_with_retry(call.call_type, fn)
+            if err is None:
                 self.executed += 1
                 if self.metrics is not None:
                     self.metrics.api_dispatcher_calls.inc(
                         call.call_type.value, "success")
-            except Exception as e:
+            else:
                 self.errors += 1
                 if self.metrics is not None:
                     self.metrics.api_dispatcher_calls.inc(
                         call.call_type.value, "error")
                 if (call.call_type == CallType.BIND
                         and self.on_bind_error is not None):
-                    self.on_bind_error(call.pod, call.node_name, e)
-        return len(calls) + n_bulk
+                    self.on_bind_error(call.pod, call.node_name, err)
+        return len(calls)
 
     def is_delete_pending(self, uid: str) -> bool:
         """A victim whose DELETE is queued but not flushed is the in-memory

@@ -66,6 +66,11 @@ class KubeSchedulerConfiguration:
     pod_max_backoff_seconds: float = 10.0            # types.go:84
     # TPU batch shape (replaces Parallelism, types.go:58)
     batch_size: int = 512
+    # API-call retry policy (client-go wait.Backoff analog): attempt
+    # budget per call INCLUDING the first try, and the base backoff that
+    # doubles per retry (with jitter) in the dispatcher
+    api_retry_max_attempts: int = 5
+    api_retry_base_seconds: float = 0.02
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
@@ -89,6 +94,10 @@ class KubeSchedulerConfiguration:
             raise ValueError("percentageOfNodesToScore must be in (0, 100]")
         if self.batch_size <= 0:
             raise ValueError("batchSize must be > 0")
+        if self.api_retry_max_attempts < 1:
+            raise ValueError("apiRetryMaxAttempts must be >= 1")
+        if self.api_retry_base_seconds <= 0:
+            raise ValueError("apiRetryBaseSeconds must be > 0")
         known = set(_default_plugin_names()) | set(self.extra_plugins)
         for p in self.profiles:
             for n in p.plugins.enabled + p.plugins.disabled:
@@ -128,6 +137,8 @@ class KubeSchedulerConfiguration:
             "podInitialBackoffSeconds": self.pod_initial_backoff_seconds,
             "podMaxBackoffSeconds": self.pod_max_backoff_seconds,
             "batchSize": self.batch_size,
+            "apiRetryMaxAttempts": self.api_retry_max_attempts,
+            "apiRetryBaseSeconds": self.api_retry_base_seconds,
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
         }
@@ -167,6 +178,8 @@ class KubeSchedulerConfiguration:
                                               1.0),
             pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
             batch_size=d.get("batchSize", 512),
+            api_retry_max_attempts=d.get("apiRetryMaxAttempts", 5),
+            api_retry_base_seconds=d.get("apiRetryBaseSeconds", 0.02),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))
 

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.types import Pod
+from ..utils.logging import klog
 from .interface import Code, CycleState, Status
 from .types import Diagnosis, NodeInfo, PodInfo
 
@@ -231,8 +232,16 @@ class Evaluator:
         CycleState the same way, preemption.go:775)."""
         pdbs = self.pdb_lister() if self.pdb_lister is not None else []
         all_nodes = all_nodes or nodes
-        batched = self._dry_run_batched(pod, nodes, num_candidates,
-                                        all_nodes, pdbs)
+        try:
+            batched = self._dry_run_batched(pod, nodes, num_candidates,
+                                            all_nodes, pdbs)
+        except Exception as e:
+            # a device/XLA fault must not sink preemption: the host loop
+            # below is the oracle the kernel replicates (the scheduler's
+            # circuit breaker handles the scheduling path separately)
+            klog.error("batched dry-run fault; using host loop",
+                       pod=pod.uid, err=str(e))
+            batched = None
         if batched is not None:
             self.batched_dry_runs += 1
             return batched

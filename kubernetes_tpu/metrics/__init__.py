@@ -255,6 +255,34 @@ class SchedulerMetrics:
             n + "cache_divergence_total",
             "Discrepancies found by the cache comparer, by kind."
             , ("kind",)))
+        self.api_retries = r.register(Counter(
+            n + "api_retries_total",
+            "Retried API calls (retriable errors: ServerTimeout/"
+            "TooManyRequests/ServiceUnavailable), by call type.",
+            ("call_type",)))
+        self.device_fallbacks = r.register(Counter(
+            n + "device_fallbacks_total",
+            "Device batches degraded to the host-oracle path, by reason "
+            "(dispatch/commit fault, invalid assignment, open circuit "
+            "breaker).",
+            ("reason",)))
+        self.circuit_breaker_transitions = r.register(Counter(
+            n + "device_circuit_breaker_transitions_total",
+            "Device-tier circuit breaker state transitions.",
+            ("state",)))
+        self.resyncs = r.register(Counter(
+            n + "resyncs_total",
+            "Full cache+queue rebuilds from a fresh LIST (watch-stream "
+            "loss recovery)."))
+        # pre-seed the zero samples so dashboards (and bench_metrics.prom)
+        # always carry the fault-path series, faults or not
+        from ..backend.dispatcher import CallType
+        for ct in CallType:
+            self.api_retries.inc(ct.value, by=0)
+        for reason in ("dispatch", "commit", "invalid_assignment",
+                       "circuit_open"):
+            self.device_fallbacks.inc(reason, by=0)
+        self.resyncs.inc(by=0)
 
     def exposition(self) -> str:
         return self.registry.exposition()

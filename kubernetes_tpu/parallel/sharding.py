@@ -45,6 +45,19 @@ from ..state.tensorize import NodeArrays
 
 NODE_AXIS = "nodes"
 
+if hasattr(jax, "shard_map"):
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:
+    # older jax (< 0.5): same semantics under jax.experimental, with the
+    # replication check spelled check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 _INT_MAX = jnp.iinfo(jnp.int32).max
 
 # the signature-cache sig is a replicated scalar; every other carry leaf is
@@ -165,12 +178,11 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                                  na_l, table_r, groups_l, offset, fam)
         return lax.scan(step, carry_l, pods_r)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(node_sharded_na, node_sharded_carry, replicated_pods,
                   replicated_table, groups_spec),
-        out_specs=(node_sharded_carry, P()),
-        check_vma=False)
+        out_specs=(node_sharded_carry, P()))
     return fn(na, carry, pods, table, groups)
 
 

@@ -351,6 +351,9 @@ class Scheduler:
                                     spread_plugin=spread_p, ipa_plugin=ipa_p)
         self.dispatcher = APIDispatcher(
             client=client, on_bind_error=self._on_bind_error)
+        if config is not None:
+            self.dispatcher.retry_max_attempts = config.api_retry_max_attempts
+            self.dispatcher.retry_base_seconds = config.api_retry_base_seconds
 
         default_fwk = next(iter(self.profiles.values())).framework
         # SchedulerQueueingHints off → empty hint map → every event
@@ -359,10 +362,12 @@ class Scheduler:
         hints = (self._build_queueing_hints(default_fwk)
                  if self.feature_gates.enabled("SchedulerQueueingHints")
                  else {})
-        self.queue = SchedulingQueue(
+        # kept so resync() can rebuild the queue with identical wiring
+        self._queue_kwargs = dict(
             pre_enqueue=self._make_pre_enqueue(default_fwk),
             queueing_hints=hints,
             clock=clock, **queue_backoffs)
+        self.queue = SchedulingQueue(**self._queue_kwargs)
 
         from .metrics import SchedulerMetrics
         self.metrics = metrics or SchedulerMetrics(
@@ -442,6 +447,17 @@ class Scheduler:
         self.host_greedy_runs = 0
         self.host_scheduled = 0
         self.preemption_attempts = 0
+        # device-tier degradation: an XLA fault (or garbage assignment
+        # tensor) falls the batch back to the host oracle; K consecutive
+        # faults open a circuit breaker that routes every drain to the
+        # host path until a cooldown expires, after which ONE probe drain
+        # re-tries the device tier (half-open)
+        self.device_fault_threshold = 3
+        self.device_fault_cooldown = 30.0
+        self.device_fallbacks = 0
+        self._device_faults = 0          # consecutive
+        self._breaker_open_until = 0.0
+        self._breaker_open = False
         # per-pod consecutive bind-error count → escalating error backoff
         self._bind_errors: dict[str, int] = {}
         # Device-resident scan carry, reused across batches while no event
@@ -920,6 +936,14 @@ class Scheduler:
         (only the host-fallback retry path commits synchronously)."""
         from .ops.groups import scatter_new_rows, to_device
 
+        if not self._device_available():
+            # circuit breaker open: the device tier is sidelined until the
+            # cooldown expires; the host oracle takes the drain
+            self.device_fallbacks += 1
+            self.metrics.device_fallbacks.inc("circuit_open")
+            self._drain_pending()
+            return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
+
         carry = self._device_carry
         nominator = self.queue.nominator
         ovl_fp = nominator.version if nominator.nominated_pods else -1
@@ -1045,11 +1069,19 @@ class Scheduler:
             ovl = self._build_overlay(na)
             nom = self._nominated_rows(qpis)
         t0 = _time.perf_counter()
-        with self.tracer.span("device_dispatch", pods=n,
-                              groups=groups_needed):
-            carry, records = self._dispatch_runs(
-                profile, na, carry, segment_batch, table, n, groups_needed,
-                ovl=ovl, nom=nom)
+        try:
+            with self.tracer.span("device_dispatch", pods=n,
+                                  groups=groups_needed):
+                carry, records = self._dispatch_runs(
+                    profile, na, carry, segment_batch, table, n,
+                    groups_needed, ovl=ovl, nom=nom)
+        except Exception as e:
+            # XLA/dispatch fault: earlier in-flight drains predate the
+            # fault and commit normally; THIS drain degrades to the host
+            # oracle and the resident carry reseeds on the next dispatch
+            self._record_device_fault("dispatch", e)
+            self._drain_pending()
+            return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
@@ -1289,6 +1321,105 @@ class Scheduler:
                 rec.result.copy_to_host_async()
         return carry, records
 
+    # -- device-tier degradation (circuit breaker) ----------------------------
+
+    def _device_available(self) -> bool:
+        """False while the circuit breaker is open; once the cooldown
+        expires, True again so ONE drain probes the device tier
+        (half-open) — its commit outcome closes or re-opens the breaker."""
+        if self._device_faults < self.device_fault_threshold:
+            return True
+        return self.clock() >= self._breaker_open_until
+
+    def _record_device_fault(self, reason: str, err: Exception) -> None:
+        self._device_faults += 1
+        self.device_fallbacks += 1
+        self.metrics.device_fallbacks.inc(reason)
+        self._invalidate_device_state()
+        klog.error("device batch fault; degrading drain to host path",
+                   reason=reason, err=str(err),
+                   consecutive=self._device_faults)
+        if self._device_faults >= self.device_fault_threshold:
+            self._breaker_open_until = (self.clock()
+                                        + self.device_fault_cooldown)
+            if not self._breaker_open:
+                self._breaker_open = True
+                self.metrics.circuit_breaker_transitions.inc("open")
+                klog.warning("device tier circuit breaker OPEN",
+                             cooldown_s=self.device_fault_cooldown)
+
+    def _record_device_success(self) -> None:
+        if not self._device_faults:
+            return
+        self._device_faults = 0
+        if self._breaker_open:
+            self._breaker_open = False
+            self.metrics.circuit_breaker_transitions.inc("closed")
+            klog.info("device tier circuit breaker closed (probe drain "
+                      "committed cleanly)")
+
+    def _device_fault_abort(self, pd: "_PendingDrain", reason: str,
+                            err: Exception) -> None:
+        """A fault while resolving an in-flight drain: degrade ITS pods —
+        and every later pending drain, whose carries chain off the faulted
+        device state — to the host-oracle path. No pod is lost: each either
+        host-binds or goes through the normal failure handler."""
+        self._record_device_fault(reason, err)
+        victims = [pd, *self._pending]
+        self._pending.clear()
+        for d in victims:
+            for q in d.qpis:
+                self._schedule_one_host(q)
+
+    def resync(self) -> None:
+        """Rebuild cache + queue from a fresh LIST of the API server — the
+        reflector relist path (client-go Reflector.ListAndWatch after
+        watch-stream loss). Call when the watch layer reports loss (e.g.
+        dropped events): in-flight drains commit, the dispatcher flushes,
+        parked pods are rejected, then cluster state is rebuilt from the
+        store's current truth and the device tier reseeds from scratch."""
+        self._drain_pending()
+        self.dispatcher.flush()
+        for uid in list(self._waiting_pods):
+            self._reject_waiting(uid, "resync")
+        self.dispatcher.flush()   # the rejects enqueue status patches
+        self.cache = Cache(clock=self.clock)
+        self.snapshot = Snapshot()
+        self.queue = SchedulingQueue(**self._queue_kwargs)
+        self.workload_manager = WorkloadManager(clock=self.clock)
+        from .backend.debugger import CacheDebugger
+        self.debugger = CacheDebugger(self.client, self.cache, self.queue,
+                                      metrics=self.metrics)
+        # rewire the preemption plugins' live handles onto the new objects
+        from .plugins.defaultpreemption import DefaultPreemption
+        for prof in self.profiles.values():
+            for p in prof.framework.plugins:
+                if isinstance(p, DefaultPreemption):
+                    p.nominator = self.queue.nominator
+                    p.snapshot = self.snapshot
+                    if getattr(p, "device_ctx", None) is not None:
+                        p.device_ctx.snapshot = self.snapshot
+        self._bind_errors.clear()
+        # LIST order matters: nodes before pods so bound pods land on real
+        # cache entries instead of imputed placeholders
+        for node in list(self.client.nodes.values()):
+            self.cache.add_node(node)
+        for pod in list(self.client.pods.values()):
+            self.workload_manager.add_pod(pod)
+            if pod.spec.node_name:
+                self.cache.add_pod(pod)
+            elif self._responsible(pod):
+                self.queue.add(pod)
+        self._invalidate_device_state()
+        self.cache.update_snapshot(self.snapshot)
+        # full=True: the fresh cache restarts its generation counters, so
+        # incremental row-gen diffing against the old state could alias
+        self.state.apply_snapshot(self.snapshot, full=True)
+        self.metrics.resyncs.inc()
+        klog.warning("resync: cache+queue rebuilt from fresh LIST",
+                     nodes=len(self.client.nodes),
+                     pods=len(self.client.pods))
+
     # -- commit pipeline ------------------------------------------------------
 
     def _drain_pending(self) -> None:
@@ -1304,6 +1435,31 @@ class Scheduler:
         drains — against the corrected chain."""
         pd = self._pending.popleft()
         out = np.full((pd.n,), -1, np.int32)
+        try:
+            self._resolve_records(pd, out)
+        except Exception as e:
+            # XLA fault surfacing at readback/replay: degrade this drain
+            # (and the chained later ones) to the host oracle
+            self._device_fault_abort(pd, "commit", e)
+            return
+        names = self.state.node_names
+        assigned = out[out >= 0]
+        if ((out < -1).any() or (out >= len(names)).any()
+                or any(not names[int(a)] for a in assigned)):
+            # a garbage assignment tensor (the argmax of a non-finite
+            # score column lands here) must never reach the cache
+            self._device_fault_abort(pd, "invalid_assignment", ValueError(
+                f"device assignments out of range: {out.tolist()}"))
+            return
+        if pd.records:
+            self._record_device_success()
+        self.metrics.device_batch_duration.observe(
+            max(_time.perf_counter() - pd.dispatched_at, 0.0))
+        self._commit_assignments(pd, out)
+
+    def _resolve_records(self, pd: "_PendingDrain", out) -> None:
+        """Resolve a drain's device results into `out`, replaying inexact
+        uniform runs (and everything chained downstream) as needed."""
         idx = 0
         while idx < len(pd.records):
             rec = pd.records[idx]
@@ -1353,9 +1509,6 @@ class Scheduler:
             if self._device_carry is not None:
                 self._device_carry = carry
             idx += 1
-        self.metrics.device_batch_duration.observe(
-            max(_time.perf_counter() - pd.dispatched_at, 0.0))
-        self._commit_assignments(pd, out)
 
     def _commit_assignments(self, pd: _PendingDrain, out) -> int:
         """Host commit of a resolved drain: bulk assume + bind enqueue for

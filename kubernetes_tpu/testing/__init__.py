@@ -1,0 +1,1 @@
+from .chaos import ChaosAPIServer, ChaosConfig  # noqa: F401

@@ -1,0 +1,213 @@
+"""Seeded fault injection around the in-memory API server.
+
+`ChaosAPIServer` wraps `backend/apiserver.APIServer` and injects, from a
+seeded RNG with per-verb probabilities, the failure modes the reference
+tolerates every day (and the resilient commit pipeline must absorb):
+
+- transient errors (`ServerTimeout` / `TooManyRequests`) raised BEFORE the
+  call takes effect — the retriable class the dispatcher must retry;
+- Conflict storms on bind — the terminal class that must route through
+  the forget/requeue path;
+- added latency (via an injectable `sleep`, a no-op by default so tests
+  stay fast while the injected total is still recorded);
+- dropped / duplicated watch events on the pod and node streams — the
+  watch-loss scenario `Scheduler.resync()` recovers from;
+- node flaps: a random node deleted and immediately re-created between
+  API calls (delete + add events both fan out), mid-batch from the
+  scheduler's point of view.
+
+Determinism: every injection draws from ONE `random.Random(seed)`, so a
+given (seed, workload, call sequence) replays the same fault script —
+that's what makes the chaos parity soak a correctness gate instead of a
+flaky stress test. Injection counters (`injected_errors`,
+`injected_conflicts`, `dropped_events`, `duplicated_events`,
+`node_flaps`, `injected_latency_total`) let tests assert faults actually
+fired.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..backend.apiserver import (APIServer, Conflict, ServerTimeout,
+                                 TooManyRequests, WatchHandlers)
+
+# verbs accepted in ChaosConfig.error_rates
+VERBS = ("create", "update", "bind", "patch", "delete")
+
+
+@dataclass
+class ChaosConfig:
+    seed: int = 0
+    # per-verb transient-error probability (ServerTimeout/TooManyRequests,
+    # raised before the call takes effect): {"bind": 0.05, ...}
+    error_rates: dict[str, float] = field(default_factory=dict)
+    # Conflict storm probability on bind (terminal: forget/requeue path)
+    conflict_rate: float = 0.0
+    # added latency: probability per call, and the delay range drawn
+    latency_rate: float = 0.0
+    latency_seconds: tuple[float, float] = (0.001, 0.01)
+    # watch-stream chaos on pod/node events
+    drop_watch_rate: float = 0.0
+    dup_watch_rate: float = 0.0
+    # per-API-call probability of a node flap (delete + re-create)
+    node_flap_rate: float = 0.0
+
+    def validate(self) -> None:
+        unknown = set(self.error_rates) - set(VERBS)
+        if unknown:
+            raise ValueError(f"unknown chaos verbs {sorted(unknown)} "
+                             f"(known: {list(VERBS)})")
+
+
+class ChaosAPIServer:
+    """Fault-injecting facade; every attribute not overridden here
+    forwards to the wrapped server, so the scheduler (and the cache
+    debugger) sees the same surface."""
+
+    def __init__(self, inner: Optional[APIServer] = None,
+                 config: Optional[ChaosConfig] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        self.inner = inner if inner is not None else APIServer()
+        self.cfg = config or ChaosConfig()
+        self.cfg.validate()
+        self.rng = random.Random(self.cfg.seed)
+        # default sleep is a no-op: tests measure the injected total
+        # instead of burning wall clock; pass time.sleep for realism
+        self.sleep = sleep or (lambda _s: None)
+        self.injected_errors: dict[str, int] = {v: 0 for v in VERBS}
+        self.injected_conflicts = 0
+        self.dropped_events = 0
+        self.duplicated_events = 0
+        self.node_flaps = 0
+        self.injected_latency_total = 0.0
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    # -- injection core -------------------------------------------------------
+
+    def _maybe_flap(self) -> None:
+        cfg = self.cfg
+        if (cfg.node_flap_rate and self.inner.nodes
+                and self.rng.random() < cfg.node_flap_rate):
+            self.flap_node(self.rng.choice(sorted(self.inner.nodes)))
+
+    def flap_node(self, name: str) -> None:
+        """Delete + immediately re-create a node: both watch events fan
+        out (cache remove + add, device-state invalidation) but the store
+        is consistent again before the next verb executes."""
+        node = self.inner.nodes[name]
+        self.inner.delete_node(name)
+        self.inner.create_node(node)
+        self.node_flaps += 1
+
+    def _inject(self, verb: str) -> None:
+        """Run the fault script for one API call; raises the injected
+        error (before the call takes effect) or returns."""
+        cfg = self.cfg
+        self._maybe_flap()
+        if cfg.latency_rate and self.rng.random() < cfg.latency_rate:
+            lo, hi = cfg.latency_seconds
+            d = lo + (hi - lo) * self.rng.random()
+            self.injected_latency_total += d
+            self.sleep(d)
+        p = cfg.error_rates.get(verb, 0.0)
+        if p and self.rng.random() < p:
+            self.injected_errors[verb] += 1
+            cls = ServerTimeout if self.rng.random() < 0.5 else TooManyRequests
+            raise cls(f"chaos: injected transient error on {verb}")
+        if verb == "bind" and cfg.conflict_rate \
+                and self.rng.random() < cfg.conflict_rate:
+            self.injected_conflicts += 1
+            raise Conflict("chaos: injected conflict storm")
+
+    # -- watch chaos ----------------------------------------------------------
+
+    def _wrap_handlers(self, h: WatchHandlers) -> WatchHandlers:
+        cfg = self.cfg
+        if not cfg.drop_watch_rate and not cfg.dup_watch_rate:
+            return h
+
+        def mk(cb):
+            if cb is None:
+                return None
+
+            def chaotic(*args):
+                if cfg.drop_watch_rate \
+                        and self.rng.random() < cfg.drop_watch_rate:
+                    self.dropped_events += 1
+                    return
+                cb(*args)
+                if cfg.dup_watch_rate \
+                        and self.rng.random() < cfg.dup_watch_rate:
+                    self.duplicated_events += 1
+                    cb(*args)
+            return chaotic
+
+        # bulk adds stay intact: they are the ingest fast path, and the
+        # per-pod stream already gives drop/dup coverage
+        return WatchHandlers(on_add=mk(h.on_add), on_update=mk(h.on_update),
+                             on_delete=mk(h.on_delete),
+                             on_add_bulk=h.on_add_bulk)
+
+    def watch_pods(self, h: WatchHandlers) -> None:
+        self.inner.watch_pods(self._wrap_handlers(h))
+
+    def watch_nodes(self, h: WatchHandlers) -> None:
+        self.inner.watch_nodes(self._wrap_handlers(h))
+
+    # -- injected verbs -------------------------------------------------------
+
+    def create_pod(self, pod):
+        self._inject("create")
+        return self.inner.create_pod(pod)
+
+    def create_pods(self, pods):
+        self._inject("create")
+        return self.inner.create_pods(pods)
+
+    def update_pod(self, pod):
+        self._inject("update")
+        return self.inner.update_pod(pod)
+
+    def delete_pod(self, uid: str):
+        self._inject("delete")
+        return self.inner.delete_pod(uid)
+
+    def bind(self, pod, node_name: str):
+        self._inject("bind")
+        return self.inner.bind(pod, node_name)
+
+    def bind_all(self, pairs):
+        """Per-pair injection: the injected subset fails (transient or
+        conflict), the rest passes through to the real bulk bind."""
+        self._maybe_flap()
+        cfg = self.cfg
+        failures = []
+        pass_through = []
+        for pair in pairs:
+            p = cfg.error_rates.get("bind", 0.0)
+            if p and self.rng.random() < p:
+                self.injected_errors["bind"] += 1
+                cls = (ServerTimeout if self.rng.random() < 0.5
+                       else TooManyRequests)
+                failures.append((pair[0], cls(
+                    "chaos: injected transient error on bind")))
+            elif cfg.conflict_rate \
+                    and self.rng.random() < cfg.conflict_rate:
+                self.injected_conflicts += 1
+                failures.append((pair[0], Conflict(
+                    "chaos: injected conflict storm")))
+            else:
+                pass_through.append(pair)
+        if pass_through:
+            failures.extend(self.inner.bind_all(pass_through))
+        return failures
+
+    def patch_pod_status(self, pod, condition, nominated_node_name=None):
+        self._inject("patch")
+        return self.inner.patch_pod_status(pod, condition,
+                                           nominated_node_name)

@@ -1,0 +1,333 @@
+"""Chaos: seeded fault injection against the resilient commit pipeline.
+
+The standing correctness gate this file establishes (ISSUE 2): a seeded
+fault script — transient bind/patch/delete errors, added latency, a node
+flap — must leave the final (pod → node) assignment IDENTICAL to the
+fault-free run of the same workload, because retries absorb every
+transient and terminal errors route through forget/requeue. Plus: the
+device-tier circuit breaker (XLA fault → host path → cooldown →
+re-probe), watch-loss recovery via resync(), and a long mixed soak
+(marked slow; CHAOS_SEED=N overrides the script seed).
+"""
+
+import dataclasses
+import os
+import random
+
+import pytest
+
+import kubernetes_tpu.scheduler as sched_mod
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.chaos import ChaosAPIServer, ChaosConfig
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _no_sleep(sched):
+    """Retries must not burn wall clock in tests."""
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _pod_specs(n, seed, prefix="p"):
+    """Deterministic mixed workload: (name, cpu_m, mem_mi) triples."""
+    rng = random.Random(seed)
+    return [(f"{prefix}{i}", 250 * rng.randint(1, 6), 512 * rng.randint(1, 4))
+            for i in range(n)]
+
+
+def _create(api, specs):
+    for name, cpu, mem in specs:
+        api.create_pod(make_pod(name)
+                       .req({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}).obj())
+
+
+def _nodes(api, n=6, cpu=16, mem="32Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _cordon(api, name, value):
+    node = api.nodes[name]
+    spec = dataclasses.replace(node.spec, unschedulable=value)
+    api.update_node(dataclasses.replace(node, spec=spec))
+
+
+def _drive_to_quiescence(api, sched, clock, want_bound, max_rounds=60):
+    """Advance time + flush until every pod binds (backoffs expire in
+    between); asserts progress terminates."""
+    for _ in range(max_rounds):
+        sched.schedule_pending()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= want_bound:
+            return
+        clock.t += 10.0
+        sched.flush_queues()
+    raise AssertionError(
+        f"did not quiesce: "
+        f"{sum(1 for p in api.pods.values() if p.spec.node_name)}"
+        f"/{want_bound} bound, pending={sched.pending_summary()}")
+
+
+def _assignments(api):
+    return {uid: p.spec.node_name for uid, p in api.pods.items()}
+
+
+def _run_parity_workload(api):
+    """The parity workload: two clean waves with a mid-run node flap (the
+    chaotic twin only — the store is identical again before the next
+    call), then a cordon-everything wave that strands a whole batch
+    (Unschedulable status patches flow in BOTH runs), then uncordon +
+    drain to fully bound."""
+    clock = Clock()
+    sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+    _create(api, _pod_specs(20, seed=100, prefix="a"))
+    sched.schedule_pending()
+    if isinstance(api, ChaosAPIServer):
+        api.flap_node("n2")   # the one scripted node flap, mid-run
+    _create(api, _pod_specs(16, seed=200, prefix="b"))
+    sched.schedule_pending()
+    # cordon EVERY node: the next wave fully strands → status patches
+    # (the patch-verb fault path) flow through the dispatcher
+    for name in list(api.nodes):
+        _cordon(api, name, True)
+    _create(api, _pod_specs(18, seed=300, prefix="c"))
+    sched.schedule_pending()
+    for name in list(api.nodes):
+        _cordon(api, name, False)
+    clock.t += 40.0
+    sched.flush_queues()
+    _drive_to_quiescence(api, sched, clock, want_bound=54)
+    return sched
+
+
+def test_chaos_parity():
+    """Acceptance gate: ≥5% transient error rate on bind/patch/delete +
+    one node flap ⇒ all pods bind and the assignment map is identical to
+    the fault-free run."""
+    clean_api = APIServer()
+    _nodes(clean_api)
+    _run_parity_workload(clean_api)
+    clean = _assignments(clean_api)
+    assert len(clean) == 54 and all(clean.values()), \
+        "fault-free run must bind everything"
+
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED,
+        error_rates={"bind": 0.10, "patch": 0.10, "delete": 0.10},
+        latency_rate=0.25, latency_seconds=(0.001, 0.05)))
+    _nodes(chaos)
+    sched = _run_parity_workload(chaos)
+    chaotic = _assignments(chaos.inner)
+
+    assert chaotic == clean
+    # the script must have actually fired: injected transients were
+    # retried (not surfaced), the flap really happened, latency was drawn
+    assert chaos.injected_errors["bind"] > 0
+    assert chaos.injected_errors["patch"] > 0
+    assert chaos.node_flaps == 1
+    assert chaos.injected_latency_total > 0
+    assert sched.dispatcher.retries > 0
+    assert sched.metrics.api_retries.value("pod_binding") > 0
+    # retries absorbed every transient: zero terminal dispatcher errors
+    assert sched.dispatcher.errors == 0
+    assert not sched.cache.assumed_pods
+
+
+def test_conflict_storm_routes_through_forget_requeue():
+    """Conflicts are TERMINAL: no retry — forget the assumed pod, requeue
+    with error backoff, and still converge to fully bound."""
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(seed=SEED, conflict_rate=0.3))
+    _nodes(chaos, n=4)
+    sched = _no_sleep(Scheduler(chaos, batch_size=16, clock=clock))
+    _create(chaos, _pod_specs(24, seed=400))
+    _drive_to_quiescence(chaos, sched, clock, want_bound=24)
+    assert chaos.injected_conflicts > 0
+    assert sched.error_count > 0          # each storm hit the forget path
+    assert sched.dispatcher.retries == 0  # terminal ⇒ never retried
+    assert not sched.cache.assumed_pods
+    assert sched.reconcile() == []
+
+
+def test_watch_loss_resync_recovers():
+    """Dropped watch events corrupt the scheduler's view (missed pod
+    adds, missed bind confirmations, missed node adds); resync() rebuilds
+    cache+queue from a fresh LIST and the cluster converges clean."""
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED, drop_watch_rate=0.3))
+    sched = _no_sleep(Scheduler(chaos, batch_size=16, clock=clock))
+    _nodes(chaos, n=5)          # registered AFTER the scheduler: droppable
+    _create(chaos, _pod_specs(30, seed=500))
+    sched.schedule_pending()
+    assert chaos.dropped_events > 0
+    # stop the bleeding, then recover from the store's truth
+    chaos.cfg.drop_watch_rate = 0.0
+    sched.resync()
+    assert sched.metrics.resyncs.value() == 1
+    _drive_to_quiescence(chaos, sched, clock, want_bound=30)
+    assert not sched.cache.assumed_pods
+    assert sched.debugger.compare() == []
+    assert sched.reconcile() == []
+
+
+def test_device_fault_circuit_breaker(monkeypatch):
+    """Forced kernel fault: the batch completes on the host path (no
+    crash, no lost pods); K consecutive faults open the breaker; the
+    cooldown re-probes the device tier and closes it — both transitions
+    visible in metrics."""
+    clock = Clock()
+    api = APIServer()
+    _nodes(api, n=4)
+    sched = _no_sleep(Scheduler(api, batch_size=16, clock=clock))
+    m = sched.metrics
+
+    real_run_batch = sched_mod.run_batch
+    real_run_uniform = sched_mod.run_uniform
+
+    def boom(*_a, **_k):
+        raise RuntimeError("injected xla fault")
+
+    monkeypatch.setattr(sched_mod, "run_batch", boom)
+    monkeypatch.setattr(sched_mod, "run_uniform", boom)
+
+    bound = 0
+    for wave in range(sched.device_fault_threshold):
+        _create(api, _pod_specs(6, seed=600 + wave, prefix=f"w{wave}-"))
+        bound += 6
+        sched.schedule_pending()
+        assert sum(1 for p in api.pods.values() if p.spec.node_name) == bound
+    assert sched.device_fallbacks == sched.device_fault_threshold
+    assert m.circuit_breaker_transitions.value("open") == 1
+    assert m.device_fallbacks.value("dispatch") == sched.device_fault_threshold
+
+    # breaker open: drains route to the host oracle WITHOUT touching the
+    # (still broken) device tier
+    _create(api, _pod_specs(6, seed=690, prefix="open-"))
+    bound += 6
+    sched.schedule_pending()
+    assert sum(1 for p in api.pods.values() if p.spec.node_name) == bound
+    assert m.device_fallbacks.value("circuit_open") >= 1
+    assert m.circuit_breaker_transitions.value("open") == 1  # no flapping
+
+    # device recovers; cooldown expires → probe drain closes the breaker
+    monkeypatch.setattr(sched_mod, "run_batch", real_run_batch)
+    monkeypatch.setattr(sched_mod, "run_uniform", real_run_uniform)
+    clock.t += sched.device_fault_cooldown + 1.0
+    before = sched.device_batches
+    _create(api, _pod_specs(6, seed=700, prefix="probe-"))
+    bound += 6
+    sched.schedule_pending()
+    assert sum(1 for p in api.pods.values() if p.spec.node_name) == bound
+    assert sched.device_batches > before          # device tier re-enabled
+    assert m.circuit_breaker_transitions.value("closed") == 1
+    assert sched.reconcile() == []
+
+
+def test_invalid_assignment_tensor_falls_back(monkeypatch):
+    """A garbage assignment tensor (the argmax of a non-finite score
+    column) must never reach the cache: the drain degrades to the host
+    oracle and every pod still binds."""
+    clock = Clock()
+    api = APIServer()
+    _nodes(api, n=4)
+    sched = _no_sleep(Scheduler(api, batch_size=16, clock=clock))
+    real_run_batch = sched_mod.run_batch
+
+    def corrupt(*a, **k):
+        import jax.numpy as jnp
+        carry, assigns = real_run_batch(*a, **k)
+        return carry, jnp.full_like(assigns, 1 << 20)
+
+    monkeypatch.setattr(sched_mod, "run_batch", corrupt)
+    _create(api, _pod_specs(8, seed=800))
+    sched.schedule_pending()
+    assert sum(1 for p in api.pods.values() if p.spec.node_name) == 8
+    assert sched.metrics.device_fallbacks.value("invalid_assignment") >= 1
+    assert not sched.cache.assumed_pods
+
+
+@pytest.mark.slow
+def test_chaos_soak():
+    """Long mixed soak under the FULL fault script (transients, conflict
+    storms, latency, node flaps, dropped+duplicated watch events with
+    periodic resync): no crash, no lost pods, clean convergence.
+    CHAOS_SEED=N replays a specific script."""
+    rng = random.Random(SEED)
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED,
+        error_rates={"bind": 0.08, "patch": 0.08, "delete": 0.08,
+                     "create": 0.02},
+        conflict_rate=0.05,
+        latency_rate=0.2, latency_seconds=(0.001, 0.05),
+        drop_watch_rate=0.03, dup_watch_rate=0.03,
+        node_flap_rate=0.02))
+    sched = _no_sleep(Scheduler(chaos, batch_size=32, clock=clock))
+    n_nodes = 24    # ~380 live pods by the end: size the cluster for them
+    _nodes(chaos, n=n_nodes, cpu=32, mem="64Gi")
+    seq = 0
+    live = []
+    dropped_seen = 0
+    for wave in range(120):
+        action = rng.random()
+        if action < 0.55:
+            for _ in range(rng.randint(3, 10)):
+                name = f"s{seq}"
+                seq += 1
+                try:
+                    chaos.create_pod(make_pod(name).req(
+                        {"cpu": f"{rng.randint(1, 6) * 250}m",
+                         "memory": f"{rng.randint(1, 4) * 512}Mi"}).obj())
+                except Exception:
+                    continue    # injected create fault: client gives up
+                live.append(f"default/{name}")
+        elif action < 0.72 and live:
+            for _ in range(rng.randint(1, 4)):
+                if not live:
+                    break
+                uid = live.pop(rng.randrange(len(live)))
+                if uid in chaos.pods:
+                    try:
+                        chaos.delete_pod(uid)
+                    except Exception:
+                        live.append(uid)    # injected fault: still alive
+        elif action < 0.85:
+            chaos.flap_node(f"n{rng.randrange(n_nodes)}")
+        else:
+            clock.t += rng.choice([5.0, 40.0, 400.0])
+            sched.flush_queues()
+        sched.schedule_pending()
+        if chaos.dropped_events > dropped_seen:
+            # the watch layer reported loss since last wave: relist
+            sched.resync()
+            dropped_seen = chaos.dropped_events
+        for p in chaos.pods.values():
+            if p.spec.node_name:
+                assert p.spec.node_name in chaos.nodes
+    # final convergence: stop watch chaos (a real client resyncs after
+    # loss; ours did above), drain everything outstanding
+    chaos.cfg.drop_watch_rate = chaos.cfg.dup_watch_rate = 0.0
+    chaos.cfg.node_flap_rate = 0.0
+    sched.resync()
+    want = len(chaos.pods)
+    _drive_to_quiescence(chaos, sched, clock, want_bound=want,
+                         max_rounds=120)
+    assert not sched.cache.assumed_pods
+    assert sched.debugger.compare() == []
+    assert chaos.injected_errors["bind"] > 0
+    assert chaos.node_flaps > 0
+    assert chaos.dropped_events > 0

@@ -1,0 +1,290 @@
+"""APIDispatcher unit coverage: retry/backoff classification, flush
+ordering (victim DELETEs before binds), the DELETE-outranks-bind leak fix,
+is_delete_pending, and the STATUS_PATCH merge path."""
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import (APIServer, Conflict, NotFound,
+                                              ServerTimeout, TooManyRequests,
+                                              is_retriable)
+from kubernetes_tpu.backend.dispatcher import (APICall, APIDispatcher,
+                                               CallType)
+from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class ScriptedClient:
+    """Records call order; raises scripted errors (a list per op key,
+    consumed one per call)."""
+
+    def __init__(self):
+        self.calls = []
+        self.fail = {}   # key -> list of exceptions to raise, in order
+
+    def _maybe_fail(self, key):
+        errs = self.fail.get(key)
+        if errs:
+            raise errs.pop(0)
+
+    def bind(self, pod, node_name):
+        self.calls.append(("bind", pod.uid, node_name))
+        self._maybe_fail("bind")
+
+    def delete_pod(self, uid):
+        self.calls.append(("delete", uid))
+        self._maybe_fail("delete")
+
+    def patch_pod_status(self, pod, condition, nominated_node_name=None):
+        self.calls.append(("patch", pod.uid, nominated_node_name))
+        self._maybe_fail("patch")
+
+
+class BulkClient(ScriptedClient):
+    def __init__(self):
+        super().__init__()
+        self.bind_all_failures = []   # one list per bind_all invocation
+
+    def bind_all(self, pairs):
+        self.calls.append(("bind_all", tuple(p.uid for p, _ in pairs)))
+        if self.bind_all_failures:
+            wanted = self.bind_all_failures.pop(0)
+            return [(p, e) for p, _o in pairs for uid, e in wanted
+                    if p.uid == uid]
+        return []
+
+
+def _dispatcher(client, **kw):
+    kw.setdefault("sleep", lambda _s: None)
+    return APIDispatcher(client=client, **kw)
+
+
+def _pod(name, node=""):
+    w = make_pod(name)
+    if node:
+        w = w.node(node)
+    return w.obj()
+
+
+def test_retriable_classification():
+    assert is_retriable(ServerTimeout("x"))
+    assert is_retriable(TooManyRequests("x"))
+    assert not is_retriable(Conflict("x"))
+    assert not is_retriable(NotFound("x"))
+    assert not is_retriable(RuntimeError("x"))
+
+
+def test_transient_bind_retries_until_success():
+    c = ScriptedClient()
+    c.fail["bind"] = [ServerTimeout("t"), TooManyRequests("t")]
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append(e))
+    d.metrics = SchedulerMetrics()
+    d.add(APICall(CallType.BIND, _pod("a"), node_name="n1"))
+    d.flush()
+    assert [k for k, *_ in c.calls] == ["bind", "bind", "bind"]
+    assert errors == []
+    assert d.retries == 2 and d.errors == 0 and d.executed == 1
+    assert d.metrics.api_retries.value(CallType.BIND.value) == 2
+
+
+def test_retry_budget_exhaustion_routes_bind_error():
+    c = ScriptedClient()
+    c.fail["bind"] = [ServerTimeout("t")] * 10
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append(e),
+                    retry_max_attempts=3)
+    d.add(APICall(CallType.BIND, _pod("a"), node_name="n1"))
+    d.flush()
+    assert len([k for k, *_ in c.calls if k == "bind"]) == 3
+    assert len(errors) == 1 and isinstance(errors[0], ServerTimeout)
+    assert d.errors == 1
+
+
+def test_terminal_conflict_not_retried():
+    c = ScriptedClient()
+    c.fail["bind"] = [Conflict("taken")]
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append(e))
+    d.add(APICall(CallType.BIND, _pod("a"), node_name="n1"))
+    d.flush()
+    assert len([k for k, *_ in c.calls if k == "bind"]) == 1
+    assert len(errors) == 1 and d.retries == 0
+
+
+def test_delete_retries_too():
+    """A victim DELETE must survive transient errors — otherwise a
+    preemptor wave half-commits."""
+    c = ScriptedClient()
+    c.fail["delete"] = [ServerTimeout("t")]
+    d = _dispatcher(c)
+    d.add(APICall(CallType.DELETE, _pod("victim")))
+    d.flush()
+    assert [k for k, *_ in c.calls] == ["delete", "delete"]
+    assert d.errors == 0 and d.executed == 1
+
+
+def test_backoff_grows_exponentially_with_jitter():
+    delays = []
+    c = ScriptedClient()
+    c.fail["bind"] = [ServerTimeout("t")] * 4
+    d = APIDispatcher(client=c, sleep=delays.append,
+                      retry_max_attempts=5, retry_base_seconds=0.1,
+                      retry_max_delay_seconds=100.0)
+    d.add(APICall(CallType.BIND, _pod("a"), node_name="n1"))
+    d.flush()
+    assert len(delays) == 4
+    for i, dt in enumerate(delays):
+        base = 0.1 * 2 ** i
+        assert base * 0.5 <= dt <= base   # equal jitter band
+
+
+def test_bulk_bind_retries_only_retriable_subset():
+    c = BulkClient()
+    pods = [_pod(f"p{i}", "n1") for i in range(3)]
+    # first bind_all: p0 transient, p1 terminal conflict; retry round clean
+    c.bind_all_failures = [[(pods[0].uid, ServerTimeout("t")),
+                            (pods[1].uid, Conflict("taken"))]]
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append((p.uid, e)))
+    d.add_binds([(p, p) for p in pods])
+    d.flush()
+    bulk = [args for k, args in c.calls if k == "bind_all"]
+    assert bulk[0] == (pods[0].uid, pods[1].uid, pods[2].uid)
+    assert bulk[1] == (pods[0].uid,)          # only the transient retried
+    assert [uid for uid, _ in errors] == [pods[1].uid]
+    assert d.retries == 1 and d.errors == 1 and d.executed == 2
+
+
+def test_flush_executes_deletes_before_bulk_binds():
+    """A preemptor wave's victims must leave the store before the
+    preemptors bind (reference relevance ordering end to end)."""
+    c = BulkClient()
+    d = _dispatcher(c)
+    preemptor = _pod("preemptor", "n1")
+    d.add_binds([(preemptor, preemptor)])
+    d.add(APICall(CallType.DELETE, _pod("victim")))
+    d.add(APICall(CallType.STATUS_PATCH, _pod("loser"), condition={"type": "x"}))
+    d.flush()
+    kinds = [k for k, *_ in c.calls]
+    assert kinds.index("delete") < kinds.index("bind_all")
+    assert kinds.index("bind_all") < kinds.index("patch")
+    assert len(d) == 0
+
+
+def test_add_bind_superseded_by_delete_routes_bind_error():
+    c = ScriptedClient()
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append((p.uid, n, e)))
+    victim = _pod("v")
+    d.add(APICall(CallType.DELETE, victim))
+    d.add(APICall(CallType.BIND, victim, node_name="n1"))
+    assert [u for u, _, _ in errors] == [victim.uid]
+    assert errors[0][1] == "n1"
+    assert isinstance(errors[0][2], Conflict)
+    # the DELETE stays queued; no bind ever executes for the victim
+    d.flush()
+    assert [k for k, *_ in c.calls] == ["delete"]
+
+
+def test_add_binds_superseded_by_delete_routes_bind_error():
+    c = BulkClient()
+    errors = []
+    d = _dispatcher(c, on_bind_error=lambda p, n, e: errors.append(p.uid))
+    victim = _pod("v", "n1")
+    other = _pod("o", "n2")
+    d.add(APICall(CallType.DELETE, victim))
+    d.add_binds([(victim, victim), (other, other)])
+    assert errors == [victim.uid]
+    d.flush()
+    bulk = [args for k, args in c.calls if k == "bind_all"]
+    assert bulk == [(other.uid,)]
+
+
+def test_is_delete_pending_lifecycle():
+    c = ScriptedClient()
+    d = _dispatcher(c)
+    victim = _pod("v")
+    assert not d.is_delete_pending(victim.uid)
+    d.add(APICall(CallType.DELETE, victim))
+    assert d.is_delete_pending(victim.uid)
+    # a pending BIND is not a pending delete
+    other = _pod("o")
+    d.add(APICall(CallType.BIND, other, node_name="n1"))
+    assert not d.is_delete_pending(other.uid)
+    d.flush()
+    assert not d.is_delete_pending(victim.uid)
+
+
+def test_status_patch_merge_carries_nominated_node_name():
+    """call_queue.go Merge: the newer condition wins but an unset
+    nominated_node_name must not drop the pending call's."""
+    c = ScriptedClient()
+    d = _dispatcher(c)
+    pod = _pod("p")
+    d.add(APICall(CallType.STATUS_PATCH, pod,
+                  condition={"type": "PodScheduled", "reason": "old"},
+                  nominated_node_name="n7"))
+    d.add(APICall(CallType.STATUS_PATCH, pod,
+                  condition={"type": "PodScheduled", "reason": "new"}))
+    d.flush()
+    assert c.calls == [("patch", pod.uid, "n7")]
+
+
+def test_status_patch_merge_explicit_clear_wins():
+    """'' clears the nomination (preemption demotion) — it must NOT be
+    treated like unset and resurrected from the pending call."""
+    c = ScriptedClient()
+    d = _dispatcher(c)
+    pod = _pod("p")
+    d.add(APICall(CallType.STATUS_PATCH, pod, condition={"type": "x"},
+                  nominated_node_name="n7"))
+    d.add(APICall(CallType.STATUS_PATCH, pod, condition={"type": "x"},
+                  nominated_node_name=""))
+    d.flush()
+    assert c.calls == [("patch", pod.uid, "")]
+
+
+def test_status_patch_merge_carries_condition():
+    c = ScriptedClient()
+    d = _dispatcher(c)
+    pod = _pod("p")
+    d.add(APICall(CallType.STATUS_PATCH, pod,
+                  condition={"type": "PodScheduled", "reason": "keep"}))
+    d.add(APICall(CallType.STATUS_PATCH, pod, nominated_node_name="n3"))
+    d.flush()
+    # nominated from the newer call, condition carried from the pending
+    assert c.calls == [("patch", pod.uid, "n3")]
+
+
+def test_status_patch_merge_against_apiserver():
+    """End to end against the real store: the merged patch lands both the
+    nomination carry-over and the newest condition."""
+    api = APIServer()
+    api.create_node(make_node("n1").obj())
+    pod = _pod("p")
+    api.create_pod(pod)
+    d = _dispatcher(api)
+    d.add(APICall(CallType.STATUS_PATCH, pod,
+                  condition={"type": "PodScheduled", "status": "False",
+                             "reason": "Unschedulable"},
+                  nominated_node_name="n1"))
+    d.add(APICall(CallType.STATUS_PATCH, pod,
+                  condition={"type": "PodScheduled", "status": "False",
+                             "reason": "SchedulerError"}))
+    d.flush()
+    stored = api.get_pod(pod.uid)
+    assert stored.status.nominated_node_name == "n1"
+    assert [c["reason"] for c in stored.status.conditions] == ["SchedulerError"]
+
+
+def test_metrics_outcome_counters():
+    c = ScriptedClient()
+    c.fail["patch"] = [Conflict("x")]
+    d = _dispatcher(c)
+    d.metrics = SchedulerMetrics()
+    d.add(APICall(CallType.STATUS_PATCH, _pod("a"), condition={"type": "x"}))
+    d.add(APICall(CallType.DELETE, _pod("b")))
+    d.flush()
+    m = d.metrics.api_dispatcher_calls
+    assert m.value(CallType.STATUS_PATCH.value, "error") == 1
+    assert m.value(CallType.DELETE.value, "success") == 1

@@ -7,6 +7,16 @@ import jax
 import numpy as np
 import pytest
 
+# parallel/sharding.py needs SOME shard_map API: jax.shard_map (0.5+) or
+# jax.experimental.shard_map (older). Without either, report the whole
+# module as skipped instead of 10 collection/runtime failures.
+if not hasattr(jax, "shard_map"):
+    try:
+        from jax.experimental.shard_map import shard_map as _probe  # noqa: F401
+    except ImportError:
+        pytest.skip("no shard_map API in this jax build",
+                    allow_module_level=True)
+
 from kubernetes_tpu.backend.cache import Cache, Snapshot
 from kubernetes_tpu.ops.program import (ScoreConfig, initial_carry,
                                         pod_rows_from_batch, run_batch)
