@@ -165,7 +165,9 @@ def main() -> None:
             passes.append(got[0][0])
         passes.sort(key=lambda it: it.average)
         item = passes[len(passes) // 2]
-        entry_extra = {}
+        # per-phase drain breakdown + wave-placement stats of the median
+        # pass (scheduler metrics; harness DataItem.extras)
+        entry_extra = dict(item.extras)
         if case == "PreemptionChurn":
             waves = sorted(dict(it.op_seconds).get(PREEMPTION_WAVE_OP, 0.0)
                            for it in passes)

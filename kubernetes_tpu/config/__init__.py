@@ -71,6 +71,9 @@ class KubeSchedulerConfiguration:
     # doubles per retry (with jitter) in the dispatcher
     api_retry_max_attempts: int = 5
     api_retry_base_seconds: float = 0.02
+    # persistent XLA compilation cache directory: warm-start passes skip
+    # the 20-40s per-executable compiles entirely (empty string = off)
+    compilation_cache_dir: str = "~/.cache/ktpu-xla"
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
@@ -139,6 +142,7 @@ class KubeSchedulerConfiguration:
             "batchSize": self.batch_size,
             "apiRetryMaxAttempts": self.api_retry_max_attempts,
             "apiRetryBaseSeconds": self.api_retry_base_seconds,
+            "compilationCacheDir": self.compilation_cache_dir,
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
         }
@@ -180,6 +184,8 @@ class KubeSchedulerConfiguration:
             batch_size=d.get("batchSize", 512),
             api_retry_max_attempts=d.get("apiRetryMaxAttempts", 5),
             api_retry_base_seconds=d.get("apiRetryBaseSeconds", 0.02),
+            compilation_cache_dir=d.get("compilationCacheDir",
+                                        "~/.cache/ktpu-xla"),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))
 
@@ -191,6 +197,40 @@ def load(path: str) -> KubeSchedulerConfiguration:
         cfg = KubeSchedulerConfiguration.from_dict(yaml.safe_load(f) or {})
     cfg.validate()
     return cfg
+
+
+_cc_applied = False
+
+
+def apply_compilation_cache(path: str | None = None) -> bool:
+    """Enable jax's persistent compilation cache (once per process).
+
+    The scheduler mints a handful of big executables (scan buckets,
+    uniform L/K/J variants, wave kernels) whose XLA compiles dominate
+    cold-start — PreemptionChurn's warm pass alone was ~41s of compiles.
+    The on-disk cache survives process restarts, so every pass after the
+    first machine-wide warm-up starts hot. `path` defaults to the
+    `compilation_cache_dir` knob's default (~/.cache/ktpu-xla); empty
+    string or "off" disables. Returns True when the cache is active."""
+    global _cc_applied
+    if _cc_applied:
+        return True
+    import os
+    if path is None:
+        path = os.environ.get("KTPU_XLA_CACHE_DIR", "~/.cache/ktpu-xla")
+    if not path or path == "off":
+        return False
+    try:
+        import jax
+        full = os.path.expanduser(path)
+        os.makedirs(full, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", full)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        # cache is an optimization — never fail scheduler construction
+        return False
+    _cc_applied = True
+    return True
 
 
 def _default_plugin_names() -> list[str]:

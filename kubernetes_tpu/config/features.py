@@ -52,6 +52,11 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # per-candidate-node host sweep becomes one gathered kernel; off =
     # the host loop (still PreFilter-hoisted) for every preemption
     "BatchedPreemptionDryRun": FeatureSpec(True, BETA),
+    # speculative wave placement for group (spread / inter-pod affinity)
+    # drains: conflict-checked parallel placement on device with exact
+    # serial-order parity (ops/program.py run_wave); off = the host
+    # greedy / per-pod scan paths for every group drain
+    "SpeculativeWavePlacement": FeatureSpec(True, BETA),
 }
 
 

@@ -133,6 +133,16 @@ class Histogram:
         self._sums[key] = self._sums.get(key, 0.0) + float(v.sum())
         self._totals[key] = self._totals.get(key, 0) + int(v.size)
 
+    def seed(self, *labels: str) -> None:
+        """Materialize a zero-count series so exposition always carries it
+        (dashboards and bench_metrics.prom see the series before the first
+        observation)."""
+        key = tuple(labels)
+        if key not in self._counts:
+            self._counts[key] = [0] * (len(self.buckets) + 1)
+            self._sums[key] = 0.0
+            self._totals[key] = 0
+
     def count(self, *labels: str) -> int:
         return self._totals.get(tuple(labels), 0)
 
@@ -274,6 +284,27 @@ class SchedulerMetrics:
             n + "resyncs_total",
             "Full cache+queue rebuilds from a fresh LIST (watch-stream "
             "loss recovery)."))
+        self.wave_placement_waves = r.register(Counter(
+            n + "wave_placement_waves_total",
+            "Speculative placement waves executed on device (group "
+            "drains: merge waves + wave-scan dispatches)."))
+        self.wave_conflict_ratio = r.register(Histogram(
+            n + "wave_conflict_ratio",
+            "Per-drain fraction of pods whose speculative wave placement "
+            "conflicted (prefix cuts + serially repaired pods over the "
+            "span).",
+            buckets=[0.0, 0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0]))
+        self.wave_accepted_prefix = r.register(Histogram(
+            n + "wave_accepted_prefix_len",
+            "Conflict-free prefix length accepted by the first wave of "
+            "each group drain.",
+            buckets=[1, 4, 16, 64, 256, 1024, 4096]))
+        self.drain_phase = r.register(Histogram(
+            n + "drain_phase_seconds",
+            "Per-drain wall time by phase: host_build (snapshot + batch "
+            "+ group seeding), device (dispatch + readback wait), commit "
+            "(assume + bind enqueue + failure handling).",
+            label_names=("phase",)))
         # pre-seed the zero samples so dashboards (and bench_metrics.prom)
         # always carry the fault-path series, faults or not
         from ..backend.dispatcher import CallType
@@ -283,6 +314,11 @@ class SchedulerMetrics:
                        "circuit_open"):
             self.device_fallbacks.inc(reason, by=0)
         self.resyncs.inc(by=0)
+        self.wave_placement_waves.inc(by=0)
+        self.wave_conflict_ratio.seed()
+        self.wave_accepted_prefix.seed()
+        for phase in ("host_build", "device", "commit"):
+            self.drain_phase.seed(phase)
 
     def exposition(self) -> str:
         return self.registry.exposition()

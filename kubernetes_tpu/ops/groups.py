@@ -71,6 +71,7 @@ class GroupsDev(NamedTuple):
     spr_f_self: object        # i32 [U, SC] — selfMatchNum (filtering.go:338)
     spr_f_tv: object          # i32 [U, SC, N] — node's interned topo value (0 = absent)
     spr_f_elig: object        # bool [U, SC, N] — counted node (keys + inclusion)
+    spr_f_dom: object         # i32 [U, SC, N] — dense domain id (wave fold)
     # spread ScheduleAnyway constraints (scoring.go)
     spr_s_active: object      # bool [U, SC]
     spr_s_max_skew: object    # i32 [U, SC]
@@ -82,12 +83,16 @@ class GroupsDev(NamedTuple):
     # inter-pod affinity required terms (filtering.go)
     ipa_ra_active: object     # bool [U, TA]
     ipa_ra_tv: object         # i32 [U, TA, N]
+    ipa_ra_dom: object        # i32 [U, TA, N] — dense domain id (wave fold)
     ipa_raa_active: object    # bool [U, TAA]
     ipa_raa_tv: object        # i32 [U, TAA, N]
+    ipa_raa_dom: object       # i32 [U, TAA, N]
     ipa_self_all: object      # bool [U] — pod matches all own affinity terms
     # inter-pod affinity score terms (scoring.go)
     ipa_stc_tv: object        # i32 [U, CT, N] — consumer (incoming) pref terms
+    ipa_stc_dom: object       # i32 [U, CT, N]
     ipa_stp_tv: object        # i32 [U, PT, N] — placed (existing) side terms
+    ipa_stp_dom: object       # i32 [U, PT, N]
     # pairwise signature match matrices [placed-row, consumer-row, ...]
     m_spr_f: object           # bool [U, U, SC]
     m_spr_s: object           # bool [U, U, SC]
@@ -228,77 +233,116 @@ def spread_dry_run_tensors(s, pod, cand_infos, victims, c_pad: int,
 # device kernels
 
 
+class GroupView(NamedTuple):
+    """One signature row's gathered group tensors — the shared input of
+    `group_mask_view` / `group_scores_view`. Built by `view_of` (gather
+    from GroupsDev/GroupCarry by tidx) on the scan path, and from the
+    wave kernel's maintained in-scan counters (ops/program.py run_wave) —
+    both paths evaluate the SAME formula code."""
+
+    f_act: object       # bool [SC]
+    f_skew: object      # i32 [SC]
+    f_self: object      # i32 [SC]
+    f_minz: object      # bool [SC]
+    f_tv: object        # i32 [SC, N]
+    f_elig: object      # bool [SC, N]
+    f_cnt: object       # i32 [SC, N]
+    s_act: object       # bool [SC]
+    s_skew: object      # i32 [SC]
+    s_is_host: object   # bool [SC]
+    s_tv: object        # i32 [SC, N]
+    s_keys_ok: object   # bool [N]
+    s_dom: object       # i32 [SC, N]
+    s_cnt: object       # i32 [SC, N]
+    ra_act: object      # bool [TA]
+    ra_tv: object       # i32 [TA, N]
+    raa_act: object     # bool [TAA]
+    raa_tv: object      # i32 [TAA, N]
+    self_all: object    # bool
+    veto: object        # i32 [N]
+    a_cnt: object       # i32 [TA, N]
+    a_total: object     # i64
+    aa_cnt: object      # i32 [TAA, N]
+    iscore: object      # i64 [N]
+
+
+def view_of(gd: GroupsDev, gc: GroupCarry, tidx) -> GroupView:
+    return GroupView(
+        f_act=gd.spr_f_active[tidx], f_skew=gd.spr_f_max_skew[tidx],
+        f_self=gd.spr_f_self[tidx], f_minz=gc.spr_f_min_zero[tidx],
+        f_tv=gd.spr_f_tv[tidx], f_elig=gd.spr_f_elig[tidx],
+        f_cnt=gc.spr_f_cnt[tidx],
+        s_act=gd.spr_s_active[tidx], s_skew=gd.spr_s_max_skew[tidx],
+        s_is_host=gd.spr_s_is_host[tidx], s_tv=gd.spr_s_tv[tidx],
+        s_keys_ok=gd.spr_s_keys_ok[tidx], s_dom=gd.spr_s_dom[tidx],
+        s_cnt=gc.spr_s_cnt[tidx],
+        ra_act=gd.ipa_ra_active[tidx], ra_tv=gd.ipa_ra_tv[tidx],
+        raa_act=gd.ipa_raa_active[tidx], raa_tv=gd.ipa_raa_tv[tidx],
+        self_all=gd.ipa_self_all[tidx],
+        veto=gc.ipa_veto[tidx], a_cnt=gc.ipa_a_cnt[tidx],
+        a_total=gc.ipa_a_total[tidx], aa_cnt=gc.ipa_aa_cnt[tidx],
+        iscore=gc.ipa_score[tidx])
+
+
+def group_mask_view(v: GroupView, fam: GroupFamilies,
+                    axis: Optional[str] = None):
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = v.veto.shape[-1]
+    mask = jnp.ones((n,), bool)
+
+    if fam.spr_f:
+        # -- spread skew (DoNotSchedule)
+        minv = jnp.min(jnp.where(v.f_elig, v.f_cnt, INT32_MAX), axis=-1)
+        if axis is not None:
+            minv = lax.pmin(minv, axis)
+        # fewer eligible domains than minDomains (incl. zero domains) ⇒
+        # min = 0 (filtering.go:66-77)
+        minv = jnp.where(v.f_minz, 0, minv)
+        ok = (v.f_cnt + v.f_self[:, None] - minv[:, None]
+              <= v.f_skew[:, None])
+        # node missing the topology key ⇒ UnschedulableAndUnresolvable
+        mask &= jnp.all(~v.f_act[:, None] | ((v.f_tv != 0) & ok), axis=0)
+
+    if fam.ipa_anti:
+        # -- existing pods' required anti-affinity (filtering.go:204-228)
+        mask &= v.veto == 0
+        # -- incoming required anti-affinity
+        mask &= ~jnp.any(v.raa_act[:, None] & (v.raa_tv != 0)
+                         & (v.aa_cnt > 0), axis=0)
+
+    if fam.ipa_req:
+        # -- incoming required affinity (incl. the first-pod-in-series
+        # escape hatch, filtering.go:381-397)
+        tv_all = jnp.all(~v.ra_act[:, None] | (v.ra_tv != 0), axis=0)
+        pods_exist = jnp.all(~v.ra_act[:, None] | (v.a_cnt > 0), axis=0)
+        # sum==0 <=> len==0 for the reference's affinityCounts map: seed
+        # entries are built by counting (strictly positive) and the device
+        # path only ever increments — if a RemovePod-style decrement is
+        # ever added, this test must switch to an explicit entry count
+        escape = (v.a_total == 0) & v.self_all
+        mask &= jnp.where(jnp.any(v.ra_act), tv_all & (pods_exist | escape),
+                          True)
+
+    return mask
+
+
 def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None,
                fam: Optional[GroupFamilies] = None):
     """Feasibility over the node axis for the pod signature `tidx`:
     spread skew check (filtering.go:314-360) AND the three inter-pod
     affinity checks (filtering.go:405-432). `fam` statically skips families
     whose contribution is provably the identity (see GroupFamilies)."""
+    return group_mask_view(view_of(gd, gc, tidx), fam or ALL_FAMILIES, axis)
+
+
+def group_scores_view(w_spread: int, w_ipa: int, v: GroupView, feasible,
+                      fam: GroupFamilies, axis: Optional[str] = None,
+                      n_global: Optional[int] = None):
     import jax.numpy as jnp
     from jax import lax
 
-    fam = fam or ALL_FAMILIES
-    n = gc.ipa_veto.shape[-1]
-    mask = jnp.ones((n,), bool)
-
-    if fam.spr_f:
-        # -- spread skew (DoNotSchedule)
-        act = gd.spr_f_active[tidx]                 # [SC]
-        cnt = gc.spr_f_cnt[tidx]                    # [SC, N]
-        elig = gd.spr_f_elig[tidx]
-        tv = gd.spr_f_tv[tidx]
-        minv = jnp.min(jnp.where(elig, cnt, INT32_MAX), axis=-1)   # [SC]
-        if axis is not None:
-            minv = lax.pmin(minv, axis)
-        # fewer eligible domains than minDomains (incl. zero domains) ⇒
-        # min = 0 (filtering.go:66-77)
-        minv = jnp.where(gc.spr_f_min_zero[tidx], 0, minv)
-        ok = (cnt + gd.spr_f_self[tidx][:, None] - minv[:, None]
-              <= gd.spr_f_max_skew[tidx][:, None])
-        # node missing the topology key ⇒ UnschedulableAndUnresolvable
-        mask &= jnp.all(~act[:, None] | ((tv != 0) & ok), axis=0)
-
-    if fam.ipa_anti:
-        # -- existing pods' required anti-affinity (filtering.go:204-228)
-        mask &= gc.ipa_veto[tidx] == 0
-        # -- incoming required anti-affinity
-        raa_act = gd.ipa_raa_active[tidx]           # [TAA]
-        raa_tv = gd.ipa_raa_tv[tidx]                # [TAA, N]
-        mask &= ~jnp.any(raa_act[:, None] & (raa_tv != 0)
-                         & (gc.ipa_aa_cnt[tidx] > 0), axis=0)
-
-    if fam.ipa_req:
-        # -- incoming required affinity (incl. the first-pod-in-series
-        # escape hatch, filtering.go:381-397)
-        ra_act = gd.ipa_ra_active[tidx]             # [TA]
-        ra_tv = gd.ipa_ra_tv[tidx]                  # [TA, N]
-        tv_all = jnp.all(~ra_act[:, None] | (ra_tv != 0), axis=0)
-        pods_exist = jnp.all(~ra_act[:, None] | (gc.ipa_a_cnt[tidx] > 0),
-                             axis=0)
-        # sum==0 <=> len==0 for the reference's affinityCounts map: seed
-        # entries are built by counting (strictly positive) and the device
-        # path only ever increments — if a RemovePod-style decrement is
-        # ever added, this test must switch to an explicit entry count
-        escape = (gc.ipa_a_total[tidx] == 0) & gd.ipa_self_all[tidx]
-        mask &= jnp.where(jnp.any(ra_act), tv_all & (pods_exist | escape),
-                          True)
-
-    return mask
-
-
-def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
-                 tidx, feasible, axis: Optional[str] = None,
-                 n_global: Optional[int] = None,
-                 fam: Optional[GroupFamilies] = None):
-    """Weighted PodTopologySpread + InterPodAffinity score over the node
-    axis, already normalized per the host plugins' Normalize formulas.
-    `feasible` is the FULL filtered set (all plugins), matching the host
-    runtime's normalize-over-filtered-list semantics. `n_global` is the
-    unsharded node-axis length (defaults to the local length)."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    fam = fam or ALL_FAMILIES
     N = feasible.shape[0]
     if n_global is None:
         n_global = N
@@ -315,29 +359,25 @@ def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
     if not fam.spr_s and not fam.ipa_score:
         return jnp.zeros((N,), jnp.int64)
     if not fam.spr_s:
-        return w_ipa * _ipa_norm_scores(gc, tidx, feasible, _gmin, _gmax)
+        return w_ipa * _ipa_norm_scores(v.iscore, feasible, _gmin, _gmax)
     # ---- PodTopologySpread (scoring.go:199-271) ----
-    s_act = gd.spr_s_active[tidx]                   # [SC]
-    has_s = jnp.any(s_act)
-    keys_ok = gd.spr_s_keys_ok[tidx]                # [N]
-    scored = feasible & keys_ok
+    has_s = jnp.any(v.s_act)
+    scored = feasible & v.s_keys_ok
     npart = _gsum(jnp.sum(scored))
     # per-constraint domain count among scored nodes (topologyNormalizingWeight)
-    dom = gd.spr_s_dom[tidx]                        # [SC, N]
+    dom = v.s_dom                                   # [SC, N]
     flags = jnp.zeros((dom.shape[0], n_global), jnp.int32)
     flags = flags.at[jnp.arange(dom.shape[0])[:, None], dom].max(
         jnp.broadcast_to(scored.astype(jnp.int32), dom.shape))
     if axis is not None:
         flags = lax.psum(flags, axis)
     distinct = jnp.sum(flags > 0, axis=1)           # [SC]
-    size = jnp.where(gd.spr_s_is_host[tidx], npart, distinct)
+    size = jnp.where(v.s_is_host, npart, distinct)
     weight = jnp.log(size.astype(jnp.float64) + 2.0)  # [SC]
-    cnt_s = gc.spr_s_cnt[tidx]                      # [SC, N]
-    tv_s = gd.spr_s_tv[tidx]
     contrib = jnp.where(
-        s_act[:, None] & (tv_s != 0),
-        cnt_s.astype(jnp.float64) * weight[:, None]
-        + (gd.spr_s_max_skew[tidx][:, None] - 1).astype(jnp.float64),
+        v.s_act[:, None] & (v.s_tv != 0),
+        v.s_cnt.astype(jnp.float64) * weight[:, None]
+        + (v.s_skew[:, None] - 1).astype(jnp.float64),
         0.0)
     raw = jnp.round(jnp.sum(contrib, axis=0)).astype(jnp.int64)  # [N]
     # normalize (host plugin normalize_scores: MAX·(max+min−s)//max)
@@ -351,14 +391,27 @@ def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
     if not fam.ipa_score:
         return w_spread * spread_score
     return (w_spread * spread_score
-            + w_ipa * _ipa_norm_scores(gc, tidx, feasible, _gmin, _gmax))
+            + w_ipa * _ipa_norm_scores(v.iscore, feasible, _gmin, _gmax))
 
 
-def _ipa_norm_scores(gc: GroupCarry, tidx, feasible, _gmin, _gmax):
-    """InterPodAffinity normalized score surface (scoring.go:263-293)."""
+def group_scores(w_spread: int, w_ipa: int, gd: GroupsDev, gc: GroupCarry,
+                 tidx, feasible, axis: Optional[str] = None,
+                 n_global: Optional[int] = None,
+                 fam: Optional[GroupFamilies] = None):
+    """Weighted PodTopologySpread + InterPodAffinity score over the node
+    axis, already normalized per the host plugins' Normalize formulas.
+    `feasible` is the FULL filtered set (all plugins), matching the host
+    runtime's normalize-over-filtered-list semantics. `n_global` is the
+    unsharded node-axis length (defaults to the local length)."""
+    return group_scores_view(w_spread, w_ipa, view_of(gd, gc, tidx),
+                             feasible, fam or ALL_FAMILIES, axis, n_global)
+
+
+def _ipa_norm_scores(s, feasible, _gmin, _gmax):
+    """InterPodAffinity normalized score surface (scoring.go:263-293).
+    `s`: the gathered i64 [N] symmetric topology score surface."""
     import jax.numpy as jnp
 
-    s = gc.ipa_score[tidx]                          # [N] i64
     big = jnp.iinfo(jnp.int64).max
     minv2 = _gmin(jnp.min(jnp.where(feasible, s, big)))
     maxv2 = _gmax(jnp.max(jnp.where(feasible, s, -big)))
@@ -532,6 +585,10 @@ class GroupManager:
         self.m_ipa_exist = np.zeros((U, U, d.ipa_anti_terms), bool)
         self.w_stc = np.zeros((U, U, d.ipa_cons_terms), np.int64)
         self.w_stp = np.zeros((U, U, d.ipa_plcd_terms), np.int64)
+        # interaction graph: interacts[p, c] — placing a pod of row p can
+        # move row c's group counts/scores (the build-time signature the
+        # wave scheduler consults; state/batch.py BatchBuilder.wave_info)
+        self.interacts = np.zeros((U, U), bool)
 
     # pairwise [U, U, ...] matrices vs per-row [U, ...] arrays: classified
     # by NAME, never by shape — a table_rows value that coincides with a
@@ -545,13 +602,14 @@ class GroupManager:
                    "ipa_ra_active", "ipa_raa_active", "ipa_self_all")
 
     def grow(self, U: int) -> None:
-        names = self._ROW_FIELDS + tuple(self._PAIRWISE_FIELDS)
+        names = (self._ROW_FIELDS + tuple(self._PAIRWISE_FIELDS)
+                 + ("interacts",))
         old = {name: getattr(self, name) for name in names}
         u0 = len(self.rows)
         self._alloc(U)
         for name, arr in old.items():
             new = getattr(self, name)
-            if name in self._PAIRWISE_FIELDS:
+            if name in self._PAIRWISE_FIELDS or name == "interacts":
                 new[:u0, :u0] = arr[:u0, :u0]
             else:
                 new[:u0] = arr[:u0]
@@ -665,13 +723,28 @@ class GroupManager:
             self.w_stc[pu, cu, t] = w if term.matches(ppod, None) else 0
         for t, (term, w) in enumerate(placed.stp_terms):
             self.w_stp[pu, cu, t] = w if term.matches(cpod, ns_labels) else 0
+        self.interacts[pu, cu] = bool(
+            self.m_spr_f[pu, cu].any() or self.m_spr_s[pu, cu].any()
+            or self.m_ipa_a[pu, cu] or self.m_ipa_aa[pu, cu].any()
+            or self.m_ipa_exist[pu, cu].any()
+            or self.w_stc[pu, cu].any() or self.w_stp[pu, cu].any())
 
     def any_groups(self) -> bool:
         return self.group_row_count > 0
 
     # -- node-dependent statics ----------------------------------------------
 
-    def node_data(self, snapshot, rows: range):
+    def _node_rows(self, snapshot) -> list:
+        """[(row index, NodeInfo)] for the snapshot's nodes — built once
+        per build/scatter and shared between node_data and seed_counts
+        (the 2×O(N) name-lookup walks used to run per call)."""
+        st = self.state
+        N = st.dims.nodes
+        nis = [(st.node_index.get(ni.name), ni)
+               for ni in snapshot.node_info_list]
+        return [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+
+    def node_data(self, snapshot, rows: range, nis=None):
         """tv / eligibility / domain arrays for the given row slice against
         the CURRENT node set, laid out in ClusterState row order. Returns a
         dict of numpy arrays shaped like the matching GroupsDev fields but
@@ -689,18 +762,22 @@ class GroupManager:
         out = dict(
             spr_f_tv=np.zeros((R, SC, N), np.int32),
             spr_f_elig=np.zeros((R, SC, N), bool),
+            spr_f_dom=np.zeros((R, SC, N), np.int32),
             spr_s_tv=np.zeros((R, SC, N), np.int32),
             spr_s_elig=np.zeros((R, SC, N), bool),
             spr_s_keys_ok=np.zeros((R, N), bool),
             spr_s_dom=np.zeros((R, SC, N), np.int32),
             ipa_ra_tv=np.zeros((R, TA, N), np.int32),
+            ipa_ra_dom=np.zeros((R, TA, N), np.int32),
             ipa_raa_tv=np.zeros((R, TAA, N), np.int32),
+            ipa_raa_dom=np.zeros((R, TAA, N), np.int32),
             ipa_stc_tv=np.zeros((R, CT, N), np.int32),
+            ipa_stc_dom=np.zeros((R, CT, N), np.int32),
             ipa_stp_tv=np.zeros((R, PT, N), np.int32),
+            ipa_stp_dom=np.zeros((R, PT, N), np.int32),
         )
-        nis = [(st.node_index.get(ni.name), ni)
-               for ni in snapshot.node_info_list]
-        nis = [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+        if nis is None:
+            nis = self._node_rows(snapshot)
         order_idx = np.array([idx for idx, _ in nis], np.int64)
 
         # per-CALL memos shared across every row and constraint: a topology
@@ -732,6 +809,8 @@ class GroupManager:
                 ok &= tv_vec(k) != 0        # interned ids start at 1
             return ok
 
+        dom_cache: dict[str, np.ndarray] = {}
+
         def dom_vec(tvv: np.ndarray) -> np.ndarray:
             """Dense domain id = row index of the FIRST node (in snapshot
             order) sharing the tv — vectorized equivalent of the previous
@@ -744,6 +823,15 @@ class GroupManager:
             first_row = order_idx[first_pos]
             dom[order_idx] = first_row[np.searchsorted(uniq, sub)]
             return dom
+
+        def dom_of_key(key: str) -> np.ndarray:
+            """Memoized dom_vec per topology key: the wave fold shares a
+            placement's count along its topology domain via these ids, so
+            every tv-valued tensor ships a dom companion."""
+            v = dom_cache.get(key)
+            if v is None:
+                v = dom_cache[key] = dom_vec(tv_vec(key))
+            return v
 
         def elig_vec(c, pod, keys: list[str]) -> np.ndarray:
             """Count-eligibility per node (common.go:43-57). The common
@@ -783,30 +871,34 @@ class GroupManager:
                 keys = [c.topology_key for c in info.f_constraints]
                 for j, c in enumerate(info.f_constraints):
                     out["spr_f_tv"][r, j] = tv_vec(c.topology_key)
+                    out["spr_f_dom"][r, j] = dom_of_key(c.topology_key)
                     out["spr_f_elig"][r, j] = elig_vec(c, pod, keys)
             # spread score
             if info.s_constraints:
                 keys = [c.topology_key for c in info.s_constraints]
                 out["spr_s_keys_ok"][r] = keys_ok_vec(keys)
                 for j, c in enumerate(info.s_constraints):
-                    tvv = tv_vec(c.topology_key)
-                    out["spr_s_tv"][r, j] = tvv
-                    out["spr_s_dom"][r, j] = dom_vec(tvv)
+                    out["spr_s_tv"][r, j] = tv_vec(c.topology_key)
+                    out["spr_s_dom"][r, j] = dom_of_key(c.topology_key)
                     out["spr_s_elig"][r, j] = elig_vec(c, pod, keys)
             # inter-pod affinity term topology values
             for t, term in enumerate(info.req_a):
                 out["ipa_ra_tv"][r, t] = tv_vec(term.topology_key)
+                out["ipa_ra_dom"][r, t] = dom_of_key(term.topology_key)
             for t, term in enumerate(info.req_aa):
                 out["ipa_raa_tv"][r, t] = tv_vec(term.topology_key)
+                out["ipa_raa_dom"][r, t] = dom_of_key(term.topology_key)
             for t, (term, _w) in enumerate(info.stc_terms):
                 out["ipa_stc_tv"][r, t] = tv_vec(term.topology_key)
+                out["ipa_stc_dom"][r, t] = dom_of_key(term.topology_key)
             for t, (term, _w) in enumerate(info.stp_terms):
                 out["ipa_stp_tv"][r, t] = tv_vec(term.topology_key)
+                out["ipa_stp_dom"][r, t] = dom_of_key(term.topology_key)
         return out
 
     # -- count seeding --------------------------------------------------------
 
-    def seed_counts(self, snapshot, rows: range):
+    def seed_counts(self, snapshot, rows: range, nis=None):
         """Count arrays for the given rows from the LIVE snapshot, computed
         by running the host plugins' PreFilter/PreScore on the representative
         pod — the device then carries these forward incrementally."""
@@ -830,8 +922,8 @@ class GroupManager:
             ipa_score=np.zeros((R, N), np.int64),
         )
         node_list = snapshot.node_info_list
-        nis = [(st.node_index.get(ni.name), ni) for ni in node_list]
-        nis = [(idx, ni) for idx, ni in nis if idx is not None and idx < N]
+        if nis is None:
+            nis = self._node_rows(snapshot)
 
         for r, u in enumerate(rows):
             info = self.rows[u] if u < len(self.rows) else None
@@ -847,6 +939,8 @@ class GroupManager:
                     for j, c in enumerate(s.constraints):
                         cnts = s.tp_value_to_match_num[j]
                         out["spr_f_min_zero"][r, j] = len(cnts) < c.min_domains
+                        if not any(cnts.values()):
+                            continue    # all-zero seed: the array is zeros
                         for idx, ni in nis:
                             v = ni.node.metadata.labels.get(c.topology_key)
                             if v is not None:
@@ -872,37 +966,49 @@ class GroupManager:
                     by_tv[v] = by_tv.get(v, 0) + \
                         pts_mod._count_pods_match_selector(
                             ni.pods, c.selector, pod.namespace)
+                if not any(by_tv.values()):
+                    continue
                 for idx, ni in nis:
                     v = ni.node.metadata.labels.get(c.topology_key)
                     if v is not None:
                         out["spr_s_cnt"][r, j, idx] = by_tv.get(v, 0)
-            # inter-pod affinity maps via the plugin's PreFilter
+            # inter-pod affinity maps via the plugin's PreFilter. Empty
+            # count maps (the common fresh-workload case) skip their
+            # per-node gather loops outright — the arrays are zeros.
             cs = CycleState()
             self.ipa.pre_filter(cs, pod, node_list)
             s = cs.read_or_none(ipa_mod._PRE_FILTER_KEY)
             if s is not None:
                 out["ipa_a_total"][r] = sum(s.affinity_counts.values())
-                for idx, ni in nis:
-                    labels = ni.node.metadata.labels
-                    veto = 0
-                    for kv in labels.items():
-                        veto += s.existing_anti_affinity_counts.get(kv, 0)
-                    out["ipa_veto"][r, idx] = veto
-                    for t, term in enumerate(info.req_a):
-                        v = labels.get(term.topology_key)
-                        if v is not None:
-                            out["ipa_a_cnt"][r, t, idx] = \
-                                s.affinity_counts.get((term.topology_key, v), 0)
-                    for t, term in enumerate(info.req_aa):
-                        v = labels.get(term.topology_key)
-                        if v is not None:
-                            out["ipa_aa_cnt"][r, t, idx] = \
-                                s.anti_affinity_counts.get((term.topology_key, v), 0)
+                if s.existing_anti_affinity_counts:
+                    for idx, ni in nis:
+                        veto = 0
+                        for kv in ni.node.metadata.labels.items():
+                            veto += s.existing_anti_affinity_counts.get(kv, 0)
+                        out["ipa_veto"][r, idx] = veto
+                if s.affinity_counts:
+                    for idx, ni in nis:
+                        labels = ni.node.metadata.labels
+                        for t, term in enumerate(info.req_a):
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                out["ipa_a_cnt"][r, t, idx] = \
+                                    s.affinity_counts.get(
+                                        (term.topology_key, v), 0)
+                if s.anti_affinity_counts:
+                    for idx, ni in nis:
+                        labels = ni.node.metadata.labels
+                        for t, term in enumerate(info.req_aa):
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                out["ipa_aa_cnt"][r, t, idx] = \
+                                    s.anti_affinity_counts.get(
+                                        (term.topology_key, v), 0)
             # symmetric score surface via the plugin's PreScore
             cs = CycleState()
             self.ipa.pre_score(cs, pod, node_list, all_nodes=node_list)
             ps = cs.read_or_none(ipa_mod._PRE_SCORE_KEY)
-            if ps is not None:
+            if ps is not None and ps.topology_score:
                 for idx, ni in nis:
                     labels = ni.node.metadata.labels
                     total = 0
@@ -945,8 +1051,9 @@ class GroupManager:
     def build_dev(self, snapshot) -> "tuple[GroupsDev, GroupCarry]":
         """Full (GroupsDev, GroupCarry) numpy build for all rows."""
         rows = range(len(self.rows))
-        nd = self.node_data(snapshot, rows)
-        seeds = self.seed_counts(snapshot, rows)
+        nis = self._node_rows(snapshot)
+        nd = self.node_data(snapshot, rows, nis=nis)
+        seeds = self.seed_counts(snapshot, rows, nis=nis)
         U, N = self.device_rows(), self.state.dims.nodes
         d = self.dims
 
@@ -965,14 +1072,19 @@ class GroupManager:
         gd = GroupsDev(
             spr_f_tv=full("spr_f_tv", (U, d.spread_constraints, N), np.int32),
             spr_f_elig=full("spr_f_elig", (U, d.spread_constraints, N), bool),
+            spr_f_dom=full("spr_f_dom", (U, d.spread_constraints, N), np.int32),
             spr_s_tv=full("spr_s_tv", (U, d.spread_constraints, N), np.int32),
             spr_s_elig=full("spr_s_elig", (U, d.spread_constraints, N), bool),
             spr_s_keys_ok=full("spr_s_keys_ok", (U, N), bool),
             spr_s_dom=full("spr_s_dom", (U, d.spread_constraints, N), np.int32),
             ipa_ra_tv=full("ipa_ra_tv", (U, d.ipa_req_terms, N), np.int32),
+            ipa_ra_dom=full("ipa_ra_dom", (U, d.ipa_req_terms, N), np.int32),
             ipa_raa_tv=full("ipa_raa_tv", (U, d.ipa_anti_terms, N), np.int32),
+            ipa_raa_dom=full("ipa_raa_dom", (U, d.ipa_anti_terms, N), np.int32),
             ipa_stc_tv=full("ipa_stc_tv", (U, d.ipa_cons_terms, N), np.int32),
+            ipa_stc_dom=full("ipa_stc_dom", (U, d.ipa_cons_terms, N), np.int32),
             ipa_stp_tv=full("ipa_stp_tv", (U, d.ipa_plcd_terms, N), np.int32),
+            ipa_stp_dom=full("ipa_stp_dom", (U, d.ipa_plcd_terms, N), np.int32),
             **sliced,
         )
         gc = GroupCarry(
@@ -1012,8 +1124,9 @@ def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
 
     rows = range(lo, hi)
     U = gd_dev.spr_f_active.shape[0]   # device row axis (compact, pow2)
-    nd = mgr.node_data(snapshot, rows)
-    seeds = mgr.seed_counts(snapshot, rows)
+    nis = mgr._node_rows(snapshot)
+    nd = mgr.node_data(snapshot, rows, nis=nis)
+    seeds = mgr.seed_counts(snapshot, rows, nis=nis)
 
     def put(update, like):
         if mesh is None:
@@ -1031,3 +1144,119 @@ def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
                  put(seeds[name], getattr(gc_dev, name)))
              for name in seeds}
     return gd_dev._replace(**gd_kw), gc_dev._replace(**gc_kw)
+
+
+# ---------------------------------------------------------------------------
+# wave fold: batch-apply a wave's accepted placements to the FULL carry
+# (ops/program.py run_wave). Every group_update increment is a pure
+# gated ADD, so the sequential per-placement updates commute — the whole
+# wave folds into the carry with one scatter/gather pass per family
+# instead of one [U, SC, N] update per placement.
+
+
+def _dom_share(tv, dom, w):
+    """Σ_m w[m] over nodes m sharing n's topology value (tv ≠ 0 both
+    sides) — the "same-topology-value broadcast" of group_update, batched
+    over placements via the dense dom ids. tv/dom: int [..., N]; w: int
+    [..., N]; returns w's dtype [..., N]."""
+    import jax
+    import jax.numpy as jnp
+
+    lead = tv.shape[:-1]
+    n = tv.shape[-1]
+    tv2 = tv.reshape(-1, n)
+    dom2 = dom.reshape(-1, n)
+    w2 = w.reshape(-1, n)
+
+    def one(t, d, x):
+        seg = jnp.zeros((n,), x.dtype).at[d].add(jnp.where(t != 0, x, 0))
+        return jnp.where(t != 0, seg[d], 0)
+
+    return jax.vmap(one)(tv2, dom2, w2).reshape(*lead, n)
+
+
+def wave_fold(gd: GroupsDev, gc: GroupCarry, wt, cnt_sn,
+              fam: Optional[GroupFamilies] = None) -> GroupCarry:
+    """GroupCarry after a wave: `wt` i32 [S] are the wave's table rows and
+    `cnt_sn` i32 [S, N] the accepted placement counts of each wave row per
+    node. Exactly equals folding the placements through group_update one
+    by one, in any order (additivity; node labels static)."""
+    import jax.numpy as jnp
+
+    fam = fam or ALL_FAMILIES
+    spr_f_cnt, spr_s_cnt = gc.spr_f_cnt, gc.spr_s_cnt
+    ipa_veto, ipa_a_cnt = gc.ipa_veto, gc.ipa_a_cnt
+    ipa_a_total, ipa_aa_cnt = gc.ipa_a_total, gc.ipa_aa_cnt
+    ipa_score = gc.ipa_score
+    cnt32 = cnt_sn.astype(jnp.int32)
+
+    if fam.spr_f:
+        # per (consumer u, constraint c): weights at the PLACED node m are
+        # Σ_s m_spr_f[placed s → u, c] · cnt[s, m], gated by u's count
+        # eligibility of m; shared to every node in m's topology domain
+        w_ucn = jnp.einsum("suc,sn->ucn", gd.m_spr_f[wt].astype(jnp.int32),
+                           cnt32)
+        add = _dom_share(gd.spr_f_tv, gd.spr_f_dom,
+                         w_ucn * gd.spr_f_elig)
+        spr_f_cnt = gc.spr_f_cnt + add
+
+    if fam.spr_s:
+        w_ucn = jnp.einsum("suc,sn->ucn", gd.m_spr_s[wt].astype(jnp.int32),
+                           cnt32)
+        topo = _dom_share(gd.spr_s_tv, gd.spr_s_dom,
+                          w_ucn * gd.spr_s_elig)
+        # hostname constraints count the chosen node's own pods, no
+        # eligibility gate (group_update's is_host branch)
+        spr_s_cnt = gc.spr_s_cnt + jnp.where(
+            gd.spr_s_is_host[:, :, None], w_ucn, topo)
+
+    if fam.ipa_anti:
+        # existing-anti veto: shared along the PLACED row's term topology
+        raa_tv_w = gd.ipa_raa_tv[wt]                       # [S, TAA, N]
+        raa_dom_w = gd.ipa_raa_dom[wt]
+        shared_st = _dom_share(
+            raa_tv_w, raa_dom_w,
+            jnp.broadcast_to(cnt32[:, None, :], raa_tv_w.shape))
+        ipa_veto = gc.ipa_veto + jnp.einsum(
+            "sut,stn->un", gd.m_ipa_exist[wt].astype(jnp.int32), shared_st)
+        # incoming-anti counts: shared along the CONSUMER's term topology
+        w_utn = jnp.einsum("sut,sn->utn", gd.m_ipa_aa[wt].astype(jnp.int32),
+                           cnt32)
+        ipa_aa_cnt = gc.ipa_aa_cnt + _dom_share(
+            gd.ipa_raa_tv, gd.ipa_raa_dom, w_utn)
+
+    if fam.ipa_req:
+        w_un = jnp.einsum("su,sn->un", gd.m_ipa_a[wt].astype(jnp.int32),
+                          cnt32)
+        ipa_a_cnt = gc.ipa_a_cnt + _dom_share(
+            gd.ipa_ra_tv, gd.ipa_ra_dom,
+            w_un[:, None, :] * gd.ipa_ra_active[:, :, None])
+        # a_total: each placement adds (# active consumer terms whose
+        # topology key exists on the placed node) when it matches all of
+        # the consumer's terms (group_update's tvb_a != 0 gate)
+        k_un = jnp.sum(gd.ipa_ra_active[:, :, None]
+                       & (gd.ipa_ra_tv != 0), axis=1)     # [U, N]
+        ipa_a_total = gc.ipa_a_total + jnp.einsum(
+            "un,un->u", w_un.astype(jnp.int64), k_un.astype(jnp.int64))
+
+    if fam.ipa_score:
+        # consumer-side preferred terms matching the placed pod
+        wc_utn = jnp.einsum("sut,sn->utn", gd.w_stc[wt],
+                            cnt_sn.astype(jnp.int64))
+        cons_add = jnp.sum(_dom_share(gd.ipa_stc_tv, gd.ipa_stc_dom,
+                                      wc_utn), axis=1)    # [U, N]
+        # placed-side terms: share counts along the placed row's term
+        # topology, then weight per consumer
+        stp_tv_w = gd.ipa_stp_tv[wt]                       # [S, PT, N]
+        stp_dom_w = gd.ipa_stp_dom[wt]
+        shared_p = _dom_share(
+            stp_tv_w, stp_dom_w,
+            jnp.broadcast_to(cnt_sn.astype(jnp.int64)[:, None, :],
+                             stp_tv_w.shape))
+        plcd_add = jnp.einsum("sut,stn->un", gd.w_stp[wt], shared_p)
+        ipa_score = gc.ipa_score + cons_add + plcd_add
+
+    return GroupCarry(spr_f_cnt=spr_f_cnt, spr_f_min_zero=gc.spr_f_min_zero,
+                      spr_s_cnt=spr_s_cnt, ipa_veto=ipa_veto,
+                      ipa_a_cnt=ipa_a_cnt, ipa_a_total=ipa_a_total,
+                      ipa_aa_cnt=ipa_aa_cnt, ipa_score=ipa_score)

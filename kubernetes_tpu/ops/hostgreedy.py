@@ -136,6 +136,20 @@ def _image_score(a, row) -> np.ndarray:
             // max(max_thr - MIN_THRESHOLD, 1))
 
 
+def static_norm_ok(arrays, pref_weight) -> bool:
+    """True when the TaintToleration / preferred-NodeAffinity
+    DefaultNormalize constants cannot shift during a same-signature run:
+    no valid node carries a PreferNoSchedule taint and the row has no
+    preferred-affinity weight. Shared eligibility predicate — HostGreedy
+    requires it (self.ok), and the wave kernel (ops/program.py run_wave)
+    keys its static `norm_live` variant on it: False here compiles the
+    cheap constant-normalization program, True the per-step renormalizing
+    one."""
+    prefer = ((arrays.taint_eff == EFFECT_PREFER_NO_SCHEDULE)
+              & arrays.valid[:, None]).any()
+    return (not prefer) and (not pref_weight.any())
+
+
 class _Row:
     """One signature row of the (numpy) PodTable, attribute access."""
 
@@ -189,9 +203,11 @@ class HostGreedy:
             # slice the node axis by FIELD NAME (GroupsDev/GroupCarry
             # specs) — a shape[-1]==N heuristic mis-truncates per-row
             # tensors whenever the row count U happens to equal N
-            gd_node = {"spr_f_tv", "spr_f_elig", "spr_s_tv", "spr_s_elig",
-                       "spr_s_keys_ok", "spr_s_dom", "ipa_ra_tv",
-                       "ipa_raa_tv", "ipa_stc_tv", "ipa_stp_tv"}
+            gd_node = {"spr_f_tv", "spr_f_elig", "spr_f_dom", "spr_s_tv",
+                       "spr_s_elig", "spr_s_keys_ok", "spr_s_dom",
+                       "ipa_ra_tv", "ipa_ra_dom", "ipa_raa_tv",
+                       "ipa_raa_dom", "ipa_stc_tv", "ipa_stc_dom",
+                       "ipa_stp_tv", "ipa_stp_dom"}
             gc_node = {"spr_f_cnt", "spr_s_cnt", "ipa_veto", "ipa_a_cnt",
                        "ipa_aa_cnt", "ipa_score"}
             arrays = type(arrays)(*(x[:n_eff] for x in arrays))
@@ -219,9 +235,7 @@ class HostGreedy:
         self.s_img = _image_score(a, row)
 
         # -- exactness preconditions (run_uniform norm_ok analog)
-        prefer = ((a.taint_eff == EFFECT_PREFER_NO_SCHEDULE)
-                  & a.valid[:, None]).any()
-        self.ok = (not prefer) and (not row.pref_weight.any())
+        self.ok = static_norm_ok(a, row.pref_weight)
 
         # -- fit state (python scalars per update; vectors at init)
         self.req = row.req.astype(np.int64)

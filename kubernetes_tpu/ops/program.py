@@ -559,10 +559,9 @@ def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
                           ports=ports)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "fam"))
-def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
-              table: PodTableDev, groups: GroupsDev | None = None,
-              fam: GroupFamilies | None = None, overlay=None):
+def _run_batch_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
+                    table: PodTableDev, groups: GroupsDev | None = None,
+                    fam: GroupFamilies | None = None, overlay=None):
     """Scan the batch; returns (final carry, assignments int32[B] (-1 = none)).
 
     `groups` (with `carry.groups`) enables the PodTopologySpread /
@@ -607,6 +606,96 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
 
     (final, _ovl), assignments = lax.scan(step, (carry, overlay), pods)
     return final, assignments
+
+
+@functools.lru_cache(maxsize=None)
+def _run_batch_fn(donate: bool):
+    return jax.jit(_run_batch_impl, static_argnames=("cfg", "fam"),
+                   donate_argnums=(2,) if donate else ())
+
+
+def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
+              table: PodTableDev, groups: GroupsDev | None = None,
+              fam: GroupFamilies | None = None, overlay=None):
+    """Jitted entry for `_run_batch_impl`; the input carry is DONATED on
+    accelerator backends — the scan chain consumes it, so XLA reuses its
+    buffers for the output carry instead of copying the resident node
+    state on every dispatch. CPU (no donation support) compiles without
+    the donation to avoid per-dispatch warnings. Callers that rewind and
+    replay (the uniform path's exactness fallback) must therefore never
+    reuse a carry already consumed by run_batch — the scheduler keeps
+    carry_in only for run_uniform records, which do not donate."""
+    fn = _run_batch_fn(jax.default_backend() != "cpu")
+    return fn(cfg, na, carry, pods, table, groups, fam, overlay)
+
+
+def _uniform_matrix(cfg: ScoreConfig, na: NodeArrays, fit_used, fit_npods,
+                    score_used, score_nz, cand, pod: PodRow, J: int):
+    """The closed-form score matrix [K, J]: entry j = fit + post-placement
+    scores of the (j+1)-th run-pod on candidate k. Built column-by-column
+    (static unroll) so every device op is a 2-D [K, J] elementwise — no
+    [K, J, C] tensors with a tiny minor dim that would waste the 8×128
+    vector tiles. Shared by run_uniform (lean path) and the wave merge
+    tier (run_wave). Returns (fit_kj, s_fit_kj, s_bal_kj)."""
+    K = cand.shape[0]
+    j1 = jnp.arange(1, J + 1, dtype=jnp.int64)[None, :]        # [1, J]
+    npods_kj = (fit_npods[cand][:, None]
+                + j1.astype(fit_npods.dtype))
+    fit_kj = npods_kj <= na.allowed_pods[cand][:, None]
+    R = na.cap.shape[1]
+    for r in range(R):
+        cap_r = na.cap[cand, r][:, None]
+        used_r = fit_used[cand, r][:, None] + j1 * pod.req[r]
+        fit_kj &= (pod.req[r] == 0) | (used_r <= cap_r)
+
+    # LeastAllocated / MostAllocated (least_allocated.go:30-60) unrolled
+    # over the score columns; BalancedAllocation via the 2-column closed
+    # form |f0−f1|/2 the reference special-cases (balanced_allocation.go
+    # :224-227) when C==2, generic otherwise.
+    w = cfg.col_weights
+    score_sum = jnp.zeros((K, J), jnp.int64)
+    w_sum = jnp.zeros((K, J), jnp.int64)
+    fracs = []
+    bal_cols_ok = []
+    for ci, col in enumerate(cfg.score_cols):
+        cap_c = na.cap[cand, col][:, None]                      # [K, 1]
+        used_pl = score_used[cand, col][:, None] + j1 * pod.req[col]
+        if cfg.col_nonzero[ci]:
+            slot = cfg.nonzero_slot[ci]
+            used_c = (score_nz[cand, slot][:, None]
+                      + j1 * pod.nonzero_req[slot])
+        else:
+            used_c = used_pl
+        col_ok = cap_c > 0
+        if cfg.strategy == "MostAllocated":
+            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
+                            used_c * MAX_SCORE // jnp.maximum(cap_c, 1))
+        else:
+            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
+                            (cap_c - used_c) * MAX_SCORE // jnp.maximum(cap_c, 1))
+        score_sum += jnp.where(col_ok, raw * w[ci], 0)
+        w_sum += jnp.where(col_ok, jnp.int64(w[ci]), 0)
+        fracs.append(jnp.where(
+            col_ok, jnp.minimum(used_pl / jnp.maximum(cap_c, 1), 1.0), 0.0))
+        bal_cols_ok.append(col_ok)
+    s_fit_kj = jnp.where(w_sum > 0, score_sum // jnp.maximum(w_sum, 1), 0)
+    # same float-op structure as balanced_allocation() — stacked jnp.sum
+    # reductions over the column axis, not a sequential Python sum chain —
+    # so XLA lowers the same associativity and results stay bit-identical
+    # to the scan's (an |f0−f1|/2 shortcut, or a different reduction order,
+    # could differ by an ulp at floor boundaries and break parity)
+    frac_kjc = jnp.stack(fracs, axis=-1)                 # [K, J, C]
+    ok_kjc = jnp.stack(bal_cols_ok, axis=-1) & jnp.ones(
+        frac_kjc.shape, bool)
+    cnt = jnp.sum(ok_kjc, axis=-1)
+    mean = jnp.sum(frac_kjc, axis=-1) / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(ok_kjc, (frac_kjc - mean[..., None]) ** 2, 0.0),
+                  axis=-1) / jnp.maximum(cnt, 1)
+    std = jnp.sqrt(var)
+    s_bal_kj = jnp.where(
+        pod.skip_balanced, 0,
+        jnp.floor((1.0 - std) * MAX_SCORE + 1e-9).astype(jnp.int64))
+    return fit_kj, s_fit_kj, s_bal_kj
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
@@ -669,70 +758,12 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     norm_ok = (jnp.max(jnp.where(feasible0, parts.taint_raw, 0)) == 0) & (
         jnp.max(jnp.where(feasible0, parts.na_raw, 0)) == 0)
 
-    # score matrix [K, J]: entry j = post-placement score of the (j+1)-th
-    # run-pod on the candidate. Built column-by-column (static unroll) so
-    # every device op is a 2-D [K, J] elementwise — no [K, J, C] tensors
-    # with a tiny minor dim that would waste the 8×128 vector tiles.
-    j1 = jnp.arange(1, J + 1, dtype=jnp.int64)[None, :]        # [1, J]
     fit_npods = (carry.npods if overlay is None
                  else carry.npods + overlay[1])
     fit_used = carry.used if overlay is None else carry.used + overlay[0]
-    npods_kj = (fit_npods[cand][:, None]
-                + j1.astype(carry.npods.dtype))
-    fit_kj = npods_kj <= na.allowed_pods[cand][:, None]
-    R = na.cap.shape[1]
-    for r in range(R):
-        cap_r = na.cap[cand, r][:, None]
-        used_r = fit_used[cand, r][:, None] + j1 * pod.req[r]
-        fit_kj &= (pod.req[r] == 0) | (used_r <= cap_r)
-
-    # LeastAllocated / MostAllocated (least_allocated.go:30-60) unrolled
-    # over the score columns; BalancedAllocation via the 2-column closed
-    # form |f0−f1|/2 the reference special-cases (balanced_allocation.go
-    # :224-227) when C==2, generic otherwise.
-    w = cfg.col_weights
-    score_sum = jnp.zeros((K, J), jnp.int64)
-    w_sum = jnp.zeros((K, J), jnp.int64)
-    fracs = []
-    bal_cols_ok = []
-    for ci, col in enumerate(cfg.score_cols):
-        cap_c = na.cap[cand, col][:, None]                      # [K, 1]
-        used_pl = carry.used[cand, col][:, None] + j1 * pod.req[col]
-        if cfg.col_nonzero[ci]:
-            slot = cfg.nonzero_slot[ci]
-            used_c = (carry.nonzero_used[cand, slot][:, None]
-                      + j1 * pod.nonzero_req[slot])
-        else:
-            used_c = used_pl
-        col_ok = cap_c > 0
-        if cfg.strategy == "MostAllocated":
-            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
-                            used_c * MAX_SCORE // jnp.maximum(cap_c, 1))
-        else:
-            raw = jnp.where((cap_c == 0) | (used_c > cap_c), 0,
-                            (cap_c - used_c) * MAX_SCORE // jnp.maximum(cap_c, 1))
-        score_sum += jnp.where(col_ok, raw * w[ci], 0)
-        w_sum += jnp.where(col_ok, jnp.int64(w[ci]), 0)
-        fracs.append(jnp.where(
-            col_ok, jnp.minimum(used_pl / jnp.maximum(cap_c, 1), 1.0), 0.0))
-        bal_cols_ok.append(col_ok)
-    s_fit_kj = jnp.where(w_sum > 0, score_sum // jnp.maximum(w_sum, 1), 0)
-    # same float-op structure as balanced_allocation() — stacked jnp.sum
-    # reductions over the column axis, not a sequential Python sum chain —
-    # so XLA lowers the same associativity and results stay bit-identical
-    # to the scan's (an |f0−f1|/2 shortcut, or a different reduction order,
-    # could differ by an ulp at floor boundaries and break parity)
-    frac_kjc = jnp.stack(fracs, axis=-1)                 # [K, J, C]
-    ok_kjc = jnp.stack(bal_cols_ok, axis=-1) & jnp.ones(
-        frac_kjc.shape, bool)
-    cnt = jnp.sum(ok_kjc, axis=-1)
-    mean = jnp.sum(frac_kjc, axis=-1) / jnp.maximum(cnt, 1)
-    var = jnp.sum(jnp.where(ok_kjc, (frac_kjc - mean[..., None]) ** 2, 0.0),
-                  axis=-1) / jnp.maximum(cnt, 1)
-    std = jnp.sqrt(var)
-    s_bal_kj = jnp.where(
-        pod.skip_balanced, 0,
-        jnp.floor((1.0 - std) * MAX_SCORE + 1e-9).astype(jnp.int64))
+    fit_kj, s_fit_kj, s_bal_kj = _uniform_matrix(
+        cfg, na, fit_used, fit_npods, carry.used, carry.nonzero_used,
+        cand, pod, J)
 
     score_kj = (cfg.w_fit * s_fit_kj + cfg.w_balanced * s_bal_kj
                 + static_add[:, None])
@@ -788,6 +819,763 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
         assignments,
         jnp.stack([mono_ok & norm_ok, depth_ok]).astype(jnp.int32)])
     return new_carry, packed
+
+
+# ---------------------------------------------------------------------------
+# speculative wave placement: conflict-checked parallel group scheduling
+# (arXiv:2508.04953 Tesserae-style batch placement with conflict repair,
+# constrained to EXACT serial-greedy parity)
+
+
+class WaveXs(NamedTuple):
+    """Per-pod wave inputs ([W] = wave length, serial priority order)."""
+
+    valid: jnp.ndarray   # bool [W]
+    widx: jnp.ndarray    # i32 [W] — slot into the wave row set [S]
+
+
+class _WaveState(NamedTuple):
+    """In-dispatch scan state: node bookkeeping + the wave rows' group
+    counters ([S] = distinct signatures in the wave) + conflict stats."""
+
+    used: jnp.ndarray          # i64 [N, R]
+    nonzero_used: jnp.ndarray  # i64 [N, 2]
+    npods: jnp.ndarray         # i32 [N]
+    fit_ok: jnp.ndarray        # bool [S, N]
+    s_fit: jnp.ndarray         # i64 [S, N]
+    s_bal: jnp.ndarray         # i64 [S, N]
+    f_cnt: jnp.ndarray         # i32 [S, SC, N]
+    s_cnt: jnp.ndarray         # i32 [S, SC, N]
+    veto: jnp.ndarray          # i32 [S, N]
+    a_cnt: jnp.ndarray         # i32 [S, TA, N]
+    a_total: jnp.ndarray       # i64 [S]
+    aa_cnt: jnp.ndarray        # i32 [S, TAA, N]
+    iscore: jnp.ndarray        # i64 [S, N]
+    cnt_sn: jnp.ndarray        # i32 [S, N] — accepted placements (fold input)
+    clean: jnp.ndarray         # bool — no conflict seen yet
+    n_conf: jnp.ndarray        # i32 — conflicting pods so far
+    prefix: jnp.ndarray        # i32 — conflict-free prefix length
+
+
+def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                        xs: WaveXs, table: PodTableDev, wt, gd: GroupsDev,
+                        statics, fam: GroupFamilies, norm_live: bool,
+                        has_groups: bool):
+    """One wave of group-constrained pods in ONE device dispatch.
+
+    Phase A (speculative parallel scoring): every distinct signature's full
+    kernel set — static filters, taint/affinity/image scores, fit scores —
+    is evaluated ONCE against the same pre-wave carry ([S, N] surfaces),
+    and each signature's speculative argmax is recorded. This is where the
+    wave wins: the expensive kernels run S times per wave instead of once
+    per pod.
+
+    Phase B (conflict detection + repair, serial priority order): a scan
+    over the wave re-derives each pod's EXACT serial decision from the
+    Phase-A surfaces plus the accumulated in-wave deltas — fit/score
+    refreshed at the touched nodes, group counters carried for the wave's
+    consumer rows, normalizations re-reduced per step. A pod whose exact
+    argmax differs from its signature's speculative choice is a CONFLICT
+    (capacity oversubscription, topology-skew movement, affinity surface
+    change); it is repaired in place by taking the exact choice, so the
+    wave's assignments are bit-identical to the serial scan in every case
+    — an all-conflict wave degenerates to a serial re-evaluation without
+    error, it just stops being fast. The conflict count and the
+    conflict-free prefix length are returned for observability.
+
+    Epilogue: the accepted placements fold into the FULL group carry with
+    one batched pass (ops/groups.py wave_fold — additivity makes the fold
+    order-independent), so the next wave (or scan segment) continues from
+    an exact resident carry with no host round trip.
+
+    Preconditions (the scheduler gates): single device, no nominated-pod
+    overlay, every wave pod sig != 0 (no host ports), groups active, and
+    `norm_live=False` only under ops.hostgreedy.static_norm_ok. Returns
+    (new carry, packed i32 [W+2]): assignments, then n_conflicts, then the
+    conflict-free prefix length."""
+    from .groups import (GroupView, group_mask_view, group_scores_view,
+                         wave_fold)
+
+    gc = carry.groups
+    S = wt.shape[0]
+    n = na.cap.shape[0]
+    fields = {name: getattr(table, name)[wt] for name in PodTableDev._fields}
+    rows = PodRow(valid=jnp.ones((S,), bool),
+                  sig=jnp.ones((S,), jnp.int32), **fields)
+
+    # ---- Phase A: per-signature surfaces at the pre-wave carry. The
+    # carry-independent ones arrive precomputed (wave_statics, cached by
+    # the scheduler per signature); only the fit kernels evaluate here.
+    static_mask, taint_raw, na_raw, s_img = statics
+
+    def fit_one(pod: PodRow):
+        fit_ok = fit_mask(na.cap, carry.used, carry.npods, na.allowed_pods,
+                          pod.req)
+        s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
+        return fit_ok, s_fit, s_bal
+
+    fit0, sfit0, sbal0 = jax.vmap(fit_one)(rows)
+
+    # wave-local group statics (gathered once; [S, ...]); a LEAN wave
+    # (non-interacting signatures, no group constraints anywhere) carries
+    # no group state at all — the issue's "disjoint signatures placed in
+    # a single wave" case, which previously thrashed the one-slot
+    # signature cache with a full kernel recompute on every alternation
+    if has_groups:
+        f_act = gd.spr_f_active[wt]
+        f_skew = gd.spr_f_max_skew[wt]
+        f_self = gd.spr_f_self[wt]
+        f_minz = gc.spr_f_min_zero[wt]
+        f_tv = gd.spr_f_tv[wt]
+        f_elig = gd.spr_f_elig[wt]
+        s_act = gd.spr_s_active[wt]
+        s_skew = gd.spr_s_max_skew[wt]
+        s_ishost = gd.spr_s_is_host[wt]
+        s_tv = gd.spr_s_tv[wt]
+        s_elig = gd.spr_s_elig[wt]
+        s_keys = gd.spr_s_keys_ok[wt]
+        s_dom = gd.spr_s_dom[wt]
+        ra_act = gd.ipa_ra_active[wt]
+        ra_tv = gd.ipa_ra_tv[wt]
+        raa_act = gd.ipa_raa_active[wt]
+        raa_tv = gd.ipa_raa_tv[wt]
+        self_all = gd.ipa_self_all[wt]
+        stc_tv = gd.ipa_stc_tv[wt]
+        stp_tv = gd.ipa_stp_tv[wt]
+        # pairwise [placed s → consumer s'] slices
+        m_f = gd.m_spr_f[wt][:, wt]
+        m_s = gd.m_spr_s[wt][:, wt]
+        m_a = gd.m_ipa_a[wt][:, wt]
+        m_aa = gd.m_ipa_aa[wt][:, wt]
+        m_ex = gd.m_ipa_exist[wt][:, wt]
+        w_c = gd.w_stc[wt][:, wt]
+        w_p = gd.w_stp[wt][:, wt]
+
+    st0 = _WaveState(
+        used=carry.used, nonzero_used=carry.nonzero_used, npods=carry.npods,
+        fit_ok=fit0, s_fit=sfit0, s_bal=sbal0,
+        f_cnt=gc.spr_f_cnt[wt] if has_groups else None,
+        s_cnt=gc.spr_s_cnt[wt] if has_groups else None,
+        veto=gc.ipa_veto[wt] if has_groups else None,
+        a_cnt=gc.ipa_a_cnt[wt] if has_groups else None,
+        a_total=gc.ipa_a_total[wt] if has_groups else None,
+        aa_cnt=gc.ipa_aa_cnt[wt] if has_groups else None,
+        iscore=gc.ipa_score[wt] if has_groups else None,
+        cnt_sn=jnp.zeros((S, n), jnp.int32) if has_groups else None,
+        clean=jnp.bool_(True), n_conf=jnp.int32(0), prefix=jnp.int32(0))
+
+    def _eval(stx: _WaveState, w):
+        """Feasibility + total score of signature slot `w` at the state —
+        the same formula code as the scan's _eval_pod, over the wave's
+        maintained counters (GroupView shared with ops/groups.py)."""
+        feasible = static_mask[w] & stx.fit_ok[w]
+        if has_groups:
+            view = GroupView(
+                f_act=f_act[w], f_skew=f_skew[w], f_self=f_self[w],
+                f_minz=f_minz[w], f_tv=f_tv[w], f_elig=f_elig[w],
+                f_cnt=stx.f_cnt[w],
+                s_act=s_act[w], s_skew=s_skew[w], s_is_host=s_ishost[w],
+                s_tv=s_tv[w], s_keys_ok=s_keys[w], s_dom=s_dom[w],
+                s_cnt=stx.s_cnt[w],
+                ra_act=ra_act[w], ra_tv=ra_tv[w], raa_act=raa_act[w],
+                raa_tv=raa_tv[w], self_all=self_all[w],
+                veto=stx.veto[w], a_cnt=stx.a_cnt[w], a_total=stx.a_total[w],
+                aa_cnt=stx.aa_cnt[w], iscore=stx.iscore[w])
+            feasible &= group_mask_view(view, fam)
+        if norm_live:
+            s_taint = default_normalize(taint_raw[w], feasible, reverse=True)
+            s_na = default_normalize(na_raw[w], feasible, reverse=False)
+            tn = cfg.w_taint * s_taint + cfg.w_node_affinity * s_na
+        else:
+            # static_norm_ok precondition: every taint_raw/na_raw is zero,
+            # so DefaultNormalize degenerates to the constants 100 / 0
+            tn = cfg.w_taint * MAX_SCORE
+        total = (cfg.w_fit * stx.s_fit[w] + cfg.w_balanced * stx.s_bal[w]
+                 + tn + cfg.w_image * s_img[w])
+        if has_groups:
+            total = total + group_scores_view(cfg.w_spread, cfg.w_ipa, view,
+                                              feasible, fam)
+        return feasible, total
+
+    # speculative choice per signature (the parallel argmax of Phase A)
+    def spec_one(s):
+        feas, tot = _eval(st0, s)
+        masked = jnp.where(feas, tot, -1)
+        b = jnp.argmax(masked).astype(jnp.int32)
+        return jnp.where(masked[b] >= 0, b, jnp.int32(-1))
+
+    spec_y = jax.vmap(spec_one)(jnp.arange(S, dtype=jnp.int32))
+
+    cols = jnp.array(cfg.score_cols, jnp.int32)
+    nzm = jnp.array(cfg.col_nonzero)
+    slots = jnp.array(cfg.nonzero_slot, jnp.int32)
+
+    def step(stx: _WaveState, x: WaveXs):
+        w = x.widx
+        feasible, total = _eval(stx, w)
+        masked = jnp.where(feasible, total, -1)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        assigned = (masked[best] >= 0) & x.valid
+        g_i = assigned.astype(jnp.int32)
+        req_w = rows.req[w]
+        used = stx.used.at[best].add(jnp.where(assigned, req_w, 0))
+        nzu = stx.nonzero_used.at[best].add(
+            jnp.where(assigned, rows.nonzero_req[w], 0))
+        npods = stx.npods.at[best].add(g_i.astype(stx.npods.dtype))
+
+        # refresh the fit kernels of EVERY wave signature at the one
+        # touched node (_row_refresh semantics, vmapped over rows)
+        cap_row = na.cap[best]
+        used_row = used[best]
+        nz_row = nzu[best]
+        npods_b = npods[best]
+        allowed_b = na.allowed_pods[best]
+
+        def refresh_one(row_s: PodRow):
+            fit_b = ((npods_b + 1 <= allowed_b)
+                     & jnp.all((row_s.req == 0)
+                               | (used_row + row_s.req <= cap_row)))
+            cap_r = cap_row[cols][None, :]
+            used_nz_r = nz_row[slots] + row_s.nonzero_req[slots]
+            used_pl_r = used_row[cols] + row_s.req[cols]
+            used_cols_r = jnp.where(nzm, used_nz_r, used_pl_r)[None, :]
+            s_fit_b = least_allocated(cfg, cap_r, used_cols_r)[0]
+            s_bal_b = jnp.where(row_s.skip_balanced, 0,
+                                balanced_allocation(cap_r,
+                                                    used_pl_r[None, :])[0])
+            return fit_b, s_fit_b, s_bal_b
+
+        fit_b, sfit_b, sbal_b = jax.vmap(refresh_one)(rows)
+
+        def put_col(arr, new):
+            return arr.at[:, best].set(jnp.where(assigned, new,
+                                                 arr[:, best]))
+
+        fit_ok = put_col(stx.fit_ok, fit_b)
+        s_fit = put_col(stx.s_fit, sfit_b)
+        s_bal = put_col(stx.s_bal, sbal_b)
+
+        # group counter updates for the wave's consumer rows — the
+        # group_update increments with consumer axis U → S, placed row w
+        f_cnt, s_cnt = stx.f_cnt, stx.s_cnt
+        veto, a_cnt, a_total = stx.veto, stx.a_cnt, stx.a_total
+        aa_cnt, iscore = stx.aa_cnt, stx.iscore
+        if has_groups and fam.spr_f:
+            tvb_f = f_tv[:, :, best]                  # [S, SC]
+            eligb_f = f_elig[:, :, best]
+            inc_f = ((m_f[w] & eligb_f)[:, :, None]
+                     & (f_tv == tvb_f[:, :, None])
+                     & (tvb_f[:, :, None] != 0))
+            f_cnt = stx.f_cnt + g_i * inc_f.astype(jnp.int32)
+        if has_groups and fam.spr_s:
+            tvb_s = s_tv[:, :, best]
+            eligb_s = s_elig[:, :, best]
+            is_b = (jnp.arange(n, dtype=jnp.int32) == best)[None, None, :]
+            share_s = jnp.where(s_ishost[:, :, None], is_b,
+                                (s_tv == tvb_s[:, :, None])
+                                & (tvb_s[:, :, None] != 0))
+            gate_c = jnp.where(s_ishost, m_s[w], m_s[w] & eligb_s)
+            s_cnt = stx.s_cnt + g_i * (
+                gate_c[:, :, None] & share_s).astype(jnp.int32)
+        if has_groups and fam.ipa_anti:
+            tvb_p_anti = raa_tv[w, :, best]           # [TAA]
+            share_anti = ((raa_tv[w] == tvb_p_anti[:, None])
+                          & (tvb_p_anti[:, None] != 0))
+            delta_veto = jnp.sum(m_ex[w][:, :, None] & share_anti[None],
+                                 axis=1).astype(jnp.int32)
+            veto = stx.veto + g_i * delta_veto
+            tvb_aa = raa_tv[:, :, best]
+            share_aa = ((raa_tv == tvb_aa[:, :, None])
+                        & (tvb_aa[:, :, None] != 0))
+            inc_aa = m_aa[w][:, :, None] & share_aa
+            aa_cnt = stx.aa_cnt + g_i * inc_aa.astype(jnp.int32)
+        if has_groups and fam.ipa_req:
+            tvb_a = ra_tv[:, :, best]
+            share_a = ((ra_tv == tvb_a[:, :, None])
+                       & (tvb_a[:, :, None] != 0))
+            inc_a = ((m_a[w][:, None] & ra_act)[:, :, None] & share_a)
+            a_cnt = stx.a_cnt + g_i * inc_a.astype(jnp.int32)
+            a_total = stx.a_total + (
+                g_i * m_a[w]
+                * jnp.sum(ra_act & (tvb_a != 0), axis=1)).astype(jnp.int64)
+        if has_groups and fam.ipa_score:
+            tvb_c = stc_tv[:, :, best]
+            share_c = ((stc_tv == tvb_c[:, :, None])
+                       & (tvb_c[:, :, None] != 0))
+            d_cons = jnp.sum(w_c[w][:, :, None] * share_c, axis=1)
+            tvb_p = stp_tv[w, :, best]
+            share_p = ((stp_tv[w] == tvb_p[:, None])
+                       & (tvb_p[:, None] != 0))
+            d_plcd = jnp.sum(w_p[w][:, :, None] * share_p[None], axis=1)
+            iscore = stx.iscore + assigned.astype(jnp.int64) * (
+                d_cons + d_plcd)
+
+        cnt_sn = (stx.cnt_sn.at[w, best].add(g_i) if has_groups else None)
+        y = jnp.where(assigned, best, jnp.int32(-1))
+        conflict = x.valid & (y != spec_y[w])
+        prefix = stx.prefix + (stx.clean & x.valid
+                               & ~conflict).astype(jnp.int32)
+        return _WaveState(
+            used=used, nonzero_used=nzu, npods=npods,
+            fit_ok=fit_ok, s_fit=s_fit, s_bal=s_bal,
+            f_cnt=f_cnt, s_cnt=s_cnt, veto=veto, a_cnt=a_cnt,
+            a_total=a_total, aa_cnt=aa_cnt, iscore=iscore,
+            cnt_sn=cnt_sn, clean=stx.clean & ~conflict,
+            n_conf=stx.n_conf + conflict.astype(jnp.int32),
+            prefix=prefix), y
+
+    stf, ys = lax.scan(step, st0, xs)
+
+    # fold the accepted placements into the FULL group carry (batched,
+    # order-independent adds — ops/groups.py wave_fold)
+    new_gc = (wave_fold(gd, gc, wt, stf.cnt_sn, fam=fam) if has_groups
+              else carry.groups)
+    new_carry = Carry(used=stf.used, nonzero_used=stf.nonzero_used,
+                      npods=stf.npods, ports=carry.ports,
+                      cache=carry.cache._replace(sig=jnp.int32(0)),
+                      groups=new_gc)
+    packed = jnp.concatenate(
+        [ys, jnp.stack([stf.n_conf, stf.prefix])]).astype(jnp.int32)
+    return new_carry, packed
+
+
+@functools.lru_cache(maxsize=None)
+def _run_wave_scan_fn(donate: bool):
+    return jax.jit(_run_wave_scan_impl,
+                   static_argnames=("cfg", "fam", "norm_live", "has_groups"),
+                   donate_argnums=(2,) if donate else ())
+
+
+def run_wave_scan(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs: WaveXs,
+                  table: PodTableDev, wt, gd: GroupsDev, statics,
+                  fam: GroupFamilies, norm_live: bool,
+                  has_groups: bool = True):
+    """Jitted entry for `_run_wave_scan_impl`. The input carry is DONATED on
+    accelerator backends (the chain consumes it; donation frees the old
+    buffers without a device round trip); CPU has no donation support, so
+    the CPU variant compiles without it to avoid per-dispatch warnings.
+    `statics` is wave_statics(na, table, wt) ([S, N] each), cached by the
+    scheduler per signature set. `has_groups=False` compiles the LEAN
+    variant — no group state at all (gd may be None) — for drains of
+    non-interacting signatures whose alternation would thrash the scan's
+    one-slot signature cache."""
+    fn = _run_wave_scan_fn(jax.default_backend() != "cpu")
+    return fn(cfg, na, carry, xs, table, wt, gd, statics, fam, norm_live,
+              has_groups)
+
+
+@functools.partial(jax.jit, static_argnames=("feats",))
+def wave_statics(na: NodeArrays, table: PodTableDev, wt,
+                 feats: tuple = (True, True, True)):
+    """Carry-independent per-signature surfaces for the wave kernels —
+    static filter mask (name/unschedulable/taints/selector; ports vacuous
+    for sig != 0 rows), TaintToleration / preferred-affinity raw counts,
+    ImageLocality score. `wt` i32 [S] table rows → [S, N] arrays. The
+    scheduler caches the result per (table row, staging generation), so
+    the expensive broadcast kernels run once per signature per node-state
+    change instead of once per dispatch.
+
+    `feats` = (taints, selectors, images): static host-derived flags; a
+    False statically skips the matching kernel family — an unconstrained
+    signature (no cluster taints, no selectors, no images) pays none of
+    the padded broadcast compute."""
+    has_taints, has_sel, has_img = feats
+    fields = {name: getattr(table, name)[wt] for name in PodTableDev._fields}
+    rows = PodRow(valid=jnp.ones(wt.shape, bool),
+                  sig=jnp.ones(wt.shape, jnp.int32), **fields)
+    n = na.valid.shape[0]
+
+    def one(row: PodRow):
+        m = na.valid
+        m &= (row.node_name_id == 0) | (na.name_id == row.node_name_id)
+        m &= ~na.unschedulable | row.tolerates_unsched
+        if has_taints:
+            m &= taint_filter_mask(na, row)
+            traw = taint_prefer_count(na, row)
+        else:
+            traw = jnp.zeros((n,), jnp.int64)
+        if has_sel:
+            m &= selector_mask(na, row)
+            naraw = preferred_affinity_score(na, row)
+        else:
+            naraw = jnp.zeros((n,), jnp.int64)
+        simg = (image_locality_score(na, row) if has_img
+                else jnp.zeros((n,), jnp.int64))
+        return m, traw, naraw, simg
+
+    return jax.vmap(one)(rows)
+
+
+class _SameWaveState(NamedTuple):
+    """run_wave (same-signature) loop state."""
+
+    used: jnp.ndarray          # i64 [N, R]
+    nonzero_used: jnp.ndarray  # i64 [N, 2]
+    npods: jnp.ndarray         # i32 [N]
+    f_cnt: jnp.ndarray         # i32 [SC, N] — own-row spread filter counts
+    veto: jnp.ndarray          # i32 [N] — own-row existing-anti veto
+    aa_cnt: jnp.ndarray        # i32 [TAA, N] — own-row incoming-anti counts
+    cnt_n: jnp.ndarray         # i32 [N] — accepted placements per node
+    out: jnp.ndarray           # i32 [B] — assignments (-1 = none)
+    done: jnp.ndarray          # i32 — pods resolved so far
+    prog: jnp.ndarray          # bool — last merge wave made progress
+    ok: jnp.ndarray            # bool — merge preconditions still hold
+    waves: jnp.ndarray         # i32 — merge waves executed
+    confs: jnp.ndarray         # i32 — conflict (prefix-cut) events
+    first_prefix: jnp.ndarray  # i32 — first wave's accepted prefix length
+
+
+def _run_wave_same_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                        valid, table: PodTableDev, wt, gd: GroupsDev,
+                        statics, K: int, J: int, Lw: int,
+                        fam: GroupFamilies, norm_live: bool,
+                        anti_term: int, merge_on: bool):
+    """Speculative wave placement for a SAME-SIGNATURE run of group pods,
+    one device dispatch for the whole span.
+
+    Merge tier (a device while_loop of closed-form waves): each wave
+    speculates the run's next placements in parallel — the run_uniform
+    top-L merge over the [K, J] post-placement score matrix, extended with
+    the group structure: an `anti_term` (the row's self-matching required
+    anti-affinity) turns the merge into champion-per-topology-domain
+    selection (each placement vetoes its whole domain, so only a domain's
+    best node can ever be chosen), and the spread skew check is replayed
+    per speculated placement at DOMAIN level (cnt0 + rank-in-domain vs the
+    pre-wave minimum). The longest conflict-free prefix — no skew-mask
+    flip, no depth overflow, no domain re-entry — is accepted, its deltas
+    fold into the loop state, and the conflicted remainder re-enters the
+    next wave re-anchored on the updated counts. Exactness preconditions
+    are checked on the live data per wave (score-matrix monotonicity,
+    flat inter-pod-affinity score surface over the feasible set, no
+    dynamically skew-masked node at wave start); any failure stops the
+    merge tier with `ok=False`.
+
+    Serial tier: whatever the merge did not resolve (conflict-heavy or
+    precondition-failing remainders — the worst-case all-conflict wave)
+    is finished by an in-dispatch serial scan with the exact per-pod
+    rule, so the kernel ALWAYS returns the full span's assignments,
+    bit-identical to the host oracle's serial order.
+
+    `valid` is a prefix mask (bool [B], B static); `wt` the scalar table
+    row. Host-side gates (the scheduler checks): single device, no
+    nominations, sig != 0, no ScheduleAnyway constraints on the row, no
+    self-matching required affinity, no self score terms, at most one
+    self-matching anti term (`anti_term`, -1 = none; static).
+
+    Returns (carry, packed i32 [B + 4]): assignments, then
+    [merge_waves, conflict_events, first_wave_prefix, serial_steps]."""
+    from .groups import (INT32_MAX, GroupView, _dom_share, group_mask_view,
+                         group_scores_view, wave_fold)
+
+    gc = carry.groups
+    B = valid.shape[0]
+    n = na.cap.shape[0]
+    W = jnp.sum(valid).astype(jnp.int32)
+    fields = {name: getattr(table, name)[wt] for name in PodTableDev._fields}
+    row = PodRow(valid=jnp.bool_(True), sig=jnp.int32(1), **fields)
+
+    # carry-independent surfaces, hoisted out of the dispatch entirely
+    # (the scheduler computes them once per signature via wave_statics)
+    m0, taint_raw, na_raw, s_img = statics
+
+    # own-row group statics
+    f_act = gd.spr_f_active[wt]
+    f_skew = gd.spr_f_max_skew[wt]
+    f_self = gd.spr_f_self[wt]
+    f_minz = gc.spr_f_min_zero[wt]
+    f_tv = gd.spr_f_tv[wt]
+    f_elig = gd.spr_f_elig[wt]
+    f_dom = gd.spr_f_dom[wt]
+    s_act = gd.spr_s_active[wt]
+    s_skew = gd.spr_s_max_skew[wt]
+    s_ishost = gd.spr_s_is_host[wt]
+    s_tv = gd.spr_s_tv[wt]
+    s_keys = gd.spr_s_keys_ok[wt]
+    s_dom = gd.spr_s_dom[wt]
+    s_cnt0 = gc.spr_s_cnt[wt]          # static: no self ScheduleAnyway
+    ra_act = gd.ipa_ra_active[wt]
+    ra_tv = gd.ipa_ra_tv[wt]
+    raa_act = gd.ipa_raa_active[wt]
+    raa_tv = gd.ipa_raa_tv[wt]
+    raa_dom = gd.ipa_raa_dom[wt]
+    self_all = gd.ipa_self_all[wt]
+    a_cnt0 = gc.ipa_a_cnt[wt]          # static: no self required affinity
+    a_total0 = gc.ipa_a_total[wt]
+    iscore0 = gc.ipa_score[wt]         # static: no self score terms
+    mf_self = gd.m_spr_f[wt, wt]       # [SC]
+    mex_self = gd.m_ipa_exist[wt, wt]  # [TAA]
+    maa_self = gd.m_ipa_aa[wt, wt]
+    if anti_term >= 0:
+        anti_tv = raa_tv[anti_term]
+        anti_dom = raa_dom[anti_term]
+
+    def eval_row(used, nz, npods, f_cnt, veto, aa_cnt):
+        fit_ok = fit_mask(na.cap, used, npods, na.allowed_pods, row.req)
+        c2 = carry._replace(used=used, nonzero_used=nz)
+        s_fit, s_bal = _fit_scores(cfg, na, c2, row)
+        view = GroupView(
+            f_act=f_act, f_skew=f_skew, f_self=f_self, f_minz=f_minz,
+            f_tv=f_tv, f_elig=f_elig, f_cnt=f_cnt,
+            s_act=s_act, s_skew=s_skew, s_is_host=s_ishost, s_tv=s_tv,
+            s_keys_ok=s_keys, s_dom=s_dom, s_cnt=s_cnt0,
+            ra_act=ra_act, ra_tv=ra_tv, raa_act=raa_act, raa_tv=raa_tv,
+            self_all=self_all, veto=veto, a_cnt=a_cnt0, a_total=a_total0,
+            aa_cnt=aa_cnt, iscore=iscore0)
+        gmask = m0 & group_mask_view(view, fam)
+        feasible = gmask & fit_ok
+        if norm_live:
+            s_taint = default_normalize(taint_raw, feasible, reverse=True)
+            s_na = default_normalize(na_raw, feasible, reverse=False)
+            tn = cfg.w_taint * s_taint + cfg.w_node_affinity * s_na
+        else:
+            tn = cfg.w_taint * MAX_SCORE
+        total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal + tn
+                 + cfg.w_image * s_img)
+        total = total + group_scores_view(cfg.w_spread, cfg.w_ipa, view,
+                                          feasible, fam)
+        return gmask, feasible, total
+
+    # ---- merge tier -------------------------------------------------------
+
+    def merge_cond(st: _SameWaveState):
+        return st.ok & st.prog & (st.done < W)
+
+    def merge_body(st: _SameWaveState):
+        gmask, feasible0, total0 = eval_row(st.used, st.nonzero_used,
+                                            st.npods, st.f_cnt, st.veto,
+                                            st.aa_cnt)
+        masked0 = jnp.where(feasible0, total0, jnp.int64(-1))
+        # inter-pod score surface must be FLAT over the feasible set: its
+        # normalized contribution is then identically 0 and stays 0 as
+        # feasibility shrinks (the surface itself is static in-run)
+        big = jnp.iinfo(jnp.int64).max
+        isc_min = jnp.min(jnp.where(feasible0, iscore0, big))
+        isc_max = jnp.max(jnp.where(feasible0, iscore0, -big))
+        flat = isc_max <= isc_min
+        # spread skew check must not mask ANY keyed node at wave start:
+        # counts only grow and the pre-wave minimum only rises, so a wave
+        # whose replayed counts stay under the bound never flips a mask bit
+        if fam.spr_f:
+            minv = jnp.min(jnp.where(f_elig, st.f_cnt, INT32_MAX), axis=-1)
+            minv = jnp.where(f_minz, 0, minv)
+            ok_cn = (st.f_cnt + f_self[:, None] - minv[:, None]
+                     <= f_skew[:, None])
+            start_inert = jnp.all(~f_act[:, None] | (f_tv == 0) | ok_cn)
+        else:
+            minv = jnp.zeros(f_skew.shape, jnp.int32)
+            start_inert = jnp.bool_(True)
+
+        _, cand = lax.top_k(masked0.astype(jnp.int32), K)
+        cand = cand.astype(jnp.int32)
+        if anti_term >= 0:
+            # champion per anti-topology domain: every placement vetoes
+            # its whole domain, so only a domain's (score desc, idx asc)
+            # best can ever be chosen; keyless nodes are unconstrained
+            keyN = masked0 * n - jnp.arange(n, dtype=jnp.int64)
+            seg = jnp.full((n,), jnp.iinfo(jnp.int64).min).at[anti_dom].max(
+                jnp.where(anti_tv != 0, keyN, jnp.iinfo(jnp.int64).min))
+            champ = (anti_tv == 0) | ((anti_tv != 0) & (keyN == seg[anti_dom]))
+            champ_cand = champ[cand][:, None]
+            jcap = 1
+        else:
+            champ_cand = jnp.ones((K, 1), bool)
+            jcap = J
+        fit_kj, s_fit_kj, s_bal_kj = _uniform_matrix(
+            cfg, na, st.used, st.npods, st.used, st.nonzero_used,
+            cand, row, J)
+        static_add = (cfg.w_taint * MAX_SCORE + cfg.w_image * s_img)[cand]
+        score_kj = (cfg.w_fit * s_fit_kj + cfg.w_balanced * s_bal_kj
+                    + static_add[:, None])
+        jmask = jnp.arange(J)[None, :] < jcap
+        masked_kj = jnp.where(gmask[cand][:, None] & champ_cand & fit_kj
+                              & jmask, score_kj, jnp.int64(-1))
+        mono_ok = jnp.all(masked_kj[:, 1:] <= masked_kj[:, :-1])
+
+        # key = (score desc, node idx asc, j asc) — run_uniform's merge
+        score_max = MAX_SCORE * (cfg.w_fit + cfg.w_balanced + cfg.w_taint
+                                 + cfg.w_node_affinity + cfg.w_image)
+        M = n * J
+        key_dt = jnp.int32 if (score_max + 2) * M < 2 ** 31 else jnp.int64
+        ent_id = (cand[:, None].astype(key_dt) * J
+                  + jnp.arange(J, dtype=key_dt)[None, :])
+        flat_key = (masked_kj.astype(key_dt) * key_dt(M) - ent_id).reshape(K * J)
+        top_vals, flat_i = lax.top_k(flat_key, Lw)
+        krank = (flat_i // J).astype(jnp.int32)
+        node_i = cand[krank]
+        j_i = (flat_i % J).astype(jnp.int32)
+        avail = W - st.done
+        sel_ok = (top_vals > -key_dt(M)) & (jnp.arange(Lw) < avail)
+
+        # conflict detection over the speculated sequence
+        if fam.spr_f:
+            # replay the skew bound at domain level: entry i's new count =
+            # cnt0(dom) + rank-in-domain + 1, against the EXACT evolving
+            # minimum — min rises to min0+m once every eligible domain's
+            # count reaches min0+m, tracked level by level so a balanced
+            # fill (counts rising in lockstep) accepts the whole wave
+            gate = (mf_self[None, :] & f_elig[:, node_i].T
+                    & sel_ok[:, None])                     # [Lw, SC]
+            dom_ic = f_dom[:, node_i].T                    # [Lw, SC]
+            eq = dom_ic[None, :, :] == dom_ic[:, None, :]  # [i, j, SC]
+            lower = jnp.tril(jnp.ones((Lw, Lw), bool), -1)
+            r_ic = jnp.sum(eq & gate[None, :, :] & lower[:, :, None],
+                           axis=1).astype(jnp.int32)
+            newcnt = st.f_cnt[:, node_i].T + r_ic + 1
+            M_CAP = 32
+            lvlv = minv[:, None] + jnp.arange(1, M_CAP + 1,
+                                              dtype=jnp.int32)[None, :]
+            # D_need[c, m]: eligible domains still below min0+m. A domain
+            # id IS the index of one of its nodes, so the domain's count
+            # can be read at that slot — one scatter marks the domains
+            # with an eligible member, then the level compare is
+            # elementwise over the marked slots only.
+            elig_dom = jax.vmap(
+                lambda dom_c, el_c: jnp.zeros((n,), jnp.int32).at[dom_c].max(
+                    el_c.astype(jnp.int32)))(f_dom, f_elig)     # [SC, N]
+            d_need = jnp.sum(
+                (elig_dom[:, None, :] > 0)
+                & (st.f_cnt[:, None, :] < lvlv[:, :, None]),
+                axis=2).astype(jnp.int32)                        # [SC, M]
+            comp = (gate[:, :, None]
+                    & (newcnt[:, :, None] == lvlv[None, :, :]))  # [Lw,SC,M]
+            cum_excl = jnp.cumsum(comp, axis=0) - comp
+            reached = cum_excl >= d_need[None, :, :]
+            lvl_up = jnp.sum(reached, axis=2).astype(jnp.int32)  # [Lw, SC]
+            min_i = jnp.where(f_minz[None, :], 0, minv[None, :] + lvl_up)
+            viol = jnp.any(f_act[None, :] & gate
+                           & ((newcnt + f_self[None, :] - min_i
+                               > f_skew[None, :])
+                              | (lvl_up >= M_CAP)), axis=1)
+        else:
+            viol = jnp.zeros((Lw,), bool)
+        if anti_term >= 0:
+            # a keyless (no-topology) node hides its deeper entries from
+            # the jcap=1 merge: cut after it so the next wave re-offers it
+            viol |= anti_tv[node_i] == 0
+        else:
+            # depth cut: a candidate consuming its last matrix entry may
+            # have deserved more — stop there, the next wave re-anchors
+            viol |= j_i == J - 1
+        viol &= sel_ok
+        excl = jnp.cumsum(viol) - viol
+        accept = sel_ok & (excl == 0)
+        iter_ok = mono_ok & flat & start_inert
+        accept &= iter_ok
+        a = jnp.sum(accept).astype(jnp.int32)
+
+        cnt_add = jnp.zeros((n,), jnp.int32).at[node_i].add(
+            accept.astype(jnp.int32))
+        used2 = st.used + cnt_add[:, None].astype(jnp.int64) * row.req[None, :]
+        nz2 = (st.nonzero_used
+               + cnt_add[:, None].astype(jnp.int64) * row.nonzero_req[None, :])
+        npods2 = st.npods + cnt_add.astype(st.npods.dtype)
+        f_cnt2 = st.f_cnt
+        if fam.spr_f:
+            inc = _dom_share(f_tv, f_dom,
+                             f_elig.astype(jnp.int32) * cnt_add[None, :])
+            f_cnt2 = st.f_cnt + jnp.where(mf_self[:, None], inc, 0)
+        veto2, aa2 = st.veto, st.aa_cnt
+        if fam.ipa_anti:
+            sh = _dom_share(raa_tv, raa_dom,
+                            jnp.broadcast_to(cnt_add[None, :], raa_tv.shape))
+            veto2 = st.veto + jnp.sum(
+                jnp.where(mex_self[:, None], sh, 0), axis=0).astype(jnp.int32)
+            aa2 = st.aa_cnt + jnp.where(maa_self[:, None], sh,
+                                        0).astype(jnp.int32)
+        rank = jnp.cumsum(accept) - accept
+        pos = jnp.where(accept, st.done + rank, B)
+        out2 = st.out.at[pos].set(node_i, mode="drop")
+        return _SameWaveState(
+            used=used2, nonzero_used=nz2, npods=npods2, f_cnt=f_cnt2,
+            veto=veto2, aa_cnt=aa2, cnt_n=st.cnt_n + cnt_add, out=out2,
+            done=st.done + a, prog=a > 0, ok=st.ok & iter_ok,
+            waves=st.waves + 1,
+            confs=st.confs + ((a < avail) & iter_ok).astype(jnp.int32),
+            first_prefix=jnp.where(st.waves == 0, a, st.first_prefix))
+
+    st = _SameWaveState(
+        used=carry.used, nonzero_used=carry.nonzero_used, npods=carry.npods,
+        f_cnt=gc.spr_f_cnt[wt], veto=gc.ipa_veto[wt],
+        aa_cnt=gc.ipa_aa_cnt[wt], cnt_n=jnp.zeros((n,), jnp.int32),
+        out=jnp.full((B,), -1, jnp.int32), done=jnp.int32(0),
+        prog=jnp.bool_(True), ok=jnp.bool_(True), waves=jnp.int32(0),
+        confs=jnp.int32(0), first_prefix=jnp.int32(-1))
+    if merge_on and not norm_live:
+        st = lax.while_loop(merge_cond, merge_body, st)
+
+    # ---- serial tier: finish the remainder with the exact per-pod rule
+    def serial_cond(sv):
+        st, steps = sv
+        return st.done < W
+
+    def serial_body(sv):
+        st, steps = sv
+        _, feasible, total = eval_row(st.used, st.nonzero_used, st.npods,
+                                      st.f_cnt, st.veto, st.aa_cnt)
+        masked = jnp.where(feasible, total, -1)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        assigned = masked[best] >= 0
+        g = assigned.astype(jnp.int32)
+        used2 = st.used.at[best].add(jnp.where(assigned, row.req, 0))
+        nz2 = st.nonzero_used.at[best].add(
+            jnp.where(assigned, row.nonzero_req, 0))
+        npods2 = st.npods.at[best].add(g.astype(st.npods.dtype))
+        f_cnt2 = st.f_cnt
+        if fam.spr_f:
+            tvb = f_tv[:, best]
+            inc = ((mf_self & f_elig[:, best])[:, None]
+                   & (f_tv == tvb[:, None]) & (tvb[:, None] != 0))
+            f_cnt2 = st.f_cnt + g * inc.astype(jnp.int32)
+        veto2, aa2 = st.veto, st.aa_cnt
+        if fam.ipa_anti:
+            tvb_a = raa_tv[:, best]
+            share = (raa_tv == tvb_a[:, None]) & (tvb_a[:, None] != 0)
+            veto2 = st.veto + g * jnp.sum(
+                mex_self[:, None] & share, axis=0).astype(jnp.int32)
+            aa2 = st.aa_cnt + g * (maa_self[:, None] & share).astype(jnp.int32)
+        out2 = st.out.at[st.done].set(jnp.where(assigned, best, -1))
+        st2 = st._replace(used=used2, nonzero_used=nz2, npods=npods2,
+                          f_cnt=f_cnt2, veto=veto2, aa_cnt=aa2,
+                          cnt_n=st.cnt_n.at[best].add(g), out=out2,
+                          done=st.done + 1)
+        return st2, steps + 1
+
+    st, serial_steps = lax.while_loop(serial_cond, serial_body,
+                                      (st, jnp.int32(0)))
+
+    new_gc = wave_fold(gd, gc, jnp.reshape(wt, (1,)), st.cnt_n[None, :],
+                       fam=fam)
+    new_carry = Carry(used=st.used, nonzero_used=st.nonzero_used,
+                      npods=st.npods, ports=carry.ports,
+                      cache=carry.cache._replace(sig=jnp.int32(0)),
+                      groups=new_gc)
+    packed = jnp.concatenate(
+        [st.out, jnp.stack([st.waves, st.confs, st.first_prefix,
+                            serial_steps])]).astype(jnp.int32)
+    return new_carry, packed
+
+
+@functools.lru_cache(maxsize=None)
+def _run_wave_same_fn(donate: bool):
+    return jax.jit(_run_wave_same_impl,
+                   static_argnames=("cfg", "K", "J", "Lw", "fam",
+                                    "norm_live", "anti_term", "merge_on"),
+                   donate_argnums=(2,) if donate else ())
+
+
+def run_wave(cfg: ScoreConfig, na: NodeArrays, carry: Carry, valid,
+             table: PodTableDev, wt, gd: GroupsDev, statics, K: int, J: int,
+             fam: GroupFamilies, norm_live: bool, anti_term: int = -1,
+             merge_on: bool = True, Lw: int = 512):
+    """Jitted entry for the same-signature wave kernel; the input carry is
+    donated on accelerator backends (see run_batch). `statics` is the
+    signature's wave_statics row ([N] each); `Lw` caps the speculated
+    entries per merge wave (span-length independent, so one executable
+    serves every drain size)."""
+    fn = _run_wave_same_fn(jax.default_backend() != "cpu")
+    Lw = min(Lw, valid.shape[0])
+    return fn(cfg, na, carry, valid, table, wt, gd, statics, K, J, Lw,
+              fam, norm_live, anti_term, merge_on)
 
 
 # ---------------------------------------------------------------------------
@@ -906,6 +1694,9 @@ def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:
         s_fit=jnp.zeros((n,), jnp.int64),
         s_bal=jnp.zeros((n,), jnp.int64),
     )
-    return Carry(used=na.used, nonzero_used=na.nonzero_used,
-                 npods=na.npods, ports=na.ports, cache=zero_cache,
-                 groups=groups)
+    # COPY the seeded node state: the carry's buffers are donated to the
+    # device programs (run_batch/run_wave consume their input carry), so
+    # they must never alias the resident NodeArrays
+    return Carry(used=jnp.array(na.used), nonzero_used=jnp.array(na.nonzero_used),
+                 npods=jnp.array(na.npods), ports=jnp.array(na.ports),
+                 cache=zero_cache, groups=groups)

@@ -69,9 +69,10 @@ _CACHE_SPEC = SigCache(sig=P(), static_mask=P(NODE_AXIS), taint_raw=P(NODE_AXIS)
 
 # group tensors: node axis is the LAST dim of the node-indexed arrays; the
 # per-row scalars and pairwise match matrices are replicated
-_GD_NODE_FIELDS = ("spr_f_tv", "spr_f_elig", "spr_s_tv", "spr_s_elig",
-                   "spr_s_keys_ok", "spr_s_dom", "ipa_ra_tv", "ipa_raa_tv",
-                   "ipa_stc_tv", "ipa_stp_tv")
+_GD_NODE_FIELDS = ("spr_f_tv", "spr_f_elig", "spr_f_dom", "spr_s_tv",
+                   "spr_s_elig", "spr_s_keys_ok", "spr_s_dom", "ipa_ra_tv",
+                   "ipa_ra_dom", "ipa_raa_tv", "ipa_raa_dom", "ipa_stc_tv",
+                   "ipa_stc_dom", "ipa_stp_tv", "ipa_stp_dom")
 _GC_NODE_FIELDS = ("spr_f_cnt", "spr_s_cnt", "ipa_veto", "ipa_a_cnt",
                    "ipa_aa_cnt", "ipa_score")
 

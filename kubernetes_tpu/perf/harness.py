@@ -92,6 +92,10 @@ class DataItem:
     # pairs — lets bench.py report phases OUTSIDE the measured window
     # (e.g. PreemptionChurn's preemptor wave) without widening it
     op_seconds: list = field(default_factory=list)
+    # scheduler-side breakdown (drain phases, wave placement stats) pulled
+    # from the metrics registry after the run — bench.py merges these into
+    # each case's extras
+    extras: dict = field(default_factory=dict)
 
 
 class ThroughputCollector:
@@ -331,8 +335,21 @@ class WorkloadRunner:
                 raise ValueError(f"unknown opcode {code}")
             op_times.append((f"{code}[{op_i}]", time.perf_counter() - t_op))
         self.last_op_seconds = op_times
+        m = sched.metrics
+        extras = {
+            "host_build_s": round(m.drain_phase.sum("host_build"), 3),
+            "device_s": round(m.drain_phase.sum("device"), 3),
+            "commit_s": round(m.drain_phase.sum("commit"), 3),
+        }
+        waves = m.wave_placement_waves.value()
+        if waves:
+            nconf = m.wave_conflict_ratio.count()
+            extras["waves"] = int(waves)
+            extras["wave_conflict_ratio"] = round(
+                m.wave_conflict_ratio.sum() / max(nconf, 1), 4)
         for item in items:
             item.op_seconds = list(op_times)
+            item.extras = dict(extras)
         return items
 
 

@@ -40,8 +40,9 @@ from .framework.interface import Code, CycleState, Status
 from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
-from .ops.program import (PodXs, ScoreConfig, initial_carry,
-                          run_batch, run_uniform, table_from_batch)
+from .ops.program import (PodXs, ScoreConfig, WaveXs, initial_carry,
+                          run_batch, run_uniform, run_wave, run_wave_scan,
+                          table_from_batch, wave_statics)
 from .plugins import noderesources as nr
 from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
                                   PrioritySort, SchedulingGates,
@@ -185,18 +186,21 @@ def _needs_per_pod_hooks(profile: "Profile", spec) -> bool:
 
 @dataclass
 class _RunRec:
-    """One dispatched device run (a uniform top-L call or a scan segment)
-    awaiting readback. `carry_in` is the device carry the run consumed —
-    kept so an inexact uniform result can rewind and replay."""
+    """One dispatched device run (a uniform top-L call, a scan segment, or
+    a wave) awaiting readback. `carry_in` is the device carry the run
+    consumed — kept ONLY for uniform runs (the one kind that can rewind
+    and replay); scan/wave runs DONATE their input carry on accelerator
+    backends, so holding it would be a dangling reference."""
 
-    kind: str                 # "uniform" | "scan"
+    kind: str                 # "uniform" | "scan" | "wave" | "wavescan"
     i: int
     j: int
     carry_in: object
-    result: object            # device array: packed[L+2] or assignments
-    L: int = 0
+    result: object            # device array: packed or assignments
+    L: int = 0                # uniform L / wave bucket (packed layout)
     J: int = 0
     uniform: bool = False
+    span: tuple = ("scan",)   # full span descriptor (replay re-dispatch)
 
 
 @dataclass
@@ -284,6 +288,9 @@ class Scheduler:
         self.client = client
         self.clock = clock
         queue_backoffs = {}
+        from .config import apply_compilation_cache
+        apply_compilation_cache(
+            config.compilation_cache_dir if config is not None else None)
         from .config.features import default_gate
         self.feature_gates = default_gate(
             config.feature_gates if config is not None else None)
@@ -499,6 +506,13 @@ class Scheduler:
         #                              for; any pow2 crossing of the live
         #                              row count (or node growth) reseeds
         self._seeded_rows = 0        # signature rows whose counts are seeded
+        # wave placement: per-signature carry-independent surface cache
+        # (ops/program.py wave_statics) — rebuilt when node state or the
+        # signature table moves
+        self._wave_statics: dict[int, tuple] = {}
+        self._wave_statics_key = (-1, -1)
+        # below this span length the per-pod scan beats a wave dispatch
+        self.wave_min_span = 24
 
     # -- wiring ---------------------------------------------------------------
 
@@ -936,6 +950,7 @@ class Scheduler:
         (only the host-fallback retry path commits synchronously)."""
         from .ops.groups import scatter_new_rows, to_device
 
+        t_entry = _time.perf_counter()
         if not self._device_available():
             # circuit breaker open: the device tier is sidelined until the
             # cooldown expires; the host oracle takes the drain
@@ -985,7 +1000,10 @@ class Scheduler:
             self.builder.groups.any_groups()
             or bool(self.snapshot.have_pods_with_affinity_list)
             or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
-        if groups_needed:
+        if groups_needed and self._classify_wave(segment_batch,
+                                                 len(qpis)) is None:
+            # host greedy is the FALLBACK tier for group drains the wave
+            # kernels can't take (gate off, short spans, >4 signatures)
             bound = self._try_host_greedy(qpis, profile, segment_batch)
             if bound is not None:
                 return bound
@@ -1069,6 +1087,8 @@ class Scheduler:
             ovl = self._build_overlay(na)
             nom = self._nominated_rows(qpis)
         t0 = _time.perf_counter()
+        self.metrics.drain_phase.observe(max(t0 - t_entry, 0.0),
+                                         "host_build")
         try:
             with self.tracer.span("device_dispatch", pods=n,
                                   groups=groups_needed):
@@ -1082,6 +1102,8 @@ class Scheduler:
             self._record_device_fault("dispatch", e)
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
+        self.metrics.drain_phase.observe(
+            max(_time.perf_counter() - t0, 0.0), "device")
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
@@ -1164,10 +1186,12 @@ class Scheduler:
         n = len(qpis)
         # mesh mode is NOT excluded: the greedy reads the full numpy
         # staging arrays, which the host owns regardless of how the device
-        # copies are sharded; the post-run invalidation reseeds the shards
+        # copies are sharded; the post-run invalidation reseeds the shards.
+        # Both scoring strategies are supported — the greedy recomputes
+        # scores per step, so MostAllocated's non-monotone score sequences
+        # (which bar the closed-form uniform path) are exact here.
         if (self.queue.nominator.nominated_pods
                 or not self.feature_gates.enabled("OpportunisticBatching")
-                or profile.score_config.strategy != "LeastAllocated"
                 or n < self.UNIFORM_RUN_MIN):
             return None
         sig = batch.sig[:n]
@@ -1254,6 +1278,159 @@ class Scheduler:
             i = j
         return runs
 
+    # -- speculative wave placement (group drains) ----------------------------
+
+    def _wave_enabled(self) -> bool:
+        return (self.mesh is None
+                and self.feature_gates.enabled("SpeculativeWavePlacement"))
+
+    def _classify_wave(self, batch, n: int) -> Optional[tuple]:
+        """Wave descriptor for a group drain, or None when the wave
+        kernels can't take it (caller falls back to host greedy / scan).
+        ("wave", tidx, anti_term, merge_on) routes a same-signature drain
+        to the merge+serial kernel; ("wavescan", rows) routes a mixed
+        drain of ≤ 4 signatures to the multi-signature serial kernel."""
+        if not self._wave_enabled() or n < self.wave_min_span:
+            return None
+        sig = batch.sig[:n]
+        if (sig == 0).any() or not batch.valid[:n].all():
+            return None
+        uniq = list(dict.fromkeys(batch.tidx[:n].tolist()))
+        if len(uniq) == 1:
+            mode, anti = self._wave_same_mode(int(uniq[0]))
+            if mode is not None:
+                return ("wave", int(uniq[0]), anti, mode == "merge")
+        if len(uniq) <= 4:
+            return ("wavescan", tuple(int(u) for u in uniq))
+        return None
+
+    def _wave_same_mode(self, u: int):
+        """(mode, anti_term) for the same-signature kernel: "merge" runs
+        the closed-form wave loop (with `anti_term` the row's single
+        self-matching required-anti term, -1 = none), "serial" the exact
+        in-dispatch scan only, None = the row needs the multi-signature
+        kernel (its in-wave self-interactions — ScheduleAnyway counts,
+        required affinity, score terms — are outside the same-signature
+        state the kernel maintains)."""
+        g = self.builder.groups
+        if u >= len(g.rows):
+            return None, -1
+        if g.spr_s_active[u].any():
+            return None, -1
+        if g.m_ipa_a[u, u] and g.ipa_ra_active[u].any():
+            return None, -1
+        if g.w_stc[u, u].any() or g.w_stp[u, u].any():
+            return None, -1
+        terms = [t for t in range(g.m_ipa_aa.shape[2])
+                 if g.m_ipa_aa[u, u, t] or g.m_ipa_exist[u, u, t]]
+        if len(terms) > 1:
+            return "serial", -1
+        return "merge", (terms[0] if terms else -1)
+
+    def _wave_norm_static(self, rows: tuple) -> bool:
+        from .ops.hostgreedy import static_norm_ok
+        pref_w = self.builder.table.pref_weight
+        return all(static_norm_ok(self.state.arrays, pref_w[u])
+                   for u in rows)
+
+    def _get_wave_statics(self, na, table, rows: tuple) -> list:
+        """Cached wave_statics rows ([N] tuples per signature); the cache
+        lives until node state (staging generation) or the signature table
+        (reset) moves — the expensive per-signature kernels then run once
+        per workload change, not once per dispatch."""
+        key = (self.state.staging_gen, self.builder.reset_count)
+        if self._wave_statics_key != key:
+            self._wave_statics.clear()
+            self._wave_statics_key = key
+        missing = [u for u in dict.fromkeys(rows)
+                   if u not in self._wave_statics]
+        t = self.builder.table
+        a = self.state.arrays
+        has_taints = a is None or bool(
+            ((a.taint_key != 0) & a.valid[:, None]).any())
+        for c0 in range(0, len(missing), 4):
+            chunk = missing[c0:c0 + 4]
+            # pad only to the next pow2 row count — the common one-new-sig
+            # case must not pay the 4-row kernel 4× over
+            S = 1 if len(chunk) == 1 else (2 if len(chunk) == 2 else 4)
+            wts = (chunk + [chunk[-1]] * S)[:S]
+            # feature flags trim wave_statics to the kernels the rows can
+            # actually exercise (an unconstrained signature skips the
+            # padded taint/selector/image broadcasts entirely)
+            feats = (has_taints,
+                     any(bool(t.ns_sel_val[u].any()) or bool(t.aff_has[u])
+                         or bool(t.pref_weight[u].any()) for u in chunk),
+                     any(bool(t.img_containers[u]) for u in chunk))
+            m_, tr, nr, si = wave_statics(
+                na, table, jnp.asarray(np.array(wts, np.int32)), feats)
+            for k, u in enumerate(chunk):
+                self._wave_statics[u] = (m_[k], tr[k], nr[k], si[k])
+        return [self._wave_statics[u] for u in rows]
+
+    def _wave_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
+                       j: int, table, span):
+        """Dispatch the same-signature wave kernel over pods [i:j)."""
+        _, u, anti_term, merge_on = span
+        m = j - i
+        bucket = pow2_at_least(m)
+        valid = np.zeros((bucket,), bool)
+        valid[:m] = batch.valid[i:j]
+        statics = self._get_wave_statics(na, table, (u,))[0]
+        # spread replay carries an [Lw, Lw, SC] rank tensor — cap the wave
+        # width under it; without it wider waves just cut dispatch count
+        Lw = min(512 if self._gd_fam.spr_f else 1024, bucket)
+        K = min(Lw, na.cap.shape[0])
+        if anti_term >= 0 and not self._gd_fam.spr_f:
+            # domain-veto waves accept one entry per node (jcap=1): the
+            # deeper matrix columns would be masked — don't build them
+            J = 1
+        else:
+            _L, _K, J = self._uniform_shape(na)
+        norm_live = not self._wave_norm_static((u,))
+        carry2, packed = run_wave(
+            cfg, na, carry, jnp.asarray(valid), table, jnp.int32(u),
+            self._gd_dev, statics, K, J, self._gd_fam, norm_live,
+            anti_term=anti_term, merge_on=merge_on, Lw=Lw)
+        return carry2, packed, bucket
+
+    def _wavescan_dispatch(self, cfg: ScoreConfig, na, carry, batch,
+                           i: int, j: int, table, span):
+        """Dispatch the multi-signature wave kernel over pods [i:j).
+        Group drains carry the resident group tensors; LEAN spans
+        (non-interacting signatures of a group-free drain) compile the
+        variant without any group state."""
+        from .ops.groups import GroupFamilies
+
+        _, uniq = span
+        uniq = list(uniq)
+        m = j - i
+        bucket = pow2_at_least(m)
+        S = pow2_at_least(len(uniq), 2)
+        wt_list = (uniq + [uniq[-1]] * S)[:S]
+        slot: dict = {}
+        for s, u in enumerate(wt_list):
+            slot.setdefault(u, s)
+        widx = np.zeros((bucket,), np.int32)
+        tid = batch.tidx
+        for k in range(m):
+            widx[k] = slot[int(tid[i + k])]
+        widx[m:] = widx[m - 1]
+        valid = np.zeros((bucket,), bool)
+        valid[:m] = batch.valid[i:j]
+        statics_list = self._get_wave_statics(na, table, tuple(wt_list))
+        statics = tuple(jnp.stack([s[f] for s in statics_list])
+                        for f in range(4))
+        norm_live = not self._wave_norm_static(tuple(wt_list))
+        xs = WaveXs(valid=jnp.asarray(valid), widx=jnp.asarray(widx))
+        has_groups = self._gd_dev is not None
+        fam = self._gd_fam if has_groups else GroupFamilies(
+            False, False, False, False, False)
+        carry2, packed = run_wave_scan(
+            cfg, na, carry, xs, table,
+            jnp.asarray(np.array(wt_list, np.int32)), self._gd_dev,
+            statics, fam, norm_live, has_groups=has_groups)
+        return carry2, packed, bucket
+
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
                        n: int, groups_needed: bool, ovl=None, nom=None):
         """Dispatch the drain through the fastest exact program with ZERO
@@ -1270,6 +1447,14 @@ class Scheduler:
         BalancedAllocation non-monotonicity, depth-J overflow) can rewind
         and replay. Returns (chain carry, [_RunRec])."""
         cfg = profile.score_config
+        if groups_needed and ovl is None and nom is None:
+            wave = self._classify_wave(batch, n)
+            if wave is not None:
+                # speculative wave placement: the whole drain is one
+                # conflict-checked device dispatch against the resident
+                # carry (host greedy stays as the no-device fallback)
+                return self._dispatch_spans(cfg, na, batch, table,
+                                            [(0, n, wave)], carry)
         # nom != None → some drain pod needs per-pod self-exclusion, which
         # the closed-form uniform path cannot express: scan the drain
         fast_ok = (self.mesh is None and nom is None
@@ -1277,11 +1462,33 @@ class Scheduler:
                    and not groups_needed and cfg.strategy == "LeastAllocated"
                    and not self._cluster_has_prefer_taints())
         if not fast_ok:
-            spans = [(0, n, False)]
+            spans = [(0, n, ("scan",))]
         else:
-            spans = self._classify_runs(batch, n)
+            spans = [(i, j, ("uniform",) if uniform else ("scan",))
+                     for (i, j, uniform) in self._classify_runs(batch, n)]
+        if not groups_needed and ovl is None and nom is None:
+            # non-interacting signatures in a single wave: an alternating
+            # multi-signature stretch thrashes the scan's one-slot
+            # signature cache (full kernel recompute per step) — the LEAN
+            # wavescan evaluates each signature's surfaces once instead
+            spans = [self._lean_wave_span(batch, s) for s in spans]
         return self._dispatch_spans(cfg, na, batch, table, spans, carry,
                                     ovl=ovl, nom=nom)
+
+    def _lean_wave_span(self, batch, span):
+        """Upgrade an eligible scan span of a group-free drain to the lean
+        wavescan; anything ineligible keeps its kind."""
+        i, j, kind = span
+        if (kind[0] != "scan" or not self._wave_enabled()
+                or j - i < self.wave_min_span):
+            return span
+        sig = batch.sig[i:j]
+        if (sig == 0).any() or not batch.valid[i:j].all():
+            return span
+        uniq = list(dict.fromkeys(int(t) for t in batch.tidx[i:j]))
+        if len(uniq) > 16:
+            return span
+        return (i, j, ("wavescan", tuple(uniq)))
 
     def _uniform_shape(self, na) -> tuple[int, int, int]:
         """(L, K, J) for run_uniform, chosen to be STABLE across drains:
@@ -1298,23 +1505,37 @@ class Scheduler:
 
     def _dispatch_spans(self, cfg: ScoreConfig, na, batch, table,
                         spans, carry, ovl=None, nom=None):
-        """Dispatch the given (i, j, uniform) spans back-to-back, chaining
+        """Dispatch the given (i, j, kind) spans back-to-back, chaining
         the carry on device; issues async host copies so the tunnel
-        transfer overlaps whatever the host does next."""
+        transfer overlaps whatever the host does next. Only uniform
+        records keep their input carry (rewind support) — scan/wave runs
+        donate it on accelerator backends."""
         records = []
-        for (i, j, uniform) in spans:
-            if uniform:
+        for (i, j, kind) in spans:
+            tag = kind[0]
+            if tag == "uniform":
                 L, K, J = self._uniform_shape(na)
                 c2, packed = run_uniform(
                     cfg, na, carry, self._xone(batch, i), table,
                     np.int32(j - i), L, K, J, overlay=ovl)
                 records.append(_RunRec("uniform", i, j, carry, packed,
-                                       L, J, True))
+                                       L, J, True, span=kind))
+            elif tag == "wave":
+                c2, packed, bucket = self._wave_dispatch(
+                    cfg, na, carry, batch, i, j, table, kind)
+                records.append(_RunRec("wave", i, j, None, packed,
+                                       bucket, span=kind))
+            elif tag == "wavescan":
+                c2, packed, bucket = self._wavescan_dispatch(
+                    cfg, na, carry, batch, i, j, table, kind)
+                records.append(_RunRec("wavescan", i, j, None, packed,
+                                       bucket, span=kind))
             else:
                 c2, assigns = self._scan_dispatch(cfg, na, carry, batch,
                                                   i, j, table, ovl=ovl,
                                                   nom=nom)
-                records.append(_RunRec("scan", i, j, carry, assigns))
+                records.append(_RunRec("scan", i, j, None, assigns,
+                                       span=kind))
             carry = c2
         for rec in records:
             if hasattr(rec.result, "copy_to_host_async"):
@@ -1435,6 +1656,7 @@ class Scheduler:
         drains — against the corrected chain."""
         pd = self._pending.popleft()
         out = np.full((pd.n,), -1, np.int32)
+        t0 = _time.perf_counter()
         try:
             self._resolve_records(pd, out)
         except Exception as e:
@@ -1453,6 +1675,9 @@ class Scheduler:
             return
         if pd.records:
             self._record_device_success()
+            # readback wait (zero when the async copy already landed)
+            self.metrics.drain_phase.observe(
+                max(_time.perf_counter() - t0, 0.0), "device")
         self.metrics.device_batch_duration.observe(
             max(_time.perf_counter() - pd.dispatched_at, 0.0))
         self._commit_assignments(pd, out)
@@ -1467,6 +1692,11 @@ class Scheduler:
             m = rec.j - rec.i
             if rec.kind == "scan":
                 out[rec.i:rec.j] = r[:m]
+                idx += 1
+                continue
+            if rec.kind in ("wave", "wavescan"):
+                out[rec.i:rec.j] = r[:m]
+                self._observe_wave(rec, r, m)
                 idx += 1
                 continue
             exact, depth = bool(r[rec.L]), bool(r[rec.L + 1])
@@ -1487,7 +1717,7 @@ class Scheduler:
                                                ovl=pd.ovl, nom=pd.nom)
                 out[rec.i:rec.j] = np.asarray(a)[:m]
             # re-dispatch the rest of this drain ...
-            spans = [(q.i, q.j, q.uniform) for q in pd.records[idx + 1:]]
+            spans = [(q.i, q.j, q.span) for q in pd.records[idx + 1:]]
             carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
                                                    pd.table, spans, carry,
                                                    ovl=pd.ovl, nom=pd.nom)
@@ -1510,10 +1740,30 @@ class Scheduler:
                 self._device_carry = carry
             idx += 1
 
+    def _observe_wave(self, rec: _RunRec, r, m: int) -> None:
+        """Record a resolved wave's stats: waves executed, conflict ratio
+        (conflict-cut events + serially repaired pods over the span), and
+        the first wave's accepted conflict-free prefix length."""
+        B = rec.L
+        if rec.kind == "wave":
+            waves, confs = int(r[B]), int(r[B + 1])
+            prefix, serial = int(r[B + 2]), int(r[B + 3])
+            self.metrics.wave_placement_waves.inc(by=max(waves, 1))
+            self.metrics.wave_conflict_ratio.observe(
+                min((confs + serial) / max(m, 1), 1.0))
+            self.metrics.wave_accepted_prefix.observe(max(prefix, 0))
+        else:
+            confs, prefix = int(r[B]), int(r[B + 1])
+            self.metrics.wave_placement_waves.inc()
+            self.metrics.wave_conflict_ratio.observe(
+                min(confs / max(m, 1), 1.0))
+            self.metrics.wave_accepted_prefix.observe(max(prefix, 0))
+
     def _commit_assignments(self, pd: _PendingDrain, out) -> int:
         """Host commit of a resolved drain: bulk assume + bind enqueue for
         hook-free pods, the full reserve/permit/pre-bind chain for the
         rest, failure handling for the unassigned."""
+        t_commit = _time.perf_counter()
         qpis = pd.qpis
         profile = pd.profile
         fwk = profile.framework
@@ -1564,6 +1814,8 @@ class Scheduler:
             for qpi in failures:
                 err = self._device_fit_error(qpi, profile, diag_cache)
                 self._handle_failure(qpi, err)
+        self.metrics.drain_phase.observe(
+            max(_time.perf_counter() - t_commit, 0.0), "commit")
         klog.v(2).info("batch committed", profile=profile.name, pods=n,
                        bound=bound, unschedulable=len(failures),
                        latency_ms=round(per_pod * n * 1e3, 1))

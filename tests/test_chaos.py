@@ -146,6 +146,65 @@ def test_chaos_parity():
     assert not sched.cache.assumed_pods
 
 
+def _run_wave_parity_workload(api):
+    """Wave-path fault-parity twin (ISSUE 3): group pods (spread +
+    anti-affinity) ride the speculative wave kernels through the same
+    seeded fault script; waves must be fault-transparent — the resident
+    device carry either commits exactly or degrades to the host oracle,
+    never half-applies."""
+    clock = Clock()
+    sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+    sched.wave_min_span = 4
+    for i in range(18):
+        api.create_pod(make_pod(f"ws{i}")
+                       .req({"cpu": "500m", "memory": "512Mi"})
+                       .label("app", "wsp")
+                       .spread_constraint(2, "topology.kubernetes.io/zone",
+                                          "DoNotSchedule", {"app": "wsp"})
+                       .obj())
+    sched.schedule_pending()
+    if isinstance(api, ChaosAPIServer):
+        api.flap_node("n1")
+    for i in range(12):
+        api.create_pod(make_pod(f"wa{i}")
+                       .req({"cpu": "500m", "memory": "512Mi"})
+                       .label("anti", "wv")
+                       .pod_affinity("kubernetes.io/hostname",
+                                     {"anti": "wv"}, anti=True)
+                       .obj())
+    sched.schedule_pending()
+    clock.t += 40.0
+    sched.flush_queues()
+    _drive_to_quiescence(api, sched, clock, want_bound=24)
+    return sched
+
+
+def test_chaos_wave_parity():
+    """Fault-parity gate over the WAVE path: seeded transient faults on
+    bind/patch + a node flap while group drains run through run_wave ⇒
+    assignments identical to the fault-free run."""
+    clean_api = APIServer()
+    _nodes(clean_api)
+    clean_sched = _run_wave_parity_workload(clean_api)
+    clean = _assignments(clean_api)
+    assert clean_sched.metrics.wave_placement_waves.value() > 0, \
+        "the wave path must actually engage"
+
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED,
+        error_rates={"bind": 0.10, "patch": 0.10, "delete": 0.10},
+        latency_rate=0.25, latency_seconds=(0.001, 0.05)))
+    _nodes(chaos)
+    sched = _run_wave_parity_workload(chaos)
+    chaotic = _assignments(chaos.inner)
+
+    assert chaotic == clean
+    assert chaos.injected_errors["bind"] > 0
+    assert sched.dispatcher.retries > 0
+    assert sched.dispatcher.errors == 0
+    assert not sched.cache.assumed_pods
+
+
 def test_conflict_storm_routes_through_forget_requeue():
     """Conflicts are TERMINAL: no retry — forget the assumed pod, requeue
     with error backoff, and still converge to fully bound."""

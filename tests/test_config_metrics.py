@@ -36,6 +36,36 @@ class TestConfig:
         again = KubeSchedulerConfiguration.from_dict(cfg.to_dict())
         assert again == cfg
 
+    def test_compilation_cache_knob(self, tmp_path):
+        """ISSUE 3 satellite: the persistent XLA compilation cache knob
+        round-trips, applies to jax config once, and 'off' disables."""
+        import jax
+
+        from kubernetes_tpu import config as config_mod
+
+        cfg = KubeSchedulerConfiguration(
+            compilation_cache_dir=str(tmp_path / "xla"))
+        cfg.validate()
+        again = KubeSchedulerConfiguration.from_dict(cfg.to_dict())
+        assert again.compilation_cache_dir == cfg.compilation_cache_dir
+        # default present in the dict form
+        assert (KubeSchedulerConfiguration().to_dict()["compilationCacheDir"]
+                == "~/.cache/ktpu-xla")
+        prev_applied = config_mod._cc_applied
+        prev_dir = jax.config.jax_compilation_cache_dir
+        try:
+            config_mod._cc_applied = False
+            assert config_mod.apply_compilation_cache("off") is False
+            assert config_mod.apply_compilation_cache(
+                str(tmp_path / "xla")) is True
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+            # once-guard: a second call is a no-op (returns True, no rewrite)
+            assert config_mod.apply_compilation_cache("/nope") is True
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+        finally:
+            config_mod._cc_applied = prev_applied
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+
     def test_yaml_load(self, tmp_path):
         p = tmp_path / "cfg.yaml"
         p.write_text("""

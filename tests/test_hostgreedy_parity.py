@@ -190,6 +190,50 @@ class TestFuzz:
             scan_vs_greedy(nodes, existing, pods)
 
 
+class TestMostAllocated:
+    def test_most_allocated_spread_parity(self):
+        """ISSUE 3 satellite: the greedy recomputes scores per step, so
+        MostAllocated's non-monotone sequences (which bar the closed-form
+        uniform path) stay exact — same-signature group runs under the
+        bin-packing strategy skip the device scan too."""
+        cfg = ScoreConfig(strategy="MostAllocated")
+        nodes = _nodes(8, zones=4, cpu=32)
+        pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+                .label("app", "m")
+                .spread_constraint(2, ZONE, "DoNotSchedule", {"app": "m"})
+                .obj() for i in range(16)]
+        out = scan_vs_greedy(nodes, [], pods, cfg=cfg)
+        assert (out >= 0).all()
+
+    def test_most_allocated_engages_host_greedy(self):
+        """The eligibility gate no longer rejects MostAllocated: with the
+        wave path off, a same-signature group drain runs the greedy."""
+        from kubernetes_tpu.backend.apiserver import APIServer
+        from kubernetes_tpu.config import (KubeSchedulerConfiguration,
+                                           KubeSchedulerProfile)
+        from kubernetes_tpu.scheduler import Scheduler
+
+        cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile(
+            scoring_strategy="MostAllocated")])
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        sched.feature_gates.set("SpeculativeWavePlacement", False)
+        for i in range(6):
+            api.create_node(make_node(f"n{i}")
+                            .capacity({"cpu": 32, "memory": "64Gi",
+                                       "pods": 80})
+                            .zone(f"z{i % 3}")
+                            .label(HOSTNAME, f"n{i}").obj())
+        for i in range(20):
+            api.create_pod(make_pod(f"p{i}")
+                           .req({"cpu": "500m", "memory": "512Mi"})
+                           .label("app", "m")
+                           .spread_constraint(3, ZONE, "DoNotSchedule",
+                                              {"app": "m"}).obj())
+        assert sched.schedule_pending() == 20
+        assert sched.host_greedy_runs > 0
+
+
 class TestSchedulerIntegration:
     def test_greedy_path_matches_scan_path_end_to_end(self):
         """Same workload through two Schedulers — host greedy on vs off —

@@ -314,6 +314,9 @@ def test_mesh_host_greedy_parity():
     def run(mesh):
         api = APIServer()
         sched = Scheduler(api, batch_size=64, mesh=mesh)
+        # force the host greedy (the feature under test): the wave path
+        # would otherwise take the single-device drain
+        sched.feature_gates.set("SpeculativeWavePlacement", False)
         for i in range(8):
             api.create_node(make_node(f"n{i}")
                             .capacity({"cpu": 16, "memory": "32Gi", "pods": 40})
