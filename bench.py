@@ -128,6 +128,13 @@ def sharded_probe() -> dict:
 
 
 def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace-dir", default="",
+                    help="write one Chrome-trace JSON per workload "
+                         "(spans of the median-candidate measured passes; "
+                         "load at chrome://tracing or ui.perfetto.dev)")
+    args = ap.parse_args()
     # raise gen0 thresholds so collection cycles don't land in the measured
     # window; the freeze happens after each warm pass, once the long-lived
     # survivors (interners, jit caches, compiled executables) exist
@@ -158,7 +165,8 @@ def main() -> None:
         for _ in range(1 if small else 3):
             t0 = time.perf_counter()
             got = run_config(cfg, case, workload, verbose=verbose,
-                             metrics_path="bench_metrics.prom")
+                             metrics_path="bench_metrics.prom",
+                             trace_dir=args.trace_dir)
             measured_s += time.perf_counter() - t0
             if not got:
                 raise SystemExit(f"workload {case}/{workload} not found")

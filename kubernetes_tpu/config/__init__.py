@@ -74,6 +74,10 @@ class KubeSchedulerConfiguration:
     # persistent XLA compilation cache directory: warm-start passes skip
     # the 20-40s per-executable compiles entirely (empty string = off)
     compilation_cache_dir: str = "~/.cache/ktpu-xla"
+    # jax.profiler trace output directory: when set,
+    # Scheduler.profile_session() brackets work with an XLA-level profiler
+    # trace under the host spans (empty string = off)
+    profiler_trace_dir: str = ""
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
@@ -143,6 +147,7 @@ class KubeSchedulerConfiguration:
             "apiRetryMaxAttempts": self.api_retry_max_attempts,
             "apiRetryBaseSeconds": self.api_retry_base_seconds,
             "compilationCacheDir": self.compilation_cache_dir,
+            "profilerTraceDir": self.profiler_trace_dir,
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
         }
@@ -186,6 +191,7 @@ class KubeSchedulerConfiguration:
             api_retry_base_seconds=d.get("apiRetryBaseSeconds", 0.02),
             compilation_cache_dir=d.get("compilationCacheDir",
                                         "~/.cache/ktpu-xla"),
+            profiler_trace_dir=d.get("profilerTraceDir", ""),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))
 

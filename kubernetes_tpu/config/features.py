@@ -57,6 +57,10 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # serial-order parity (ops/program.py run_wave); off = the host
     # greedy / per-pod scan paths for every group drain
     "SpeculativeWavePlacement": FeatureSpec(True, BETA),
+    # mask-derived FailedScheduling diagnosis (ops/program.py diagnose_row):
+    # per-plugin rejected-node counts reduced from the device filter masks;
+    # off = the host-oracle filter replay per failed signature
+    "DeviceMaskDiagnosis": FeatureSpec(True, BETA),
 }
 
 

@@ -1,0 +1,224 @@
+"""Decision provenance: event recorder + per-drain flight recorder.
+
+Mirrors the event surface of the reference scheduler —
+`record.EventBroadcaster` (client-go tools/record, events.go) feeding
+`Scheduled` / `FailedScheduling` events through an aggregating sink — in
+this framework's in-process model:
+
+- `EventRecorder` is a ring-buffered, queryable sink. Events aggregate by
+  (object, type, reason, message) exactly like the reference
+  EventAggregator's correlator key, so a pod failing the same way across
+  retries holds ONE entry with a rising `count` instead of flooding the
+  ring. `Scheduled` events take a dedicated cheap path (the per-bind hot
+  loop must not pay message formatting; the message renders at dump time).
+- `FlightRecorder` keeps the last K drains' worth of "what did the
+  scheduler just do": batch size, signature count, per-phase wall times,
+  run kinds, wave conflict stats, fallback/circuit-breaker state and event
+  counts — the post-mortem the reference reconstructs from attempt
+  histograms plus trace sampling, kept resident here because the batched
+  device path makes the DRAIN (not the pod) the unit worth replaying.
+
+Both are served by the SchedulerServer's /debug endpoints.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+REASON_SCHEDULED = "Scheduled"
+REASON_FAILED_SCHEDULING = "FailedScheduling"
+REASON_PREEMPTED = "Preempted"
+
+
+@dataclass(slots=True)
+class Event:
+    """One aggregated event (events.go Event, consumed subset)."""
+
+    object_ref: str           # "namespace/name" of the involved pod
+    type: str                 # Normal | Warning
+    reason: str               # Scheduled | FailedScheduling | ...
+    message: str
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"object": self.object_ref, "type": self.type,
+                "reason": self.reason, "message": self.message,
+                "count": self.count,
+                "firstTimestamp": round(self.first_timestamp, 6),
+                "lastTimestamp": round(self.last_timestamp, 6)}
+
+
+class EventRecorder:
+    """Aggregating ring of scheduling events (EventBroadcaster analog).
+
+    `capacity` bounds distinct aggregation keys; the oldest key is evicted
+    on overflow (the reference relies on apiserver TTL instead). `metrics`
+    is a SchedulerMetrics — every recorded event increments
+    scheduler_events_total{type,reason} (including aggregated repeats,
+    matching the reference where each Eventf call counts)."""
+
+    def __init__(self, capacity: int = 4096,
+                 clock: Callable[[], float] = _time.monotonic,
+                 metrics=None):
+        self.capacity = capacity
+        self.clock = clock
+        self.metrics = metrics
+        self._events: "OrderedDict[tuple, Event]" = OrderedDict()
+        # Scheduled fast path: (object_ref, node_name, timestamp) tuples;
+        # message formatting deferred to query time
+        self._scheduled: deque = deque(maxlen=capacity)
+        self.counts: dict[tuple[str, str], int] = {}
+
+    # -- recording ------------------------------------------------------------
+
+    def event(self, object_ref: str, type_: str, reason: str,
+              message: str) -> None:
+        """Record one event, aggregating with prior identical ones."""
+        now = self.clock()
+        key = (object_ref, type_, reason, message)
+        ev = self._events.get(key)
+        if ev is not None:
+            ev.count += 1
+            ev.last_timestamp = now
+            self._events.move_to_end(key)
+        else:
+            self._events[key] = Event(object_ref=object_ref, type=type_,
+                                      reason=reason, message=message,
+                                      first_timestamp=now,
+                                      last_timestamp=now)
+            while len(self._events) > self.capacity:
+                self._events.popitem(last=False)
+        self._count(type_, reason)
+
+    def scheduled(self, object_ref: str, node_name: str) -> None:
+        """Cheap Scheduled event (per-bind hot path): no string formatting,
+        one deque append + one counter bump."""
+        self._scheduled.append((object_ref, node_name, self.clock()))
+        self._count(EVENT_NORMAL, REASON_SCHEDULED)
+
+    def scheduled_bulk(self, refs_nodes: list, now: Optional[float] = None
+                       ) -> None:
+        """Batched Scheduled events for a committed drain ([(ref, node)])."""
+        if not refs_nodes:
+            return
+        t = self.clock() if now is None else now
+        self._scheduled.extend((ref, node, t) for ref, node in refs_nodes)
+        self._count(EVENT_NORMAL, REASON_SCHEDULED, by=len(refs_nodes))
+
+    def _count(self, type_: str, reason: str, by: int = 1) -> None:
+        key = (type_, reason)
+        self.counts[key] = self.counts.get(key, 0) + by
+        if self.metrics is not None:
+            self.metrics.events_total.inc(type_, reason, by=by)
+
+    # -- querying -------------------------------------------------------------
+
+    @staticmethod
+    def scheduled_message(object_ref: str, node_name: str) -> str:
+        # reference schedule_one.go: "Successfully assigned <ns>/<name> to
+        # <node>"
+        return f"Successfully assigned {object_ref} to {node_name}"
+
+    def events(self, reason: Optional[str] = None,
+               object_ref: Optional[str] = None,
+               limit: int = 0) -> list[Event]:
+        """Newest-last event list, optionally filtered; Scheduled fast-path
+        entries are materialized into full Events here."""
+        out: list[Event] = []
+        if reason in (None, REASON_SCHEDULED):
+            for ref, node, t in self._scheduled:
+                if object_ref is not None and ref != object_ref:
+                    continue
+                out.append(Event(object_ref=ref, type=EVENT_NORMAL,
+                                 reason=REASON_SCHEDULED,
+                                 message=self.scheduled_message(ref, node),
+                                 first_timestamp=t, last_timestamp=t))
+        for ev in self._events.values():
+            if reason is not None and ev.reason != reason:
+                continue
+            if object_ref is not None and ev.object_ref != object_ref:
+                continue
+            out.append(ev)
+        out.sort(key=lambda e: e.last_timestamp)
+        if limit and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def dump(self, reason: Optional[str] = None, limit: int = 0) -> dict:
+        return {"counts": {f"{t}/{r}": c
+                           for (t, r), c in sorted(self.counts.items())},
+                "events": [e.to_dict()
+                           for e in self.events(reason=reason, limit=limit)]}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+@dataclass(slots=True)
+class FlightRecord:
+    """One drain's telemetry (fixed-size row of the flight ring)."""
+
+    seq: int
+    wall_time: float          # time.time() at commit (human correlation)
+    profile: str
+    pods: int                 # drain size
+    bound: int
+    failed: int
+    signatures: int           # distinct signature rows in the drain
+    kinds: tuple              # run kinds ("uniform"/"scan"/"wave"/...)
+    groups: bool
+    phases: dict              # phase name → seconds
+    wave: dict = field(default_factory=dict)   # waves/conflicts/prefix
+    breaker_open: bool = False
+    consecutive_faults: int = 0
+    fallback: str = ""        # "" = device path; else degradation reason
+    events: dict = field(default_factory=dict)  # reason → count this drain
+
+    def total_seconds(self) -> float:
+        return float(sum(self.phases.values()))
+
+    def to_dict(self) -> dict:
+        return {"seq": self.seq, "wallTime": round(self.wall_time, 6),
+                "profile": self.profile, "pods": self.pods,
+                "bound": self.bound, "failed": self.failed,
+                "signatures": self.signatures,
+                "kinds": list(self.kinds), "groups": self.groups,
+                "phases": {k: round(v, 6) for k, v in self.phases.items()},
+                "wave": self.wave, "breakerOpen": self.breaker_open,
+                "consecutiveFaults": self.consecutive_faults,
+                "fallback": self.fallback, "events": self.events}
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-drain FlightRecords."""
+
+    def __init__(self, capacity: int = 256):
+        self.ring: deque = deque(maxlen=capacity)
+        self._seq = itertools.count(1)
+
+    def record(self, **kw) -> FlightRecord:
+        rec = FlightRecord(seq=next(self._seq), wall_time=_time.time(), **kw)
+        self.ring.append(rec)
+        return rec
+
+    def dump(self, limit: int = 0) -> list[dict]:
+        records = list(self.ring)
+        if limit and len(records) > limit:
+            records = records[-limit:]
+        return [r.to_dict() for r in records]
+
+    def slowest(self, n: int = 16) -> list[dict]:
+        """The n slowest recorded drains by total phase time."""
+        return [r.to_dict()
+                for r in sorted(self.ring, key=FlightRecord.total_seconds,
+                                reverse=True)[:n]]

@@ -289,6 +289,33 @@ class Diagnosis:
     unschedulable_plugins: set[str] = field(default_factory=set)
     pending_plugins: set[str] = field(default_factory=set)
     pre_filter_msg: str = ""
+    # memoized aggregations (one Diagnosis is shared by every same-signature
+    # pod of a failed drain; a 5k-node histogram must not be recomputed per
+    # pod). Invalidation is unnecessary: node_to_status is write-once.
+    _reasons_hist: Optional[dict] = None
+    _plugin_counts: Optional[dict] = None
+
+    def reasons_histogram(self) -> dict[str, int]:
+        """reason string → node count; a node contributes once per reason
+        its status carries (reference types.go FitError.Error histogram)."""
+        if self._reasons_hist is None:
+            hist: dict[str, int] = {}
+            for status in self.node_to_status.values():
+                for r in status.reasons:
+                    hist[r] = hist.get(r, 0) + 1
+            self._reasons_hist = hist
+        return self._reasons_hist
+
+    def plugin_node_counts(self) -> dict[str, int]:
+        """rejecting plugin → node count (each node counts once, under the
+        first plugin that rejected it)."""
+        if self._plugin_counts is None:
+            counts: dict[str, int] = {}
+            for status in self.node_to_status.values():
+                p = status.plugin or "?"
+                counts[p] = counts.get(p, 0) + 1
+            self._plugin_counts = counts
+        return self._plugin_counts
 
 
 @dataclass
@@ -298,8 +325,19 @@ class FitError(Exception):
     diagnosis: Diagnosis = field(default_factory=Diagnosis)
 
     def __str__(self) -> str:
-        return (f"0/{self.num_all_nodes} nodes are available for pod "
-                f"{self.pod.namespace}/{self.pod.name}")
+        """Reference types.go FitError.Error(): '0/N nodes are available:
+        <count> <reason>, ...' with reasons sorted alphabetically (the
+        FailedScheduling event body)."""
+        if self.diagnosis.pre_filter_msg:
+            return (f"0/{self.num_all_nodes} nodes are available: "
+                    f"{self.diagnosis.pre_filter_msg}.")
+        hist = self.diagnosis.reasons_histogram()
+        if not hist:
+            return (f"0/{self.num_all_nodes} nodes are available for pod "
+                    f"{self.pod.namespace}/{self.pod.name}")
+        body = ", ".join(f"{count} {reason}"
+                         for reason, count in sorted(hist.items()))
+        return f"0/{self.num_all_nodes} nodes are available: {body}."
 
 
 from .interface import Status  # noqa: E402  (bottom import to avoid cycle)

@@ -35,10 +35,24 @@ from typing import Callable, Optional
 SUBSYSTEM = "scheduler"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (exposition format spec):
+    backslash, double-quote and line-feed must be escaped — raw values
+    break every scrape parser on the first quote or newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(h: str) -> str:
+    """HELP text escaping: backslash and line-feed only (quotes are legal
+    in HELP per the text-format spec)."""
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(f'{n}="{_escape_label_value(v)}"'
+                     for n, v in zip(names, values))
     return "{" + inner + "}"
 
 
@@ -57,7 +71,7 @@ class Counter:
         return self._values.get(tuple(labels), 0.0)
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} counter"]
         for key, v in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
@@ -83,7 +97,7 @@ class Gauge:
 
     def expose(self) -> list[str]:
         values = self.callback() if self.callback is not None else self._values
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} gauge"]
         for key, v in sorted(values.items()):
             out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v:g}")
@@ -149,8 +163,31 @@ class Histogram:
     def sum(self, *labels: str) -> float:
         return self._sums.get(tuple(labels), 0.0)
 
+    def quantile(self, q: float) -> float:
+        """histogram_quantile over ALL label sets merged (bench reporting):
+        the value of the bucket upper edge holding the q-th observation,
+        linearly interpolated inside the bucket like PromQL. Returns 0.0
+        with no observations; the top bucket clamps to its lower edge."""
+        merged = [0] * (len(self.buckets) + 1)
+        for counts in self._counts.values():
+            for i, c in enumerate(counts):
+                merged[i] += c
+        total = sum(merged)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(merged):
+            prev = cum
+            cum += c
+            if cum >= rank and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i] if i < len(self.buckets) else lo
+                return lo + (hi - lo) * ((rank - prev) / c)
+        return self.buckets[-1]
+
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         for key, counts in sorted(self._counts.items()):
             cumulative = 0
@@ -196,6 +233,18 @@ class Registry:
 SCHEDULED = "scheduled"
 UNSCHEDULABLE = "unschedulable"
 ERROR = "error"
+
+DEFAULT_PROFILE = "default-scheduler"
+
+# the device-modeled plugin set, used to pre-seed per-plugin series (the
+# kernel-backed filters/scorers every device batch evaluates)
+DEVICE_FILTER_PLUGINS = (
+    "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+    "NodePorts", "NodeResourcesFit", "PodTopologySpread", "InterPodAffinity")
+DEVICE_SCORE_PLUGINS = (
+    "TaintToleration", "NodeAffinity", "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation", "PodTopologySpread",
+    "InterPodAffinity", "ImageLocality")
 
 
 class SchedulerMetrics:
@@ -303,8 +352,21 @@ class SchedulerMetrics:
             n + "drain_phase_seconds",
             "Per-drain wall time by phase: host_build (snapshot + batch "
             "+ group seeding), device (dispatch + readback wait), commit "
-            "(assume + bind enqueue + failure handling).",
+            "(assume + bind enqueue + failure handling). host_build "
+            "decomposes into host_snapshot / host_tensorize / "
+            "host_group_seed / host_cache children.",
             label_names=("phase",)))
+        self.events_total = r.register(Counter(
+            n + "events_total",
+            "Scheduling events emitted by the event recorder, by type "
+            "(Normal/Warning) and reason (events.go analog).",
+            ("type", "reason")))
+        self.unschedulable_nodes = r.register(Histogram(
+            n + "unschedulable_nodes",
+            "Per-FailedScheduling rejected-node count, by the plugin that "
+            "rejected them (device mask-derived diagnosis).",
+            buckets=[1, 8, 64, 512, 2048, 8192, 32768],
+            label_names=("plugin",)))
         # pre-seed the zero samples so dashboards (and bench_metrics.prom)
         # always carry the fault-path series, faults or not
         from ..backend.dispatcher import CallType
@@ -317,8 +379,46 @@ class SchedulerMetrics:
         self.wave_placement_waves.inc(by=0)
         self.wave_conflict_ratio.seed()
         self.wave_accepted_prefix.seed()
-        for phase in ("host_build", "device", "commit"):
+        for phase in ("host_build", "device", "commit",
+                      "host_snapshot", "host_tensorize",
+                      "host_group_seed", "host_cache"):
             self.drain_phase.seed(phase)
+        # remaining registered-but-unseeded series: dashboards and
+        # bench_metrics.prom must carry every series even when the run
+        # never observes them (no permit waits, no divergence, no events)
+        for result in (SCHEDULED, UNSCHEDULABLE, ERROR):
+            self.schedule_attempts.inc(result, DEFAULT_PROFILE, by=0)
+            self.attempt_duration.seed(result, DEFAULT_PROFILE)
+        for result in ("allowed", "rejected"):
+            self.permit_wait_duration.seed(result)
+        self.sli_duration.seed("1")
+        self.device_batch_size.seed()
+        self.device_batch_duration.seed()
+        self.preemption_victims.seed()
+        self.preemption_attempts.inc(by=0)
+        for state in ("open", "closed"):
+            self.circuit_breaker_transitions.inc(state, by=0)
+        for queue, event in (("active", "PodAdd"), ("gated", "PodAdd"),
+                             ("unschedulable", "ScheduleAttemptFailure")):
+            self.queue_incoming_pods.inc(queue, event, by=0)
+        from ..backend.dispatcher import CallType
+        for ct in CallType:
+            self.api_dispatcher_calls.inc(ct.value, "success", by=0)
+        for kind in ("device_vs_host", "host_vs_apiserver"):
+            self.cache_divergence.inc(kind, by=0)
+        for etype, reason in (("Normal", "Scheduled"),
+                              ("Warning", "FailedScheduling")):
+            self.events_total.inc(etype, reason, by=0)
+        for plugin in DEVICE_FILTER_PLUGINS:
+            self.unschedulable_nodes.seed(plugin)
+        for plugin in DEVICE_FILTER_PLUGINS:
+            self.plugin_execution_duration.seed(plugin, "Filter", "SUCCESS")
+            self.plugin_evaluation_total.inc(plugin, "Filter",
+                                             DEFAULT_PROFILE, by=0)
+        for plugin in DEVICE_SCORE_PLUGINS:
+            self.plugin_execution_duration.seed(plugin, "Score", "SUCCESS")
+            self.plugin_evaluation_total.inc(plugin, "Score",
+                                             DEFAULT_PROFILE, by=0)
 
     def exposition(self) -> str:
         return self.registry.exposition()

@@ -337,6 +337,55 @@ def group_mask(gd: GroupsDev, gc: GroupCarry, tidx, axis: Optional[str] = None,
     return group_mask_view(view_of(gd, gc, tidx), fam or ALL_FAMILIES, axis)
 
 
+def group_reason_masks(gd: GroupsDev, gc: GroupCarry, tidx,
+                       fam: Optional[GroupFamilies] = None,
+                       axis: Optional[str] = None):
+    """Diagnosis companion to `group_mask`: the SAME formulas, split into
+    the five per-node failure masks the host filters report —
+    (spr_missing, spr_skew, aff_fail, anti_fail, exist_fail), each bool
+    [N]. Spread attributes each node to its FIRST failing constraint (the
+    host filter iterates constraints in order and returns on the first
+    violation, podtopologyspread filtering); the caller layers these under
+    the host's plugin order (spread before inter-pod affinity)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    fam = fam or ALL_FAMILIES
+    v = view_of(gd, gc, tidx)
+    n = v.veto.shape[-1]
+    false = jnp.zeros((n,), bool)
+    spr_missing = spr_skew = aff_fail = anti_fail = exist_fail = false
+
+    if fam.spr_f:
+        minv = jnp.min(jnp.where(v.f_elig, v.f_cnt, INT32_MAX), axis=-1)
+        if axis is not None:
+            minv = lax.pmin(minv, axis)
+        minv = jnp.where(v.f_minz, 0, minv)
+        ok = (v.f_cnt + v.f_self[:, None] - minv[:, None]
+              <= v.f_skew[:, None])
+        missing_c = v.f_act[:, None] & (v.f_tv == 0)        # [SC, N]
+        fail_c = v.f_act[:, None] & ((v.f_tv == 0) | ~ok)
+        any_fail = jnp.any(fail_c, axis=0)
+        first_c = jnp.argmax(fail_c, axis=0)                # [N]
+        first_missing = jnp.take_along_axis(
+            missing_c, first_c[None, :], axis=0)[0]
+        spr_missing = any_fail & first_missing
+        spr_skew = any_fail & ~first_missing
+
+    if fam.ipa_req:
+        tv_all = jnp.all(~v.ra_act[:, None] | (v.ra_tv != 0), axis=0)
+        pods_exist = jnp.all(~v.ra_act[:, None] | (v.a_cnt > 0), axis=0)
+        escape = (v.a_total == 0) & v.self_all
+        aff_fail = jnp.any(v.ra_act) & ~(tv_all & (pods_exist | escape))
+
+    if fam.ipa_anti:
+        anti_fail = jnp.any(v.raa_act[:, None] & (v.raa_tv != 0)
+                            & (v.aa_cnt > 0), axis=0)
+        exist_fail = v.veto != 0
+
+    return spr_missing, spr_skew, aff_fail, anti_fail, exist_fail
+
+
 def group_scores_view(w_spread: int, w_ipa: int, v: GroupView, feasible,
                       fam: GroupFamilies, axis: Optional[str] = None,
                       n_global: Optional[int] = None):

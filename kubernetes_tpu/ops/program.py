@@ -536,6 +536,94 @@ def _eval_pod(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pod: PodRow,
     return feasible, total, parts
 
 
+# ---------------------------------------------------------------------------
+# failure diagnosis reduction
+#
+# The host oracle recovers "why did this pod fail" by replaying every filter
+# plugin over every node in Python (find_nodes_that_pass_filters). The same
+# information is already present in the device filter masks; this reduction
+# attributes every rejected node to its FIRST failing filter in the host
+# plugin order — the sequential-filter semantics of run_filter_plugins,
+# where a node's status comes from the first plugin that rejects it — plus
+# the per-resource fit detail the NodeResourcesFit reasons need.
+
+# reason slots (host filter order; plugins the device kernels model)
+DIAG_FEASIBLE = 0
+DIAG_INVALID = -1                 # padding / freed node row
+DIAG_NODE_UNSCHEDULABLE = 1
+DIAG_NODE_NAME = 2
+DIAG_TAINT = 3
+DIAG_NODE_AFFINITY = 4
+DIAG_PORTS = 5
+DIAG_FIT = 6
+DIAG_SPREAD_LABEL = 7             # missing topology key (unresolvable)
+DIAG_SPREAD_SKEW = 8
+DIAG_IPA_AFFINITY = 9
+DIAG_IPA_ANTI = 10
+DIAG_IPA_EXISTING_ANTI = 11
+
+
+def _diagnose_masks(na: NodeArrays, pod: PodRow, gd, gc, tidx, fam):
+    """Per-node first-failing-filter slot + fit detail, all [N]-shaped."""
+    from ..ops.groups import group_reason_masks
+
+    unsched_ok = ~na.unschedulable | pod.tolerates_unsched
+    name_ok = (pod.node_name_id == 0) | (na.name_id == pod.node_name_id)
+    taint_ok = taint_filter_mask(na, pod)
+    sel_ok = selector_mask(na, pod)
+    ports_ok = ports_mask(na.ports, pod.port_ids)
+    pods_fail = na.npods + 1 > na.allowed_pods
+    cols_fail = (pod.req[None, :] != 0) & (na.used + pod.req[None, :]
+                                           > na.cap)           # [N, R]
+    fit_ok = ~pods_fail & ~jnp.any(cols_fail, axis=1)
+    n = na.valid.shape[0]
+    false = jnp.zeros((n,), bool)
+    if gd is not None:
+        spr_missing, spr_skew, aff_f, anti_f, exist_f = group_reason_masks(
+            gd, gc, tidx, fam)
+    else:
+        spr_missing = spr_skew = aff_f = anti_f = exist_f = false
+    slot = jnp.select(
+        [~na.valid,
+         ~unsched_ok, ~name_ok, ~taint_ok, ~sel_ok, ~ports_ok, ~fit_ok,
+         spr_missing, spr_skew, aff_f, anti_f, exist_f],
+        [jnp.int32(DIAG_INVALID), jnp.int32(DIAG_NODE_UNSCHEDULABLE),
+         jnp.int32(DIAG_NODE_NAME), jnp.int32(DIAG_TAINT),
+         jnp.int32(DIAG_NODE_AFFINITY), jnp.int32(DIAG_PORTS),
+         jnp.int32(DIAG_FIT), jnp.int32(DIAG_SPREAD_LABEL),
+         jnp.int32(DIAG_SPREAD_SKEW), jnp.int32(DIAG_IPA_AFFINITY),
+         jnp.int32(DIAG_IPA_ANTI), jnp.int32(DIAG_IPA_EXISTING_ANTI)],
+        default=jnp.int32(DIAG_FEASIBLE))
+    return slot, pods_fail, cols_fail
+
+
+@functools.partial(jax.jit, static_argnames=("fam",))
+def _diagnose_groups(na: NodeArrays, table: PodTableDev, tidx, gd, gc, fam):
+    pod = _gather_row(table, PodXs(valid=jnp.bool_(True), sig=jnp.int32(0),
+                                   tidx=tidx))
+    return _diagnose_masks(na, pod, gd, gc, tidx, fam)
+
+
+@jax.jit
+def _diagnose_lean(na: NodeArrays, table: PodTableDev, tidx):
+    pod = _gather_row(table, PodXs(valid=jnp.bool_(True), sig=jnp.int32(0),
+                                   tidx=tidx))
+    return _diagnose_masks(na, pod, None, None, tidx, None)
+
+
+def diagnose_row(na: NodeArrays, table: PodTableDev, tidx: int,
+                 gd=None, gc=None, fam=None):
+    """Reduce the filter masks of signature row `tidx` against node state
+    `na` (used/npods/ports = the post-commit truth) into
+    (slot i32 [N], fit_pods_fail bool [N], fit_cols_fail bool [N, R]):
+    `slot` holds each node's first failing filter (DIAG_*), the fit arrays
+    carry the per-reason detail for DIAG_FIT nodes ("Too many pods" /
+    per-column Insufficient)."""
+    if gd is not None:
+        return _diagnose_groups(na, table, jnp.int32(tidx), gd, gc, fam)
+    return _diagnose_lean(na, table, jnp.int32(tidx))
+
+
 def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
                       assigned: jnp.ndarray) -> Carry:
     onehot = (jnp.arange(carry.npods.shape[0], dtype=jnp.int32) == best) & assigned

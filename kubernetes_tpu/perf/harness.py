@@ -237,7 +237,8 @@ class WorkloadRunner:
     """Executes one workload's op list against a fresh Scheduler."""
 
     def __init__(self, scheduler_factory: Optional[Callable[[APIServer], Scheduler]] = None,
-                 batch_size: int = 8192, create_batch: int = 512):
+                 batch_size: int = 8192, create_batch: int = 512,
+                 trace: bool = False):
         # `create_batch` streams pods in realistic chunks (the reference
         # benchmark's createPods ingestion rate is bounded by client
         # QPS/Burst 5000, util.go:123-124); the async commit pipeline
@@ -246,12 +247,21 @@ class WorkloadRunner:
         # round trip. `batch_size` only caps a single drain.
         self.batch_size = batch_size
         self.create_batch = create_batch
+        self.trace = trace
+        self.last_tracer = None
         self.factory = scheduler_factory or (
             lambda api: Scheduler(api, batch_size=batch_size))
 
     def run(self, tc: TestCase, wl: Workload, verbose: bool = False) -> list[DataItem]:
         api = APIServer()
         sched = self.last_scheduler = self.factory(api)
+        if self.trace:
+            # capture EVERY cycle's span tree for Chrome-trace export
+            # (bench --trace-dir): slow-threshold inf keeps the slow ring
+            # quiet, keep_recent retains the full drain history
+            from ..utils.tracing import Tracer
+            self.last_tracer = sched.tracer = Tracer(
+                slow_threshold_s=float("inf"), keep_recent=65536)
         params = wl.params
         items: list[DataItem] = []
         node_seq = 0
@@ -340,6 +350,18 @@ class WorkloadRunner:
             "host_build_s": round(m.drain_phase.sum("host_build"), 3),
             "device_s": round(m.drain_phase.sum("device"), 3),
             "commit_s": round(m.drain_phase.sum("commit"), 3),
+            # host_build decomposition (this PR's observability layer)
+            "host_snapshot_s": round(m.drain_phase.sum("host_snapshot"), 3),
+            "host_tensorize_s": round(m.drain_phase.sum("host_tensorize"), 3),
+            "host_group_seed_s": round(
+                m.drain_phase.sum("host_group_seed"), 3),
+            "host_cache_s": round(m.drain_phase.sum("host_cache"), 3),
+            # per-attempt latency percentiles from the attempt-duration
+            # histogram (all result/profile series merged)
+            "attempt_p50_ms": round(
+                m.attempt_duration.quantile(0.50) * 1e3, 3),
+            "attempt_p99_ms": round(
+                m.attempt_duration.quantile(0.99) * 1e3, 3),
         }
         waves = m.wave_placement_waves.value()
         if waves:
@@ -355,10 +377,13 @@ class WorkloadRunner:
 
 def run_config(path: str, case_filter: str = "", workload_filter: str = "",
                verbose: bool = False, scheduler_factory=None,
-               metrics_path: str = "") -> list[tuple[DataItem, float]]:
+               metrics_path: str = "",
+               trace_dir: str = "") -> list[tuple[DataItem, float]]:
     """Run matching (case, workload) pairs; returns [(item, threshold)].
     `metrics_path` appends each run's Prometheus exposition (the reference
-    benchmark collects /metrics the same way, scheduler_perf/util.go)."""
+    benchmark collects /metrics the same way, scheduler_perf/util.go);
+    `trace_dir` writes one Chrome-trace JSON of the run's span trees per
+    workload (loadable at chrome://tracing / ui.perfetto.dev)."""
     out = []
     for tc in load_test_cases(path):
         if case_filter and case_filter != tc.name:
@@ -366,11 +391,19 @@ def run_config(path: str, case_filter: str = "", workload_filter: str = "",
         for wl in tc.workloads:
             if workload_filter and workload_filter != wl.name:
                 continue
-            runner = WorkloadRunner(scheduler_factory=scheduler_factory)
+            runner = WorkloadRunner(scheduler_factory=scheduler_factory,
+                                    trace=bool(trace_dir))
             for item in runner.run(tc, wl, verbose=verbose):
                 out.append((item, wl.threshold))
             if metrics_path:
                 with open(metrics_path, "a") as f:
                     f.write(f"# == {tc.name}/{wl.name} ==\n")
                     f.write(runner.last_scheduler.metrics.exposition())
+            if trace_dir and runner.last_tracer is not None:
+                os.makedirs(trace_dir, exist_ok=True)
+                dest = os.path.join(trace_dir,
+                                    f"{tc.name}_{wl.name}.trace.json")
+                n = runner.last_tracer.export_chrome_trace(dest)
+                if verbose:
+                    print(f"  trace: {dest} ({n} events)")
     return out

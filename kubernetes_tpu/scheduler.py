@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import time as _time
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -220,6 +221,10 @@ class _PendingDrain:
     groups_needed: bool
     records: list = field(default_factory=list)
     dispatched_at: float = 0.0
+    # per-phase wall times + wave stats, accumulated from dispatch through
+    # commit; the flight recorder persists them per drain
+    phases: dict = field(default_factory=dict)
+    wave: dict = field(default_factory=dict)
     # nominated-pod resource overlay active at dispatch (None = none);
     # replays must reproduce the dispatch-time overlay
     ovl: object = None
@@ -387,6 +392,15 @@ class Scheduler:
                                       metrics=self.metrics)
         from .utils.tracing import NOOP_TRACER
         self.tracer = tracer or NOOP_TRACER
+        # decision provenance + drain telemetry (events.py): Scheduled /
+        # FailedScheduling events and the per-drain flight ring, both
+        # served by the SchedulerServer's /debug endpoints
+        from .events import EventRecorder, FlightRecorder
+        self.events = EventRecorder(clock=clock, metrics=self.metrics)
+        self.flight = FlightRecorder()
+        # jax.profiler session directory (config profilerTraceDir; "" = off)
+        self.profiler_trace_dir = (
+            config.profiler_trace_dir if config is not None else "")
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -588,6 +602,7 @@ class Scheduler:
         self.dispatcher.add(APICall(CallType.BIND, rec.assumed,
                                     node_name=rec.node_name))
         self.scheduled_count += 1
+        self.events.scheduled(rec.qpi.pod.uid, rec.node_name)
         from .metrics import SCHEDULED
         pod = rec.qpi.pod
         self.metrics.schedule_attempts.inc(SCHEDULED,
@@ -956,145 +971,180 @@ class Scheduler:
             # cooldown expires; the host oracle takes the drain
             self.device_fallbacks += 1
             self.metrics.device_fallbacks.inc("circuit_open")
+            self.flight.record(
+                profile=profile.name, pods=len(qpis), bound=0, failed=0,
+                signatures=0, kinds=(), groups=False, phases={},
+                breaker_open=True, consecutive_faults=self._device_faults,
+                fallback="circuit_open")
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
 
-        carry = self._device_carry
-        nominator = self.queue.nominator
-        ovl_fp = nominator.version if nominator.nominated_pods else -1
-        if carry is not None and (self._carry_profile != profile.name
-                                  or self._carry_ovl_fp != ovl_fp):
-            # the signature cache's s_fit/s_bal were computed under another
-            # profile's ScoreConfig — or its fit_ok under a different
-            # nominated-pod overlay: invalidate (sig 0 never matches)
-            carry = carry._replace(
-                cache=carry.cache._replace(sig=jnp.int32(0)))
-            self._device_carry = carry
-        self._carry_profile = profile.name
-        self._carry_ovl_fp = ovl_fp
-        if carry is None:
-            # reseed device state from the host snapshot (first batch, or an
-            # external event invalidated the resident carry). Pending
-            # commits mutate the host cache the snapshot is built from, so
-            # they must land first.
-            self._drain_pending()
-            self.cache.update_snapshot(self.snapshot)
-            self.state.apply_snapshot(self.snapshot)
-        if (prebuilt is not None
-                and prebuilt.table.req.shape[1] == self.state.dims.resources):
-            segment_batch = prebuilt
-        else:
-            segment_batch = self.builder.build([q.pod for q in qpis],
-                                               pad_to=self.batch_size)
+        ph: dict[str, float] = {}
+        with self.tracer.span("host_build", pods=len(qpis)):
+            carry = self._device_carry
+            nominator = self.queue.nominator
+            ovl_fp = nominator.version if nominator.nominated_pods else -1
+            if carry is not None and (self._carry_profile != profile.name
+                                      or self._carry_ovl_fp != ovl_fp):
+                # the signature cache's s_fit/s_bal were computed under
+                # another profile's ScoreConfig — or its fit_ok under a
+                # different nominated-pod overlay: invalidate (sig 0 never
+                # matches)
+                carry = carry._replace(
+                    cache=carry.cache._replace(sig=jnp.int32(0)))
+                self._device_carry = carry
+            self._carry_profile = profile.name
+            self._carry_ovl_fp = ovl_fp
+            if carry is None:
+                # reseed device state from the host snapshot (first batch,
+                # or an external event invalidated the resident carry).
+                # Pending commits mutate the host cache the snapshot is
+                # built from, so they must land first.
+                with self._phase("host_snapshot", ph):
+                    self._drain_pending()
+                    self.cache.update_snapshot(self.snapshot)
+                    self.state.apply_snapshot(self.snapshot)
+            with self._phase("host_tensorize", ph,
+                             cached=prebuilt is not None):
+                if (prebuilt is not None
+                        and prebuilt.table.req.shape[1]
+                        == self.state.dims.resources):
+                    segment_batch = prebuilt
+                else:
+                    segment_batch = self.builder.build(
+                        [q.pod for q in qpis], pad_to=self.batch_size)
             if segment_batch.host_fallback.any():
                 # state moved between routing and segment build (e.g. a node
                 # update surfaced images): honor queue order and let the
                 # oracle take the segment
                 self._drain_pending()
-                return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
-        na = self._node_arrays()
-        # group kernels are needed when any signature row carries spread or
-        # inter-pod affinity constraints, or when existing cluster pods do
-        # (affinity is symmetric: they veto/score ANY incoming pod)
-        groups_needed = (
-            self.builder.groups.any_groups()
-            or bool(self.snapshot.have_pods_with_affinity_list)
-            or bool(self.snapshot.have_pods_with_required_anti_affinity_list))
-        if groups_needed and self._classify_wave(segment_batch,
-                                                 len(qpis)) is None:
-            # host greedy is the FALLBACK tier for group drains the wave
-            # kernels can't take (gate off, short spans, >4 signatures)
-            bound = self._try_host_greedy(qpis, profile, segment_batch)
-            if bound is not None:
-                return bound
-        table_reset = self.builder.reset_count != self._builder_reset_seen
-        self._builder_reset_seen = self.builder.reset_count
-        capacity = (self.builder.groups.device_rows(), na.used.shape[0])
-        if carry is not None and (
-                table_reset   # every signature id / group row invalidated
-                or carry.used.shape != na.used.shape
-                or groups_needed != (carry.groups is not None)
-                or (groups_needed and capacity != self._gd_capacity)):
-            # structural change: reseed from the host snapshot
-            carry = None
-            self._drain_pending()
-            self.cache.update_snapshot(self.snapshot)
-            self.state.apply_snapshot(self.snapshot)
-            na = self._node_arrays()
-        if carry is None:
-            gcarry = None
-            if groups_needed:
-                gd_np, gc_np = self.builder.groups.build_dev(self.snapshot)
-                if self.mesh is not None:
-                    from .parallel.sharding import (shard_group_carry,
-                                                    shard_groups)
-                    self._gd_dev = shard_groups(self.mesh, to_device(gd_np))
-                    gcarry = shard_group_carry(self.mesh, to_device(gc_np))
-                else:
-                    self._gd_dev = to_device(gd_np)
-                    gcarry = to_device(gc_np)
-                self._gd_fam = self.builder.groups.families(self.snapshot)
-            else:
-                self._gd_dev = None
-                self._gd_fam = None
-            self._gd_capacity = capacity
-            self._seeded_rows = self.builder.table_used
-            carry = initial_carry(na, gcarry)
-        elif groups_needed and self.builder.table_used > self._seeded_rows:
-            # new signature rows while the carry is resident: seed just those
-            # rows from the live snapshot (assumes included) and scatter in.
-            # Pending commits must land first — the seeds count them.
-            self._drain_pending()
-            carry = self._device_carry
-            if carry is None:
-                # a bind error during the drain invalidated the carry:
-                # restart this dispatch against the reseeded state
-                return self._dispatch_device_drain(qpis, profile, prebuilt)
-            if (self.builder.groups.device_rows(),
-                    na.used.shape[0]) != self._gd_capacity:
-                # the commits above can intern NEW signature rows (e.g.
-                # preemption's batched dry-run row for a failed pod): a
-                # pow2 capacity crossing means the resident group tensors
-                # are too small to scatter into — reseed instead
-                self._invalidate_device_state()
-                return self._dispatch_device_drain(qpis, profile, prebuilt)
-            self.cache.update_snapshot(self.snapshot)
-            self._gd_dev, gcarry = scatter_new_rows(
-                self._gd_dev, carry.groups, self.builder.groups,
-                self.snapshot, self._seeded_rows, self.builder.table_used,
-                mesh=self.mesh)
-            self._gd_fam = self.builder.groups.families(self.snapshot)
-            carry = carry._replace(groups=gcarry)
-            self._seeded_rows = self.builder.table_used
-        if (self._table_dev is None
-                or self._table_dev_version != segment_batch.table_version):
-            self._table_dev = table_from_batch(segment_batch)
-            self._table_dev_version = segment_batch.table_version
-        table = self._table_dev
-        n = len(qpis)
-        ovl = None
-        nom = None
-        if self.queue.nominator.nominated_pods:
-            # re-validate at the DISPATCH site: interleaved host-path
-            # scheduling (mixed drains, fallback segments) can nominate
-            # mid-batch, after _schedule_batch's entry check ran
-            if groups_needed or not self._overlay_eligible(qpis):
-                # groups: nominated pods' labels feed group counts, which
-                # the resource-only overlay cannot represent
-                self._drain_pending()
                 return sum(1 if self._schedule_one_host(q) else 0
                            for q in qpis)
-            ovl = self._build_overlay(na)
-            nom = self._nominated_rows(qpis)
+            na = self._node_arrays()
+            # group kernels are needed when any signature row carries spread
+            # or inter-pod affinity constraints, or when existing cluster
+            # pods do (affinity is symmetric: they veto/score ANY incoming
+            # pod)
+            groups_needed = (
+                self.builder.groups.any_groups()
+                or bool(self.snapshot.have_pods_with_affinity_list)
+                or bool(
+                    self.snapshot.have_pods_with_required_anti_affinity_list))
+            if groups_needed and self._classify_wave(segment_batch,
+                                                     len(qpis)) is None:
+                # host greedy is the FALLBACK tier for group drains the wave
+                # kernels can't take (gate off, short spans, >4 signatures)
+                bound = self._try_host_greedy(qpis, profile, segment_batch)
+                if bound is not None:
+                    return bound
+            table_reset = self.builder.reset_count != self._builder_reset_seen
+            self._builder_reset_seen = self.builder.reset_count
+            capacity = (self.builder.groups.device_rows(), na.used.shape[0])
+            if carry is not None and (
+                    table_reset  # every signature id / group row invalidated
+                    or carry.used.shape != na.used.shape
+                    or groups_needed != (carry.groups is not None)
+                    or (groups_needed and capacity != self._gd_capacity)):
+                # structural change: reseed from the host snapshot
+                carry = None
+                with self._phase("host_snapshot", ph):
+                    self._drain_pending()
+                    self.cache.update_snapshot(self.snapshot)
+                    self.state.apply_snapshot(self.snapshot)
+                na = self._node_arrays()
+            with self._phase("host_group_seed", ph, groups=groups_needed):
+                if carry is None:
+                    gcarry = None
+                    if groups_needed:
+                        gd_np, gc_np = self.builder.groups.build_dev(
+                            self.snapshot)
+                        if self.mesh is not None:
+                            from .parallel.sharding import (shard_group_carry,
+                                                            shard_groups)
+                            self._gd_dev = shard_groups(self.mesh,
+                                                        to_device(gd_np))
+                            gcarry = shard_group_carry(self.mesh,
+                                                       to_device(gc_np))
+                        else:
+                            self._gd_dev = to_device(gd_np)
+                            gcarry = to_device(gc_np)
+                        self._gd_fam = self.builder.groups.families(
+                            self.snapshot)
+                    else:
+                        self._gd_dev = None
+                        self._gd_fam = None
+                    self._gd_capacity = capacity
+                    self._seeded_rows = self.builder.table_used
+                    carry = initial_carry(na, gcarry)
+                elif (groups_needed
+                      and self.builder.table_used > self._seeded_rows):
+                    # new signature rows while the carry is resident: seed
+                    # just those rows from the live snapshot (assumes
+                    # included) and scatter in. Pending commits must land
+                    # first — the seeds count them.
+                    self._drain_pending()
+                    carry = self._device_carry
+                    if carry is None:
+                        # a bind error during the drain invalidated the
+                        # carry: restart this dispatch against the reseeded
+                        # state
+                        return self._dispatch_device_drain(qpis, profile,
+                                                           prebuilt)
+                    if (self.builder.groups.device_rows(),
+                            na.used.shape[0]) != self._gd_capacity:
+                        # the commits above can intern NEW signature rows
+                        # (e.g. preemption's batched dry-run row for a
+                        # failed pod): a pow2 capacity crossing means the
+                        # resident group tensors are too small to scatter
+                        # into — reseed instead
+                        self._invalidate_device_state()
+                        return self._dispatch_device_drain(qpis, profile,
+                                                           prebuilt)
+                    self.cache.update_snapshot(self.snapshot)
+                    self._gd_dev, gcarry = scatter_new_rows(
+                        self._gd_dev, carry.groups, self.builder.groups,
+                        self.snapshot, self._seeded_rows,
+                        self.builder.table_used, mesh=self.mesh)
+                    self._gd_fam = self.builder.groups.families(self.snapshot)
+                    carry = carry._replace(groups=gcarry)
+                    self._seeded_rows = self.builder.table_used
+            with self._phase("host_cache", ph):
+                if (self._table_dev is None
+                        or self._table_dev_version
+                        != segment_batch.table_version):
+                    self._table_dev = table_from_batch(segment_batch)
+                    self._table_dev_version = segment_batch.table_version
+                table = self._table_dev
+                n = len(qpis)
+                ovl = None
+                nom = None
+                if self.queue.nominator.nominated_pods:
+                    # re-validate at the DISPATCH site: interleaved
+                    # host-path scheduling (mixed drains, fallback segments)
+                    # can nominate mid-batch, after _schedule_batch's entry
+                    # check ran
+                    if groups_needed or not self._overlay_eligible(qpis):
+                        # groups: nominated pods' labels feed group counts,
+                        # which the resource-only overlay cannot represent
+                        self._drain_pending()
+                        return sum(1 if self._schedule_one_host(q) else 0
+                                   for q in qpis)
+                    ovl = self._build_overlay(na)
+                    nom = self._nominated_rows(qpis)
         t0 = _time.perf_counter()
         self.metrics.drain_phase.observe(max(t0 - t_entry, 0.0),
                                          "host_build")
+        for name, dt in ph.items():
+            self.metrics.drain_phase.observe(dt, name)
+        ph["host_build"] = t0 - t_entry
         try:
             with self.tracer.span("device_dispatch", pods=n,
-                                  groups=groups_needed):
+                                  groups=groups_needed,
+                                  batch_bucket=len(segment_batch.valid)) as ds:
                 carry, records = self._dispatch_runs(
                     profile, na, carry, segment_batch, table, n,
                     groups_needed, ovl=ovl, nom=nom)
+                ds.set(runs=",".join(r.kind for r in records))
         except Exception as e:
             # XLA/dispatch fault: earlier in-flight drains predate the
             # fault and commit normally; THIS drain degrades to the host
@@ -1102,6 +1152,7 @@ class Scheduler:
             self._record_device_fault("dispatch", e)
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
+        ph["device_dispatch"] = _time.perf_counter() - t0
         self.metrics.drain_phase.observe(
             max(_time.perf_counter() - t0, 0.0), "device")
         self._device_carry = carry
@@ -1110,8 +1161,17 @@ class Scheduler:
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
-            dispatched_at=t0, ovl=ovl, nom=nom))
+            dispatched_at=t0, ovl=ovl, nom=nom, phases=ph))
         return 0
+
+    @contextmanager
+    def _phase(self, name: str, ph: dict, **attrs):
+        """Time one host-build sub-phase: tracer child span + an entry in
+        `ph` (flight recorder + drain_phase sub-phase series)."""
+        t0 = _time.perf_counter()
+        with self.tracer.span(name, **attrs):
+            yield
+        ph[name] = ph.get(name, 0.0) + (_time.perf_counter() - t0)
 
     def _nominated_rows(self, qpis: list[QueuedPodInfo]):
         """i32 [n] row index of each drain pod's OWN nomination (-1 =
@@ -1557,6 +1617,10 @@ class Scheduler:
         self.device_fallbacks += 1
         self.metrics.device_fallbacks.inc(reason)
         self._invalidate_device_state()
+        self.flight.record(
+            profile="", pods=0, bound=0, failed=0, signatures=0, kinds=(),
+            groups=False, phases={}, breaker_open=self._breaker_open,
+            consecutive_faults=self._device_faults, fallback=reason)
         klog.error("device batch fault; degrading drain to host path",
                    reason=reason, err=str(err),
                    consecutive=self._device_faults)
@@ -1676,8 +1740,9 @@ class Scheduler:
         if pd.records:
             self._record_device_success()
             # readback wait (zero when the async copy already landed)
-            self.metrics.drain_phase.observe(
-                max(_time.perf_counter() - t0, 0.0), "device")
+            wait = max(_time.perf_counter() - t0, 0.0)
+            pd.phases["device_wait"] = wait
+            self.metrics.drain_phase.observe(wait, "device")
         self.metrics.device_batch_duration.observe(
             max(_time.perf_counter() - pd.dispatched_at, 0.0))
         self._commit_assignments(pd, out)
@@ -1696,7 +1761,7 @@ class Scheduler:
                 continue
             if rec.kind in ("wave", "wavescan"):
                 out[rec.i:rec.j] = r[:m]
-                self._observe_wave(rec, r, m)
+                self._observe_wave(rec, r, m, pd)
                 idx += 1
                 continue
             exact, depth = bool(r[rec.L]), bool(r[rec.L + 1])
@@ -1740,10 +1805,12 @@ class Scheduler:
                 self._device_carry = carry
             idx += 1
 
-    def _observe_wave(self, rec: _RunRec, r, m: int) -> None:
+    def _observe_wave(self, rec: _RunRec, r, m: int,
+                      pd: Optional["_PendingDrain"] = None) -> None:
         """Record a resolved wave's stats: waves executed, conflict ratio
         (conflict-cut events + serially repaired pods over the span), and
-        the first wave's accepted conflict-free prefix length."""
+        the first wave's accepted conflict-free prefix length. Also folds
+        the raw numbers into the drain's flight-recorder entry."""
         B = rec.L
         if rec.kind == "wave":
             waves, confs = int(r[B]), int(r[B + 1])
@@ -1753,11 +1820,17 @@ class Scheduler:
                 min((confs + serial) / max(m, 1), 1.0))
             self.metrics.wave_accepted_prefix.observe(max(prefix, 0))
         else:
+            waves, serial = 1, 0
             confs, prefix = int(r[B]), int(r[B + 1])
             self.metrics.wave_placement_waves.inc()
             self.metrics.wave_conflict_ratio.observe(
                 min(confs / max(m, 1), 1.0))
             self.metrics.wave_accepted_prefix.observe(max(prefix, 0))
+        if pd is not None:
+            w = pd.wave
+            w["waves"] = w.get("waves", 0) + max(waves, 1)
+            w["conflicts"] = w.get("conflicts", 0) + confs + serial
+            w.setdefault("first_prefix", max(prefix, 0))
 
     def _commit_assignments(self, pd: _PendingDrain, out) -> int:
         """Host commit of a resolved drain: bulk assume + bind enqueue for
@@ -1814,8 +1887,21 @@ class Scheduler:
             for qpi in failures:
                 err = self._device_fit_error(qpi, profile, diag_cache)
                 self._handle_failure(qpi, err)
-        self.metrics.drain_phase.observe(
-            max(_time.perf_counter() - t_commit, 0.0), "commit")
+        commit_s = max(_time.perf_counter() - t_commit, 0.0)
+        self.metrics.drain_phase.observe(commit_s, "commit")
+        pd.phases["commit"] = pd.phases.get("commit", 0.0) + commit_s
+        self.flight.record(
+            profile=profile.name, pods=n, bound=bound,
+            failed=len(failures),
+            signatures=(int(np.unique(pd.batch.tidx[:n]).size)
+                        if pd.batch is not None else 0),
+            kinds=tuple(r.kind for r in pd.records) or ("host_greedy",),
+            groups=pd.groups_needed, phases=dict(pd.phases),
+            wave=dict(pd.wave), breaker_open=self._breaker_open,
+            consecutive_faults=self._device_faults,
+            fallback="" if pd.records else "host_greedy",
+            events={"Scheduled": bound,
+                    "FailedScheduling": len(failures)})
         klog.v(2).info("batch committed", profile=profile.name, pods=n,
                        bound=bound, unschedulable=len(failures),
                        latency_ms=round(per_pod * n * 1e3, 1))
@@ -1870,6 +1956,11 @@ class Scheduler:
         if not in_flight:
             self.queue.in_flight_events.clear()
         self.dispatcher.add_binds(bound_pods)
+        # Scheduled events, bulk + lazy-formatted (pod.uid is already the
+        # "ns/name" object ref — no per-pod string building here)
+        self.events.scheduled_bulk(
+            [(pod.uid, assumed.spec.node_name)
+             for assumed, pod in bound_pods], now=now)
         nb = len(bound_pods)
         self.scheduled_count += nb
         from .metrics import SCHEDULED
@@ -1962,41 +2053,230 @@ class Scheduler:
         return {"device_vs_host": self.reconcile(),
                 "host_vs_apiserver": self.debugger.compare()}
 
+    def profile_session(self):
+        """jax.profiler session context, gated by the config
+        `profilerTraceDir` knob (a no-op context when unset): wrap a
+        stretch of scheduling with it to get the XLA/TPU-level trace
+        under the host spans."""
+        from .utils.tracing import jax_profiler_session
+        return jax_profiler_session(self.profiler_trace_dir)
+
     def _device_fit_error(self, qpi: QueuedPodInfo, profile: Profile,
                           diag_cache: dict) -> FitError:
-        """The device reports only global infeasibility; run the host
-        oracle's FILTER phase once per failed signature per batch to
-        recover the exact per-node statuses and rejecting plugins —
-        queueing hints and preemption's resolvable-node pruning both need
-        the real diagnosis, not a guess from the pod spec. Identical
+        """The device reports only global infeasibility; the diagnosis —
+        exact per-node statuses and rejecting plugins, which queueing
+        hints, preemption's resolvable-node pruning and the
+        FailedScheduling event all need — comes from the mask-derived
+        device reduction (ops/program.py diagnose_row) when the signature
+        is tensorizable, else from a host-oracle filter replay. Identical
         signatures share identical filter outcomes, so the dict lookup
         makes mass failures (a full cluster rejecting a homogeneous tail)
-        cost ONE host filter sweep per batch instead of one per pod."""
+        cost ONE reduction per signature per batch instead of one per
+        pod."""
         from .framework.types import Diagnosis
         # content key, not the numeric sig id: host-port pods carry sig 0
         # yet still share identical filter outcomes
         sig = BatchBuilder._sig_key(qpi.pod)
         cached = diag_cache.get(sig)
         if cached is None:
-            fwk = profile.framework
-            nodes = self.snapshot.node_info_list
-            diagnosis = Diagnosis()
-            state = CycleState()
-            pre_result, status = fwk.run_pre_filter_plugins(
-                state, qpi.pod, nodes)
-            if not status.is_success():
-                diagnosis.pre_filter_msg = "; ".join(status.reasons)
-                if status.plugin:
-                    diagnosis.unschedulable_plugins.add(status.plugin)
-            else:
-                fwk.find_nodes_that_pass_filters(state, qpi.pod, nodes,
-                                                 pre_result, diagnosis)
-            if not diagnosis.unschedulable_plugins:
-                diagnosis.unschedulable_plugins = {"NodeResourcesFit"}
-            diag_cache[sig] = cached = diagnosis
+            cached = self._mask_diagnosis(qpi, diag_cache)
+            if cached is None:
+                cached = self._host_replay_diagnosis(qpi, profile)
+            if not cached.unschedulable_plugins:
+                cached.unschedulable_plugins = {"NodeResourcesFit"}
+            diag_cache[sig] = cached
         err = FitError(qpi.pod, len(self.snapshot.node_info_list))
         err.diagnosis = cached
         return err
+
+    def _host_replay_diagnosis(self, qpi: QueuedPodInfo, profile: Profile):
+        """Host-oracle filter replay over the live snapshot — the fallback
+        diagnosis tier (gate off, non-tensorizable signature, reduction
+        fault)."""
+        from .framework.types import Diagnosis
+        fwk = profile.framework
+        nodes = self.snapshot.node_info_list
+        diagnosis = Diagnosis()
+        state = CycleState()
+        pre_result, status = fwk.run_pre_filter_plugins(
+            state, qpi.pod, nodes)
+        if not status.is_success():
+            diagnosis.pre_filter_msg = "; ".join(status.reasons)
+            if status.plugin:
+                diagnosis.unschedulable_plugins.add(status.plugin)
+        else:
+            fwk.find_nodes_that_pass_filters(state, qpi.pod, nodes,
+                                             pre_result, diagnosis)
+        return diagnosis
+
+    def _mask_diagnosis(self, qpi: QueuedPodInfo, diag_cache: dict):
+        """Diagnosis from the device filter masks: one diagnose_row
+        reduction against the post-commit node state attributes every
+        rejected node to its first failing plugin (host filter order) with
+        exact per-reason detail. Returns None when the reduction cannot
+        represent the pod (host-fallback signature, gate off, sharded
+        mesh) or faults — the caller then replays on the host."""
+        if (self.mesh is not None
+                or not self.feature_gates.enabled("DeviceMaskDiagnosis")):
+            return None
+        ent = self.builder._lookup(qpi.pod)
+        if ent[0] != "row":
+            return None
+        tidx = ent[2]
+        ctx = diag_cache.get("_device_ctx")
+        if ctx is None:
+            try:
+                ctx = self._diagnosis_context()
+            except Exception as e:
+                klog.warning("device diagnosis context build failed; "
+                             "falling back to host filter replay",
+                             err=str(e))
+                ctx = False
+            diag_cache["_device_ctx"] = ctx
+        if ctx is False:
+            return None
+        na, table, gd, gc, fam = ctx
+        try:
+            from .ops.program import diagnose_row
+            slot, pods_fail, cols_fail = diagnose_row(na, table, tidx,
+                                                      gd=gd, gc=gc, fam=fam)
+            slot = np.asarray(slot)
+            pods_fail = np.asarray(pods_fail)
+            cols_fail = np.asarray(cols_fail)
+        except Exception as e:
+            klog.warning("device diagnosis reduction failed; falling back "
+                         "to host filter replay", err=str(e))
+            return None
+        return self._assemble_diagnosis(qpi, tidx, slot, pods_fail,
+                                        cols_fail)
+
+    def _diagnosis_context(self):
+        """Post-commit device state for diagnose_row, built once per
+        failed drain (cached in the drain's diag_cache): staging node
+        arrays refreshed from the live snapshot, the signature table, and
+        — when group constraints are live — fresh group tensors."""
+        from .ops.groups import to_device
+        from .ops.program import PodTableDev
+        self.state.apply_snapshot(self.snapshot)
+        self.state.ensure_arrays()
+        na = self.state.arrays
+        table = PodTableDev(*(jnp.asarray(getattr(self.builder.table, f))
+                              for f in PodTableDev._fields))
+        gd = gc = fam = None
+        groups_needed = (
+            self.builder.groups.any_groups()
+            or bool(self.snapshot.have_pods_with_affinity_list)
+            or bool(
+                self.snapshot.have_pods_with_required_anti_affinity_list))
+        if groups_needed:
+            gd_np, gc_np = self.builder.groups.build_dev(self.snapshot)
+            gd, gc = to_device(gd_np), to_device(gc_np)
+            fam = self.builder.groups.families(self.snapshot)
+        return na, table, gd, gc, fam
+
+    def _assemble_diagnosis(self, qpi: QueuedPodInfo, tidx: int, slot,
+                            pods_fail, cols_fail):
+        """slot/fit arrays → Diagnosis with per-node Statuses carrying the
+        host plugins' exact reason strings and codes."""
+        from .framework.types import Diagnosis
+        from .ops import program as prog
+        from .plugins.node_basics import (TaintToleration as TTPlugin,
+                                          find_matching_untolerated_taint)
+        from .plugins.nodeaffinity import ERR_REASON as NA_ERR
+        from .plugins.podtopologyspread import (
+            ERR_REASON_CONSTRAINTS_NOT_MATCH, ERR_REASON_NODE_LABEL_NOT_MATCH)
+        from .plugins.interpodaffinity import (ERR_AFFINITY,
+                                               ERR_ANTI_AFFINITY,
+                                               ERR_EXISTING_ANTI_AFFINITY)
+        pod = qpi.pod
+        diagnosis = Diagnosis()
+        names = self.state.node_names
+        # one shared Status per identical (slot, detail) — a 5k-node mass
+        # rejection allocates a handful of Status objects, not 5k
+        shared: dict = {}
+        simple = {
+            prog.DIAG_NODE_UNSCHEDULABLE: (
+                Status.unresolvable, "node(s) were unschedulable",
+                "NodeUnschedulable"),
+            prog.DIAG_NODE_NAME: (
+                Status.unresolvable,
+                "node(s) didn't match the requested node name", "NodeName"),
+            prog.DIAG_NODE_AFFINITY: (
+                Status.unresolvable, NA_ERR, "NodeAffinity"),
+            prog.DIAG_PORTS: (
+                Status.unschedulable,
+                "node(s) didn't have free ports for the requested pod ports",
+                "NodePorts"),
+            prog.DIAG_SPREAD_LABEL: (
+                Status.unresolvable, ERR_REASON_NODE_LABEL_NOT_MATCH,
+                "PodTopologySpread"),
+            prog.DIAG_SPREAD_SKEW: (
+                Status.unschedulable, ERR_REASON_CONSTRAINTS_NOT_MATCH,
+                "PodTopologySpread"),
+            prog.DIAG_IPA_AFFINITY: (
+                Status.unresolvable, ERR_AFFINITY, "InterPodAffinity"),
+            prog.DIAG_IPA_ANTI: (
+                Status.unschedulable, ERR_ANTI_AFFINITY, "InterPodAffinity"),
+            prog.DIAG_IPA_EXISTING_ANTI: (
+                Status.unschedulable, ERR_EXISTING_ANTI_AFFINITY,
+                "InterPodAffinity"),
+        }
+        req_row = self.builder.table.req[tidx]
+        cap = self.state.arrays.cap
+        rnames = self.state.rtable.names
+        for i in np.nonzero(slot > 0)[0]:
+            i = int(i)
+            name = names[i] if i < len(names) else ""
+            if not name:
+                continue
+            s = int(slot[i])
+            if s == prog.DIAG_TAINT:
+                # reason carries the taint content — resolve it from the
+                # node itself, exactly like the host plugin
+                ni = self.snapshot.get(name)
+                taint = find_matching_untolerated_taint(
+                    ni.node.spec.taints, pod.spec.tolerations,
+                    TTPlugin.FILTER_EFFECTS) if ni is not None else None
+                key = (s, taint.key if taint else "",
+                       taint.value if taint else "")
+                status = shared.get(key)
+                if status is None:
+                    reason = (f"node(s) had untolerated taint "
+                              f"{{{taint.key}: {taint.value}}}" if taint
+                              else "node(s) had untolerated taint")
+                    status = shared[key] = Status.unresolvable(
+                        reason, plugin="TaintToleration")
+            elif s == prog.DIAG_FIT:
+                # per-reason fit detail (fit.go insufficient_resources):
+                # Too many pods + per-column Insufficient <resource>;
+                # unresolvable when a request exceeds this node's raw
+                # allocatable (per-node, so it keys the sharing too)
+                cols = tuple(int(c) for c in np.nonzero(cols_fail[i])[0])
+                unresolvable = any(int(req_row[c]) > int(cap[i, c])
+                                   for c in cols)
+                key = (s, bool(pods_fail[i]), cols, unresolvable)
+                status = shared.get(key)
+                if status is None:
+                    reasons = []
+                    if pods_fail[i]:
+                        reasons.append("Too many pods")
+                    reasons.extend(
+                        "Insufficient " + (rnames[c] if c < len(rnames)
+                                           else f"resource-{c}")
+                        for c in cols)
+                    mk = (Status.unresolvable if unresolvable
+                          else Status.unschedulable)
+                    status = shared[key] = mk(*reasons,
+                                              plugin="NodeResourcesFit")
+            else:
+                status = shared.get(s)
+                if status is None:
+                    mk, reason, plugin = simple[s]
+                    status = shared[s] = mk(reason, plugin=plugin)
+            diagnosis.node_to_status[name] = status
+            if status.plugin:
+                diagnosis.unschedulable_plugins.add(status.plugin)
+        return diagnosis
 
     # -- scheduling: host path (oracle + fallback) ----------------------------
 
@@ -2141,6 +2421,7 @@ class Scheduler:
             self.dispatcher.add(APICall(CallType.BIND, assumed,
                                         node_name=node_name))
         self.scheduled_count += 1
+        self.events.scheduled(pod.uid, node_name)
         from .metrics import SCHEDULED
         self.metrics.schedule_attempts.inc(
             SCHEDULED, pod.spec.scheduler_name)
@@ -2243,11 +2524,20 @@ class Scheduler:
             UNSCHEDULABLE, pod.spec.scheduler_name)
         self.metrics.queue_incoming_pods.inc("unschedulable",
                                              "ScheduleAttemptFailure")
+        # FailedScheduling event with the reference-format message
+        # ("0/N nodes are available: X Insufficient cpu, ...") + the
+        # per-plugin rejected-node counts behind it
+        from .events import EVENT_WARNING, REASON_FAILED_SCHEDULING
+        msg = str(err)
+        self.events.event(pod.uid, EVENT_WARNING, REASON_FAILED_SCHEDULING,
+                          msg)
+        for plugin, count in err.diagnosis.plugin_node_counts().items():
+            self.metrics.unschedulable_nodes.observe(count, plugin)
         self.queue.add_unschedulable_if_not_present(qpi)
         self.dispatcher.add(APICall(
             CallType.STATUS_PATCH, qpi.pod,
             condition={"type": "PodScheduled", "status": "False",
-                       "reason": "Unschedulable", "message": str(err)},
+                       "reason": "Unschedulable", "message": msg},
             nominated_node_name=nominated))
 
     # -- housekeeping ---------------------------------------------------------

@@ -10,6 +10,11 @@ in-process model:
   the informer handlers to be registered (the reference's
   WaitForHandlersSync analog) and — when leader election is on — this
   instance to hold the lease; /metrics serves the Prometheus exposition.
+- /debug/* are the observability surfaces: /debug/flightrecorder (the
+  per-drain flight ring), /debug/slowcycles (slow span trees + slowest
+  drains), /debug/events (the event recorder, ?reason=FailedScheduling to
+  filter), /debug/cachedump (CacheDebugger.dump) and /debug/cache (dump +
+  full divergence sweep).
 - `LeaderElector` drives a Lease object stored in the APIServer
   (coordination.k8s.io/Lease semantics: acquire when unheld or expired,
   renew while holding, release on stop). Multiple scheduler instances
@@ -154,8 +159,38 @@ class SchedulerServer:
                         "divergence": outer.scheduler.debug_compare(),
                         "dump": outer.scheduler.debugger.dump(),
                     }, indent=2, default=str), "application/json")
+                elif self.path.startswith("/debug/cachedump"):
+                    # dump WITHOUT the divergence sweep (the sweep quiesces
+                    # the commit pipeline; the dump alone is read-only)
+                    self._send(200, json.dumps(
+                        outer.scheduler.debugger.dump(), indent=2,
+                        default=str), "application/json")
+                elif self.path.startswith("/debug/flightrecorder"):
+                    q = self._query()
+                    self._send(200, json.dumps({
+                        "records": outer.scheduler.flight.dump(
+                            limit=int(q.get("limit", "0"))),
+                    }, indent=2), "application/json")
+                elif self.path.startswith("/debug/slowcycles"):
+                    tracer = outer.scheduler.tracer
+                    self._send(200, json.dumps({
+                        "slowCycles": [sp.to_dict()
+                                       for sp in tracer.slow_cycles],
+                        "slowestDrains": outer.scheduler.flight.slowest(),
+                    }, indent=2), "application/json")
+                elif self.path.startswith("/debug/events"):
+                    q = self._query()
+                    self._send(200, json.dumps(
+                        outer.scheduler.events.dump(
+                            reason=q.get("reason"),
+                            limit=int(q.get("limit", "0"))),
+                        indent=2), "application/json")
                 else:
                     self._send(404, "not found")
+
+            def _query(self) -> dict:
+                from urllib.parse import parse_qsl, urlsplit
+                return dict(parse_qsl(urlsplit(self.path).query))
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]

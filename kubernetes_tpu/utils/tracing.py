@@ -13,14 +13,24 @@ klog verbosity. This is the dependency-free analog:
   trace sampling.
 - `NOOP_TRACER` keeps the hot path branch-free when tracing is off: span()
   returns a reusable null context.
+- finished span trees export as Chrome-trace / Perfetto JSON
+  (`to_chrome_trace` / `export_chrome_trace`): monotonic timestamps, one
+  complete ("X") event per span, attributes as args — load the file at
+  chrome://tracing or ui.perfetto.dev. `keep_recent` retains the last K
+  root spans regardless of duration so a bench run can export its whole
+  drain history.
+- `jax_profiler_session(dir)` optionally brackets a workload with a
+  jax.profiler trace (XLA/TPU-level view under the host spans), gated by
+  the `profilerTraceDir` config knob.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from collections import deque
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -45,6 +55,22 @@ class Span:
             lines.append(c.breakdown(indent + 1))
         return "\n".join(lines)
 
+    def to_dict(self) -> dict:
+        """Nested-dict form (the /debug/slowcycles serialization)."""
+        return {"name": self.name,
+                "duration_ms": round(self.duration_s * 1e3, 3),
+                "attributes": dict(self.attributes),
+                "children": [c.to_dict() for c in self.children]}
+
+    def find(self, name: str) -> Optional["Span"]:
+        if self.name == name:
+            return self
+        for c in self.children:
+            hit = c.find(name)
+            if hit is not None:
+                return hit
+        return None
+
 
 class _NullSpan:
     def __enter__(self):
@@ -65,10 +91,14 @@ class Tracer:
 
     def __init__(self, slow_threshold_s: float = 1.0, keep: int = 32,
                  on_slow: Optional[Callable[[Span], None]] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 keep_recent: int = 0):
         self.slow_threshold_s = slow_threshold_s
         self.clock = clock
         self.slow_cycles: deque[Span] = deque(maxlen=keep)
+        # every finished ROOT span, slow or not (trace export); off at 0
+        self.recent: deque[Span] = deque(maxlen=max(keep_recent, 1))
+        self.keep_recent = keep_recent
         self.on_slow = on_slow or self._log_slow
         self._stack: list[Span] = []
 
@@ -85,9 +115,21 @@ class Tracer:
         finally:
             self._stack.pop()
             sp.duration_s = self.clock() - sp.start
-            if parent is None and sp.duration_s >= self.slow_threshold_s:
-                self.slow_cycles.append(sp)
-                self.on_slow(sp)
+            if parent is None:
+                if self.keep_recent:
+                    self.recent.append(sp)
+                if sp.duration_s >= self.slow_threshold_s:
+                    self.slow_cycles.append(sp)
+                    self.on_slow(sp)
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the retained root spans (recent if enabled, else the slow
+        ring) as Chrome-trace JSON; returns the event count."""
+        spans = list(self.recent if self.keep_recent else self.slow_cycles)
+        trace = to_chrome_trace(spans)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return len(trace["traceEvents"])
 
     @staticmethod
     def _log_slow(sp: Span) -> None:
@@ -97,9 +139,77 @@ class Tracer:
 
 class NoopTracer:
     slow_cycles: deque = deque()
+    recent: deque = deque()
+    keep_recent = 0
 
     def span(self, name: str, **attributes):
         return _NULL_SPAN
 
 
 NOOP_TRACER = NoopTracer()
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace / Perfetto export
+
+
+def _span_events(sp: Span, out: list, pid: int, tid: int) -> None:
+    out.append({"ph": "X", "cat": "scheduler", "name": sp.name,
+                "ts": sp.start * 1e6,            # µs, monotonic base
+                "dur": max(sp.duration_s, 0.0) * 1e6,
+                "pid": pid, "tid": tid,
+                "args": {k: (v if isinstance(v, (int, float, bool, str))
+                             else str(v))
+                         for k, v in sp.attributes.items()}})
+    for c in sp.children:
+        _span_events(c, out, pid, tid)
+
+
+def to_chrome_trace(spans: list[Span], process_name: str = "kube-scheduler-tpu"
+                    ) -> dict:
+    """Span trees → Chrome-trace JSON object (trace_event format, loadable
+    at chrome://tracing / ui.perfetto.dev). Every span becomes one complete
+    ("X") event; timestamps keep the tracer's monotonic base."""
+    events: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 1,
+         "args": {"name": process_name}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "host-loop"}},
+    ]
+    for sp in spans:
+        _span_events(sp, events, 1, 1)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str, spans: list[Span]) -> int:
+    trace = to_chrome_trace(spans)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])
+
+
+@contextmanager
+def jax_profiler_session(trace_dir: Optional[str]):
+    """Bracket a workload with a jax.profiler trace when `trace_dir` is
+    set (the config `profilerTraceDir` knob); a no-op otherwise, and any
+    profiler failure (unsupported backend, busy session) degrades to the
+    no-op instead of sinking the workload."""
+    if not trace_dir:
+        with nullcontext():
+            yield
+        return
+    import jax
+    started = False
+    try:
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:    # pragma: no cover - backend specific
+        logger.warning("jax profiler session unavailable: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover - backend specific
+                logger.warning("jax profiler stop failed: %s", e)

@@ -1,0 +1,335 @@
+"""Event recorder + mask-derived failure diagnosis: parity + wiring.
+
+The core gate: FailedScheduling events built from the device filter-mask
+reduction (ops/program.py diagnose_row) must BYTE-MATCH a host-oracle
+filter replay — message, per-node statuses and per-plugin rejected-node
+counts — on seeded unschedulable scenarios up to 5k nodes, and events
+must keep firing when the device tier degrades to the host path.
+"""
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.events import (EventRecorder, FlightRecorder,
+                                   REASON_FAILED_SCHEDULING,
+                                   REASON_SCHEDULED)
+from kubernetes_tpu.framework.interface import Code, CycleState
+from kubernetes_tpu.framework.types import Diagnosis, FitError
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def host_oracle_fit_error(sched: Scheduler, pod) -> FitError:
+    """The host oracle's filter replay over the live snapshot — the truth
+    the device reduction must reproduce byte for byte."""
+    fwk = sched.profiles[pod.spec.scheduler_name].framework
+    sched.cache.update_snapshot(sched.snapshot)
+    nodes = sched.snapshot.node_info_list
+    diag = Diagnosis()
+    state = CycleState()
+    pre, status = fwk.run_pre_filter_plugins(state, pod, nodes)
+    if not status.is_success():
+        diag.pre_filter_msg = "; ".join(status.reasons)
+        if status.plugin:
+            diag.unschedulable_plugins.add(status.plugin)
+    else:
+        fwk.find_nodes_that_pass_filters(state, pod, nodes, pre, diag)
+    err = FitError(pod, len(nodes))
+    err.diagnosis = diag
+    return err
+
+
+def assert_device_matches_oracle(sched: Scheduler, pod) -> FitError:
+    """FailedScheduling event message + the full per-node status map of
+    the device diagnosis must equal the host replay's."""
+    events = sched.events.events(reason=REASON_FAILED_SCHEDULING,
+                                 object_ref=pod.uid)
+    assert events, f"no FailedScheduling event for {pod.uid}"
+    oracle = host_oracle_fit_error(sched, pod)
+    assert events[-1].message == str(oracle)
+    # the diagnosis the failure handler saw (per-node parity, not just the
+    # aggregated message): replay the scheduler-side path
+    dev = sched._device_fit_error(
+        _qpi_of(sched, pod), sched.profiles[pod.spec.scheduler_name], {})
+    dev_map = {n: (s.code, s.plugin, tuple(s.reasons))
+               for n, s in dev.diagnosis.node_to_status.items()}
+    host_map = {n: (s.code, s.plugin, tuple(s.reasons))
+                for n, s in oracle.diagnosis.node_to_status.items()}
+    assert dev_map == host_map
+    assert (dev.diagnosis.plugin_node_counts()
+            == oracle.diagnosis.plugin_node_counts())
+    assert (dev.diagnosis.unschedulable_plugins
+            == oracle.diagnosis.unschedulable_plugins)
+    return dev
+
+
+def _qpi_of(sched: Scheduler, pod):
+    from kubernetes_tpu.framework.types import PodInfo, QueuedPodInfo
+    return QueuedPodInfo(pod_info=PodInfo.of(pod))
+
+
+def _big():
+    return {"cpu": 64, "memory": "64Gi", "pods": 110}
+
+
+class TestMaskDiagnosisParity:
+    def test_mixed_rejections_5k_nodes(self):
+        """The headline parity gate: 5000 nodes rejecting one signature
+        for six different reasons (two distinct taints among them); the
+        device mask-derived message must byte-match the host replay."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(5000):
+            n = make_node(f"n{i:04d}").label("disk", "ssd")
+            if i < 1000:
+                n = n.capacity({"cpu": 4, "memory": "64Gi", "pods": 110})
+            elif i < 2000:
+                n = n.capacity(_big()).unschedulable()
+            elif i < 2500:
+                n = n.capacity(_big()).taint("dedicated", "gpu")
+            elif i < 3000:
+                n = n.capacity(_big()).taint("team", "infra")
+            elif i < 4000:
+                n = make_node(f"n{i:04d}").capacity(_big())  # no disk label
+            elif i < 4500:
+                n = n.capacity({"cpu": 16, "memory": "2Gi", "pods": 110})
+            else:
+                n = n.capacity({"cpu": 64, "memory": "64Gi", "pods": 0})
+            api.create_node(n.obj())
+        sched.prime()
+        pod = (make_pod("p0").req({"cpu": "8", "memory": "4Gi"})
+               .node_selector({"disk": "ssd"}).obj())
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 0
+        dev = assert_device_matches_oracle(sched, pod)
+        counts = dev.diagnosis.plugin_node_counts()
+        assert counts == {"NodeResourcesFit": 2000, "NodeUnschedulable": 1000,
+                          "TaintToleration": 1000, "NodeAffinity": 1000}
+        msg = str(dev)
+        assert msg.startswith("0/5000 nodes are available: ")
+        assert "1000 Insufficient cpu" in msg
+        assert "500 node(s) had untolerated taint {dedicated: gpu}" in msg
+        assert "500 node(s) had untolerated taint {team: infra}" in msg
+        assert "500 Too many pods" in msg
+        # per-plugin rejected-node counts land in the histogram
+        m = sched.metrics.unschedulable_nodes
+        assert m.count("NodeResourcesFit") >= 1
+        assert m.sum("NodeResourcesFit") >= 2000
+
+    def test_spread_skew_and_missing_label(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(2):
+            api.create_node(make_node(f"a{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 20}).zone("z0").obj())
+        for i in range(2):
+            api.create_node(make_node(f"b{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 20}).zone("z1").obj())
+        for i in range(2):
+            api.create_node(make_node(f"c{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 20}).obj())
+        for i in range(4):   # existing app=x pods crowd z0
+            api.create_pod(make_pod(f"ex{i}").req({"cpu": "100m"})
+                           .label("app", "x").node(f"a{i % 2}").obj())
+        pod = (make_pod("sp").req({"cpu": "2", "memory": "1Gi"})
+               .label("app", "x")
+               .spread_constraint(1, ZONE, "DoNotSchedule",
+                                  {"app": "x"}).obj())
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 0
+        dev = assert_device_matches_oracle(sched, pod)
+        hist = dev.diagnosis.reasons_histogram()
+        assert hist[
+            "node(s) didn't match pod topology spread constraints"] == 2
+        assert hist["node(s) didn't match pod topology spread constraints "
+                    "(missing required label)"] == 2
+        # missing topology label is UnschedulableAndUnresolvable
+        codes = {n: s.code for n, s in dev.diagnosis.node_to_status.items()}
+        assert codes["c0"] == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert codes["a0"] == Code.UNSCHEDULABLE
+
+    def test_incoming_and_existing_anti_affinity(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(2):
+            api.create_node(make_node(f"a{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 20}).zone("z0").obj())
+        for i in range(2):
+            api.create_node(make_node(f"b{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 20}).zone("z1").obj())
+        api.create_pod(make_pod("exy").req({"cpu": "100m"})
+                       .label("app", "y").node("a0").obj())
+        pod = (make_pod("anti").req({"cpu": "2", "memory": "1Gi"})
+               .pod_affinity(ZONE, {"app": "y"}, anti=True).obj())
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 0
+        assert_device_matches_oracle(sched, pod)
+
+        api2 = APIServer()
+        sched2 = Scheduler(api2, batch_size=64)
+        for i in range(2):
+            api2.create_node(make_node(f"a{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 20}).zone("z0").obj())
+        for i in range(2):
+            api2.create_node(make_node(f"b{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 20}).zone("z1").obj())
+        api2.create_pod(make_pod("guard").req({"cpu": "100m"})
+                        .label("app", "g")
+                        .pod_affinity(ZONE, {"app": "z"}, anti=True)
+                        .node("a0").obj())
+        pod2 = (make_pod("victim").req({"cpu": "2", "memory": "1Gi"})
+                .label("app", "z").obj())
+        api2.create_pod(pod2)
+        assert sched2.schedule_pending() == 0
+        dev = assert_device_matches_oracle(sched2, pod2)
+        assert ("node(s) didn't satisfy existing pods anti-affinity rules"
+                in dev.diagnosis.reasons_histogram())
+
+    def test_host_port_signature(self):
+        """Host-port pods carry sig 0 yet still get the mask diagnosis
+        (their table row exists; ports come from the snapshot carry)."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("p0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 20}).obj())
+        api.create_node(make_node("p1").capacity(
+            {"cpu": 1, "memory": "16Gi", "pods": 20}).obj())
+        api.create_pod(make_pod("web").req({"cpu": "100m"})
+                       .host_port(8080).node("p0").obj())
+        pod = (make_pod("web2").req({"cpu": "2", "memory": "1Gi"})
+               .host_port(8080).obj())
+        api.create_pod(pod)
+        assert sched.schedule_pending() == 0
+        dev = assert_device_matches_oracle(sched, pod)
+        hist = dev.diagnosis.reasons_histogram()
+        assert hist["node(s) didn't have free ports for the requested "
+                    "pod ports"] == 1
+
+    def test_gate_off_uses_host_replay_with_identical_result(self):
+        def build(gates):
+            api = APIServer()
+            from kubernetes_tpu.config import KubeSchedulerConfiguration
+            cfg = KubeSchedulerConfiguration(feature_gates=gates)
+            sched = Scheduler(api, batch_size=64, config=cfg)
+            for i in range(4):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 2, "memory": "4Gi", "pods": 10}).obj())
+            pod = make_pod("p").req({"cpu": "8", "memory": "1Gi"}).obj()
+            api.create_pod(pod)
+            sched.schedule_pending()
+            return sched.events.events(
+                reason=REASON_FAILED_SCHEDULING)[-1].message
+        on = build({})
+        off = build({"DeviceMaskDiagnosis": False})
+        assert on == off
+        assert "4 Insufficient cpu" in on
+
+
+class TestEventsAcrossFallback:
+    def test_events_fire_on_device_fault_fallback(self, monkeypatch):
+        """Chaos: the device tier faults, the drain degrades to the host
+        oracle — Scheduled AND FailedScheduling events must still fire."""
+        import kubernetes_tpu.scheduler as sched_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("injected XLA fault")
+
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 4, "memory": "8Gi", "pods": 10}).obj())
+        monkeypatch.setattr(sched_mod, "run_batch", boom)
+        monkeypatch.setattr(sched_mod, "run_uniform", boom)
+        api.create_pod(make_pod("ok").req(
+            {"cpu": "1", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("big").req(
+            {"cpu": "100", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+        assert sched.device_fallbacks >= 1
+        ok_ev = sched.events.events(reason=REASON_SCHEDULED,
+                                    object_ref="default/ok")
+        assert ok_ev and "to n0" in ok_ev[-1].message
+        fail_ev = sched.events.events(reason=REASON_FAILED_SCHEDULING,
+                                      object_ref="default/big")
+        assert fail_ev and "Insufficient cpu" in fail_ev[-1].message
+        # the fault itself is in the flight ring
+        faults = [r for r in sched.flight.dump() if r["fallback"]]
+        assert faults and faults[0]["fallback"] == "dispatch"
+
+
+class TestEventRecorder:
+    def test_aggregation_and_counts(self):
+        clock = iter(range(100)).__next__
+        rec = EventRecorder(capacity=8, clock=lambda: float(clock()))
+        for _ in range(3):
+            rec.event("default/p", "Warning", "FailedScheduling",
+                      "0/1 nodes are available: 1 Insufficient cpu.")
+        evs = rec.events(reason="FailedScheduling")
+        assert len(evs) == 1 and evs[0].count == 3
+        assert evs[0].first_timestamp < evs[0].last_timestamp
+        assert rec.counts[("Warning", "FailedScheduling")] == 3
+
+    def test_ring_eviction(self):
+        rec = EventRecorder(capacity=4)
+        for i in range(8):
+            rec.event(f"default/p{i}", "Warning", "FailedScheduling", "m")
+        evs = rec.events(reason="FailedScheduling")
+        assert len(evs) == 4
+        assert {e.object_ref for e in evs} == {f"default/p{i}"
+                                               for i in range(4, 8)}
+
+    def test_scheduled_fast_path_renders_reference_message(self):
+        rec = EventRecorder()
+        rec.scheduled("default/p", "node-3")
+        rec.scheduled_bulk([("default/q", "node-4")])
+        evs = rec.events(reason="Scheduled")
+        assert [e.message for e in evs] == [
+            "Successfully assigned default/p to node-3",
+            "Successfully assigned default/q to node-4"]
+        dump = rec.dump()
+        assert dump["counts"] == {"Normal/Scheduled": 2}
+
+    def test_metrics_series_increment(self):
+        from kubernetes_tpu.metrics import SchedulerMetrics
+        m = SchedulerMetrics()
+        rec = EventRecorder(metrics=m)
+        rec.scheduled("default/p", "n0")
+        rec.event("default/q", "Warning", "FailedScheduling", "no")
+        assert m.events_total.value("Normal", "Scheduled") == 1
+        assert m.events_total.value("Warning", "FailedScheduling") == 1
+
+
+class TestFlightRecorder:
+    def test_ring_and_slowest(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(6):
+            fr.record(profile="default-scheduler", pods=64, bound=60,
+                      failed=4, signatures=2, kinds=("scan",), groups=False,
+                      phases={"host_build": float(i)})
+        records = fr.dump()
+        assert len(records) == 4
+        assert records[-1]["seq"] == 6
+        assert fr.slowest(1)[0]["phases"]["host_build"] == 5.0
+        assert fr.dump(limit=2)[0]["seq"] == 5
+
+    def test_scheduler_records_drains(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 20}).obj())
+        for i in range(4):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 4
+        records = sched.flight.dump()
+        assert records
+        rec = records[-1]
+        assert rec["pods"] == 4 and rec["bound"] == 4
+        assert rec["signatures"] == 1
+        assert rec["kinds"]
+        # the phase map carries the decomposed host_build
+        for phase in ("host_build", "host_tensorize", "host_group_seed",
+                      "host_cache", "device_dispatch", "commit"):
+            assert phase in rec["phases"], phase

@@ -1,12 +1,13 @@
 """Serving surface (healthz/readyz/metrics, leader election) + tracing."""
 
+import json
 import urllib.request
 
 from kubernetes_tpu.backend.apiserver import APIServer
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.server import LeaderElector, SchedulerServer
 from kubernetes_tpu.testing.wrappers import make_node, make_pod
-from kubernetes_tpu.utils.tracing import Tracer
+from kubernetes_tpu.utils.tracing import Tracer, to_chrome_trace
 
 
 class FakeClock:
@@ -132,6 +133,151 @@ class TestTracing:
         # closes (wait_pending), so `bound` counts commits inside the cycle
         assert root.attributes.get("pods") == 1
         assert root.attributes.get("bound") in (0, 1)
+
+
+class TestDebugEndpoints:
+    def _scheduled_cluster(self, tracer=None):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, tracer=tracer)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 20}).obj())
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("big").req(
+            {"cpu": "100", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        return api, sched
+
+    def test_flightrecorder_and_events_endpoints(self):
+        api, sched = self._scheduled_cluster()
+        srv = SchedulerServer(sched).start()
+        try:
+            code, body = _get(srv.port, "/debug/flightrecorder")
+            assert code == 200
+            records = json.loads(body)["records"]
+            assert records and records[-1]["pods"] == 4
+            assert records[-1]["bound"] == 3
+            assert records[-1]["failed"] == 1
+            assert "host_build" in records[-1]["phases"]
+
+            code, body = _get(srv.port, "/debug/events")
+            assert code == 200
+            dump = json.loads(body)
+            assert dump["counts"]["Normal/Scheduled"] == 3
+            assert dump["counts"]["Warning/FailedScheduling"] == 1
+
+            code, body = _get(srv.port,
+                              "/debug/events?reason=FailedScheduling&limit=1")
+            assert code == 200
+            evs = json.loads(body)["events"]
+            assert len(evs) == 1
+            assert "Insufficient cpu" in evs[0]["message"]
+        finally:
+            srv.stop()
+
+    def test_cachedump_and_slowcycles_endpoints(self):
+        tracer = Tracer(slow_threshold_s=0.0)   # every cycle is "slow"
+        api, sched = self._scheduled_cluster(tracer=tracer)
+        srv = SchedulerServer(sched).start()
+        try:
+            code, body = _get(srv.port, "/debug/cachedump")
+            assert code == 200
+            dump = json.loads(body)
+            assert "cache" in dump and "queue" in dump
+            # bound pods show up in the cache dump, the failed one pends
+            assert "default/big" in dump["queue"]["pending"]
+
+            code, body = _get(srv.port, "/debug/slowcycles")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["slowCycles"]
+            names = [c["name"] for c in payload["slowCycles"]]
+            assert "scheduling_cycle" in names
+            assert payload["slowestDrains"]
+        finally:
+            srv.stop()
+
+    def test_cache_debugger_dump_shape(self):
+        api, sched = self._scheduled_cluster()
+        dump = sched.debugger.dump()
+        assert set(dump) == {"cache", "queue"}
+        assert "summary" in dump["queue"]
+        assert isinstance(dump["queue"]["pending"], list)
+
+    def test_divergence_counter_on_seeded_mismatch(self):
+        api, sched = self._scheduled_cluster()
+        sched.wait_pending()
+        before = sched.metrics.cache_divergence.value("host_vs_apiserver")
+        # seed a mismatch: a node the cache never heard of
+        api.nodes["ghost"] = make_node("ghost").capacity(
+            {"cpu": 1, "memory": "1Gi", "pods": 5}).obj()
+        out = sched.debugger.compare()
+        assert any("ghost" in line for line in out)
+        after = sched.metrics.cache_divergence.value("host_vs_apiserver")
+        assert after >= before + 1
+
+
+class TestChromeTraceExport:
+    def test_host_build_decomposes_into_children(self, tmp_path):
+        tracer = Tracer(slow_threshold_s=float("inf"), keep_recent=128)
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, tracer=tracer)
+        api.create_node(make_node("n0").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 20}).obj())
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 3
+        assert tracer.recent
+        hb = next(sp for root in tracer.recent
+                  for sp in [root.find("host_build")] if sp is not None)
+        child_names = {c.name for c in hb.children}
+        # the acceptance gate: host_build decomposes into >= 3 phases
+        assert len(child_names & {"host_snapshot", "host_tensorize",
+                                  "host_group_seed", "host_cache"}) >= 3
+        dd = next(sp for root in tracer.recent
+                  for sp in [root.find("device_dispatch")] if sp is not None)
+        assert dd.attributes["pods"] == 3
+        assert "runs" in dd.attributes
+
+        dest = tmp_path / "run.trace.json"
+        n = tracer.export_chrome_trace(str(dest))
+        trace = json.loads(dest.read_text())   # loadable JSON
+        events = trace["traceEvents"]
+        assert len(events) == n
+        complete = [e for e in events if e["ph"] == "X"]
+        assert {"host_build", "device_dispatch"} <= {e["name"]
+                                                     for e in complete}
+        for e in complete:
+            assert e["dur"] >= 0 and "ts" in e
+
+    def test_to_chrome_trace_nests_all_spans(self):
+        clock = FakeClock()
+        tr = Tracer(slow_threshold_s=float("inf"), clock=clock,
+                    keep_recent=4)
+        with tr.span("root", pods=2):
+            with tr.span("child_a"):
+                clock.t += 0.25
+            with tr.span("child_b"):
+                clock.t += 0.5
+        trace = to_chrome_trace(list(tr.recent))
+        byname = {e["name"]: e for e in trace["traceEvents"]
+                  if e["ph"] == "X"}
+        assert byname["root"]["dur"] == 750000.0
+        assert byname["child_a"]["dur"] == 250000.0
+        assert byname["child_b"]["ts"] == 250000.0
+        assert byname["root"]["args"] == {"pods": 2}
+
+    def test_jax_profiler_session_noop_when_unset(self):
+        from kubernetes_tpu.utils.tracing import jax_profiler_session
+        with jax_profiler_session(""):
+            pass
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        assert sched.profiler_trace_dir == ""
+        with sched.profile_session():
+            pass
 
 
 class TestExtenders:
