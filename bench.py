@@ -3,8 +3,16 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N/270,
+   "summary": {"<Case>_<Workload>": {"pods_per_s": N, "p50": N, "p99": N,
+               "attempt_p50_ms": N, "attempt_p99_ms": N}, ...},
    "extra": {"TopologySpreading_...": {...}, "SchedulingPodAntiAffinity_...":
    {...}}}
+
+`summary` is the NORMALIZED per-workload block — every workload (headline
+included) with its throughput and latency percentiles in one place, the
+contract `tools/bench_compare.py` (the regression sentinel) reads; `extra`
+keeps the full per-workload detail (passes, warm/measured seconds, drain
+phase sums, wave stats, host_top_frames).
 
 `vs_baseline` divides by the reference's threshold for the same workload
 (kubernetes/kubernetes test/integration/scheduler_perf configs):
@@ -55,7 +63,9 @@ CASES = [
 # PreemptionChurn's preemptor wave is the createPods op at this template
 # index (perf/configs/performance-config.yaml): its wall time is recorded
 # separately as preemption_wave_s — the wave runs OUTSIDE the measured
-# window, so the headline can't see regressions there without this
+# window. Per-workload regressions inside the window are the `summary`
+# block's job (tools/bench_compare.py gates every workload, not just the
+# headline); this extra keeps the out-of-window wave visible too.
 PREEMPTION_WAVE_OP = "createPods[2]"
 
 
@@ -134,6 +144,13 @@ def main() -> None:
                     help="write one Chrome-trace JSON per workload "
                          "(spans of the median-candidate measured passes; "
                          "load at chrome://tracing or ui.perfetto.dev)")
+    ap.add_argument("--profile-dir", default="",
+                    help="write one collapsed-stack host profile per "
+                         "workload (continuous profiler; render with "
+                         "flamegraph.pl or speedscope.app)")
+    ap.add_argument("--cases", default="",
+                    help="comma-separated case filter (e.g. "
+                         "SchedulingBasic,TopologySpreading); default all")
     args = ap.parse_args()
     # raise gen0 thresholds so collection cycles don't land in the measured
     # window; the freeze happens after each warm pass, once the long-lived
@@ -147,8 +164,11 @@ def main() -> None:
     cfg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "kubernetes_tpu", "perf", "configs",
                        "performance-config.yaml")
+    case_filter = {c for c in args.cases.split(",") if c}
     results = {}
     for case, big, small_wl, threshold in CASES:
+        if case_filter and case not in case_filter:
+            continue
         workload = small_wl if small else big
         t0 = time.perf_counter()
         run_config(cfg, case, workload)           # warm: compiles all shapes
@@ -166,7 +186,8 @@ def main() -> None:
             t0 = time.perf_counter()
             got = run_config(cfg, case, workload, verbose=verbose,
                              metrics_path="bench_metrics.prom",
-                             trace_dir=args.trace_dir)
+                             trace_dir=args.trace_dir,
+                             profile_dir=args.profile_dir)
             measured_s += time.perf_counter() - t0
             if not got:
                 raise SystemExit(f"workload {case}/{workload} not found")
@@ -196,7 +217,26 @@ def main() -> None:
                   f"(warm pass {warm_s:.1f}s, measured {measured_s:.1f}s)",
                   file=sys.stderr)
 
-    if not small:   # the CPU-mesh probe would dominate the quick variant
+    if not results:
+        raise SystemExit(f"--cases {args.cases!r} matched no case")
+
+    # normalized per-workload summary (the bench_compare.py contract):
+    # every workload's throughput + latency percentiles in ONE block, so
+    # neither the sentinel nor a human parses `extra` ad hoc — fixing the
+    # headline blindness where phases outside the headline metric (and
+    # every non-headline workload) had no first-class number
+    summary = {}
+    for key, entry in results.items():
+        summary[key] = {
+            "pods_per_s": entry["value"],
+            "p50": entry.get("p50", 0), "p99": entry.get("p99", 0),
+            "attempt_p50_ms": entry.get("attempt_p50_ms", 0.0),
+            "attempt_p99_ms": entry.get("attempt_p99_ms", 0.0),
+        }
+
+    if not small and not case_filter:
+        # the CPU-mesh probe would dominate the quick variant; excluded
+        # from `summary` (compile evidence, not a throughput contract)
         results["Sharded_8dev_512Nodes_2048Pods"] = sharded_probe()
 
     head_key = next(iter(results))
@@ -206,6 +246,7 @@ def main() -> None:
         "value": head["value"],
         "unit": "pods/s",
         "vs_baseline": head["vs_baseline"],
+        "summary": summary,
         "extra": {k: v for k, v in results.items() if k != head_key},
     }))
 

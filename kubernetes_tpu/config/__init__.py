@@ -78,6 +78,10 @@ class KubeSchedulerConfiguration:
     # Scheduler.profile_session() brackets work with an XLA-level profiler
     # trace under the host spans (empty string = off)
     profiler_trace_dir: str = ""
+    # continuous host profiler sampling rate (perf/profiler.py), consulted
+    # only when the ContinuousHostProfiling gate is on; 0 disables the
+    # sampler even with the gate on
+    host_profiler_hz: float = 200.0
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
@@ -105,6 +109,8 @@ class KubeSchedulerConfiguration:
             raise ValueError("apiRetryMaxAttempts must be >= 1")
         if self.api_retry_base_seconds <= 0:
             raise ValueError("apiRetryBaseSeconds must be > 0")
+        if self.host_profiler_hz < 0 or self.host_profiler_hz > 10000:
+            raise ValueError("hostProfilerHz must be in [0, 10000]")
         known = set(_default_plugin_names()) | set(self.extra_plugins)
         for p in self.profiles:
             for n in p.plugins.enabled + p.plugins.disabled:
@@ -148,6 +154,7 @@ class KubeSchedulerConfiguration:
             "apiRetryBaseSeconds": self.api_retry_base_seconds,
             "compilationCacheDir": self.compilation_cache_dir,
             "profilerTraceDir": self.profiler_trace_dir,
+            "hostProfilerHz": self.host_profiler_hz,
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
         }
@@ -192,6 +199,7 @@ class KubeSchedulerConfiguration:
             compilation_cache_dir=d.get("compilationCacheDir",
                                         "~/.cache/ktpu-xla"),
             profiler_trace_dir=d.get("profilerTraceDir", ""),
+            host_profiler_hz=d.get("hostProfilerHz", 200.0),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))
 

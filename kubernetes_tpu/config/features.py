@@ -61,6 +61,11 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # per-plugin rejected-node counts reduced from the device filter masks;
     # off = the host-oracle filter replay per failed signature
     "DeviceMaskDiagnosis": FeatureSpec(True, BETA),
+    # always-on sampling host profiler (perf/profiler.py): a background
+    # thread samples the host-loop stack at hostProfilerHz, attributing
+    # cost per drain phase + signature-cardinality bucket; served at
+    # /debug/hostprofile. Off = no sampler thread, no attribution.
+    "ContinuousHostProfiling": FeatureSpec(True, BETA),
 }
 
 

@@ -48,13 +48,17 @@ class Event:
     count: int = 1
     first_timestamp: float = 0.0
     last_timestamp: float = 0.0
+    # drain that emitted the LAST occurrence (0 = outside a drain commit):
+    # correlates the event with log lines, spans and the flight entry
+    drain_id: int = 0
 
     def to_dict(self) -> dict:
         return {"object": self.object_ref, "type": self.type,
                 "reason": self.reason, "message": self.message,
                 "count": self.count,
                 "firstTimestamp": round(self.first_timestamp, 6),
-                "lastTimestamp": round(self.last_timestamp, 6)}
+                "lastTimestamp": round(self.last_timestamp, 6),
+                "drainId": self.drain_id}
 
 
 class EventRecorder:
@@ -73,10 +77,12 @@ class EventRecorder:
         self.clock = clock
         self.metrics = metrics
         self._events: "OrderedDict[tuple, Event]" = OrderedDict()
-        # Scheduled fast path: (object_ref, node_name, timestamp) tuples;
-        # message formatting deferred to query time
+        # Scheduled fast path: (object_ref, node_name, timestamp, drain)
+        # tuples; message formatting deferred to query time
         self._scheduled: deque = deque(maxlen=capacity)
         self.counts: dict[tuple[str, str], int] = {}
+        # the drain whose commit is currently emitting (scheduler-set)
+        self.current_drain = 0
 
     # -- recording ------------------------------------------------------------
 
@@ -89,12 +95,14 @@ class EventRecorder:
         if ev is not None:
             ev.count += 1
             ev.last_timestamp = now
+            ev.drain_id = self.current_drain
             self._events.move_to_end(key)
         else:
             self._events[key] = Event(object_ref=object_ref, type=type_,
                                       reason=reason, message=message,
                                       first_timestamp=now,
-                                      last_timestamp=now)
+                                      last_timestamp=now,
+                                      drain_id=self.current_drain)
             while len(self._events) > self.capacity:
                 self._events.popitem(last=False)
         self._count(type_, reason)
@@ -102,7 +110,8 @@ class EventRecorder:
     def scheduled(self, object_ref: str, node_name: str) -> None:
         """Cheap Scheduled event (per-bind hot path): no string formatting,
         one deque append + one counter bump."""
-        self._scheduled.append((object_ref, node_name, self.clock()))
+        self._scheduled.append((object_ref, node_name, self.clock(),
+                                self.current_drain))
         self._count(EVENT_NORMAL, REASON_SCHEDULED)
 
     def scheduled_bulk(self, refs_nodes: list, now: Optional[float] = None
@@ -111,7 +120,9 @@ class EventRecorder:
         if not refs_nodes:
             return
         t = self.clock() if now is None else now
-        self._scheduled.extend((ref, node, t) for ref, node in refs_nodes)
+        did = self.current_drain
+        self._scheduled.extend((ref, node, t, did)
+                               for ref, node in refs_nodes)
         self._count(EVENT_NORMAL, REASON_SCHEDULED, by=len(refs_nodes))
 
     def _count(self, type_: str, reason: str, by: int = 1) -> None:
@@ -135,13 +146,14 @@ class EventRecorder:
         entries are materialized into full Events here."""
         out: list[Event] = []
         if reason in (None, REASON_SCHEDULED):
-            for ref, node, t in self._scheduled:
+            for ref, node, t, did in self._scheduled:
                 if object_ref is not None and ref != object_ref:
                     continue
                 out.append(Event(object_ref=ref, type=EVENT_NORMAL,
                                  reason=REASON_SCHEDULED,
                                  message=self.scheduled_message(ref, node),
-                                 first_timestamp=t, last_timestamp=t))
+                                 first_timestamp=t, last_timestamp=t,
+                                 drain_id=did))
         for ev in self._events.values():
             if reason is not None and ev.reason != reason:
                 continue
@@ -183,6 +195,10 @@ class FlightRecord:
     consecutive_faults: int = 0
     fallback: str = ""        # "" = device path; else degradation reason
     events: dict = field(default_factory=dict)  # reason → count this drain
+    drain_id: int = 0         # the scheduler's monotonic drain id
+    # hottest host frames over the drain's wall window, attached only to
+    # SLOW drains by the continuous profiler ("frame self/total" strings)
+    hot_frames: tuple = ()
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -196,7 +212,9 @@ class FlightRecord:
                 "phases": {k: round(v, 6) for k, v in self.phases.items()},
                 "wave": self.wave, "breakerOpen": self.breaker_open,
                 "consecutiveFaults": self.consecutive_faults,
-                "fallback": self.fallback, "events": self.events}
+                "fallback": self.fallback, "events": self.events,
+                "drainId": self.drain_id,
+                "hotFrames": list(self.hot_frames)}
 
 
 class FlightRecorder:

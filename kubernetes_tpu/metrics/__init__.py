@@ -251,7 +251,8 @@ class SchedulerMetrics:
     """The scheduler's series, bound to one Registry (metrics.go Register)."""
 
     def __init__(self, registry: Optional[Registry] = None,
-                 queue_depths: Optional[Callable[[], dict]] = None):
+                 queue_depths: Optional[Callable[[], dict]] = None,
+                 inflight: Optional[Callable[[], dict]] = None):
         r = self.registry = registry or Registry()
         n = f"{SUBSYSTEM}_"
         self.schedule_attempts = r.register(Counter(
@@ -367,6 +368,30 @@ class SchedulerMetrics:
             "rejected them (device mask-derived diagnosis).",
             buckets=[1, 8, 64, 512, 2048, 8192, 32768],
             label_names=("plugin",)))
+        # device/compile cost capture (perf/ledger.py): mirrored from the
+        # process-global compile ledger at exposition time
+        self.xla_compiles = r.register(Counter(
+            n + "xla_compiles_total",
+            "XLA executables compiled per kernel entry point (fresh "
+            "jit-cache entries; >1 per kernel = retraces).",
+            ("kernel",)))
+        self.xla_compile_seconds = r.register(Counter(
+            n + "xla_compile_seconds",
+            "Wall seconds spent in dispatches that minted a fresh XLA "
+            "executable (trace + lower + compile), per kernel.",
+            ("kernel",)))
+        self.h2d_bytes = r.register(Counter(
+            n + "h2d_bytes_total",
+            "Host-device transfer bytes by the drain phase that paid "
+            "them (node-array/group/table uploads; device_readback is "
+            "the d2h direction).",
+            ("phase",)))
+        self.dispatcher_inflight = r.register(Gauge(
+            n + "dispatcher_inflight",
+            "In-flight work of the async commit pipeline at scrape time: "
+            "queued api_calls (dispatcher) and dispatched-but-uncommitted "
+            "drains.",
+            ("kind",), callback=inflight))
         # pre-seed the zero samples so dashboards (and bench_metrics.prom)
         # always carry the fault-path series, faults or not
         from ..backend.dispatcher import CallType
@@ -419,6 +444,29 @@ class SchedulerMetrics:
             self.plugin_execution_duration.seed(plugin, "Score", "SUCCESS")
             self.plugin_evaluation_total.inc(plugin, "Score",
                                              DEFAULT_PROFILE, by=0)
+        from ..perf.ledger import H2D_PHASES, KERNELS
+        for kernel in KERNELS:
+            self.xla_compiles.inc(kernel, by=0)
+            self.xla_compile_seconds.inc(kernel, by=0)
+        for phase in H2D_PHASES:
+            self.h2d_bytes.inc(phase, by=0)
+        # seed the static fallback values; a wired callback (the live
+        # scheduler) takes precedence at scrape time
+        for kind in ("api_calls", "drains"):
+            self.dispatcher_inflight.set(0.0, kind)
+
+    def sync_compile_ledger(self) -> None:
+        """Mirror the process-global compile ledger (perf/ledger.py) into
+        the xla_*/h2d series. Absolute assignment, not increment: the
+        ledger owns the monotonic totals (jit caches are process-wide, so
+        per-Scheduler deltas would under-report shared compiles)."""
+        from ..perf.ledger import GLOBAL
+        for kernel, rec in GLOBAL.kernels.items():
+            self.xla_compiles._values[(kernel,)] = float(rec.compiles)
+            self.xla_compile_seconds._values[(kernel,)] = rec.compile_seconds
+        for phase, nbytes in GLOBAL.h2d.items():
+            self.h2d_bytes._values[(phase,)] = float(nbytes)
 
     def exposition(self) -> str:
+        self.sync_compile_ledger()
         return self.registry.exposition()

@@ -1152,6 +1152,8 @@ class GroupManager:
 def to_device(tree):
     """numpy → jnp leaves of a GroupsDev / GroupCarry."""
     import jax.numpy as jnp
+    from ..perf.ledger import GLOBAL as _ledger
+    _ledger.note_h2d_tree("host_group_seed", tree)
     return type(tree)(*(jnp.asarray(x) for x in tree))
 
 

@@ -42,6 +42,10 @@ from ..state.batch import (OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN,
                            OP_LT, OP_NOT_IN, TOL_EQUAL, TOL_EXISTS)
 from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
                                EFFECT_PREFER_NO_SCHEDULE, NodeArrays)
+# compile ledger (perf/ledger.py): every public jit entry below dispatches
+# through LEDGER.measured_call so fresh compiles/retraces/donation misses
+# are attributed per kernel (scheduler_xla_compiles_total{kernel})
+from ..perf.ledger import GLOBAL as LEDGER
 
 MAX_SCORE = 100
 
@@ -382,8 +386,10 @@ def _gather_row(table: PodTableDev, x) -> PodRow:
 
 def table_from_batch(batch) -> PodTableDev:
     """PodBatch → device signature table."""
-    return PodTableDev(*(jnp.asarray(getattr(batch.table, f))
-                         for f in PodTableDev._fields))
+    table = PodTableDev(*(jnp.asarray(getattr(batch.table, f))
+                          for f in PodTableDev._fields))
+    LEDGER.note_h2d_tree("host_cache", table)
+    return table
 
 
 def pod_rows_from_batch(batch) -> tuple[PodXs, PodTableDev]:
@@ -620,8 +626,10 @@ def diagnose_row(na: NodeArrays, table: PodTableDev, tidx: int,
     carry the per-reason detail for DIAG_FIT nodes ("Too many pods" /
     per-column Insufficient)."""
     if gd is not None:
-        return _diagnose_groups(na, table, jnp.int32(tidx), gd, gc, fam)
-    return _diagnose_lean(na, table, jnp.int32(tidx))
+        return LEDGER.measured_call("diagnose", _diagnose_groups, na, table,
+                                    jnp.int32(tidx), gd, gc, fam)
+    return LEDGER.measured_call("diagnose", _diagnose_lean, na, table,
+                                jnp.int32(tidx))
 
 
 def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
@@ -713,8 +721,11 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
     replay (the uniform path's exactness fallback) must therefore never
     reuse a carry already consumed by run_batch — the scheduler keeps
     carry_in only for run_uniform records, which do not donate."""
-    fn = _run_batch_fn(jax.default_backend() != "cpu")
-    return fn(cfg, na, carry, pods, table, groups, fam, overlay)
+    donate = jax.default_backend() != "cpu"
+    fn = _run_batch_fn(donate)
+    return LEDGER.measured_call("run_batch", fn, cfg, na, carry, pods,
+                                table, groups, fam, overlay,
+                                donated=carry if donate else None)
 
 
 def _uniform_matrix(cfg: ScoreConfig, na: NodeArrays, fit_used, fit_npods,
@@ -787,9 +798,9 @@ def _uniform_matrix(cfg: ScoreConfig, na: NodeArrays, fit_used, fit_npods,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
-def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
-                table: PodTableDev, n_actual, L: int, K: int, J: int,
-                overlay=None):
+def _run_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
+                     table: PodTableDev, n_actual, L: int, K: int, J: int,
+                     overlay=None):
     """Closed-form batch assignment for a run of SAME-SIGNATURE pods — the
     top-k trick of reference runtime/batch.go:97 (sortedNodes.Pop) taken to
     its TPU limit: the whole run becomes ONE top_k instead of L scan steps.
@@ -907,6 +918,17 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
         assignments,
         jnp.stack([mono_ok & norm_ok, depth_ok]).astype(jnp.int32)])
     return new_carry, packed
+
+
+def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
+                table: PodTableDev, n_actual, L: int, K: int, J: int,
+                overlay=None):
+    """Ledger-instrumented entry for `_run_uniform_jit` (the closed-form
+    top-L path; see its docstring for the exactness argument). Never
+    donates: the scheduler keeps the input carry for rewind/replay."""
+    return LEDGER.measured_call("run_uniform", _run_uniform_jit, cfg, na,
+                                carry, x, table, n_actual, L, K, J,
+                                overlay=overlay)
 
 
 # ---------------------------------------------------------------------------
@@ -1247,14 +1269,17 @@ def run_wave_scan(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs: WaveXs,
     variant — no group state at all (gd may be None) — for drains of
     non-interacting signatures whose alternation would thrash the scan's
     one-slot signature cache."""
-    fn = _run_wave_scan_fn(jax.default_backend() != "cpu")
-    return fn(cfg, na, carry, xs, table, wt, gd, statics, fam, norm_live,
-              has_groups)
+    donate = jax.default_backend() != "cpu"
+    fn = _run_wave_scan_fn(donate)
+    return LEDGER.measured_call("run_wave_scan", fn, cfg, na, carry, xs,
+                                table, wt, gd, statics, fam, norm_live,
+                                has_groups,
+                                donated=carry if donate else None)
 
 
 @functools.partial(jax.jit, static_argnames=("feats",))
-def wave_statics(na: NodeArrays, table: PodTableDev, wt,
-                 feats: tuple = (True, True, True)):
+def _wave_statics_jit(na: NodeArrays, table: PodTableDev, wt,
+                      feats: tuple = (True, True, True)):
     """Carry-independent per-signature surfaces for the wave kernels —
     static filter mask (name/unschedulable/taints/selector; ports vacuous
     for sig != 0 rows), TaintToleration / preferred-affinity raw counts,
@@ -1292,6 +1317,13 @@ def wave_statics(na: NodeArrays, table: PodTableDev, wt,
         return m, traw, naraw, simg
 
     return jax.vmap(one)(rows)
+
+
+def wave_statics(na: NodeArrays, table: PodTableDev, wt,
+                 feats: tuple = (True, True, True)):
+    """Ledger-instrumented entry for `_wave_statics_jit`."""
+    return LEDGER.measured_call("wave_statics", _wave_statics_jit, na,
+                                table, wt, feats)
 
 
 class _SameWaveState(NamedTuple):
@@ -1660,10 +1692,13 @@ def run_wave(cfg: ScoreConfig, na: NodeArrays, carry: Carry, valid,
     signature's wave_statics row ([N] each); `Lw` caps the speculated
     entries per merge wave (span-length independent, so one executable
     serves every drain size)."""
-    fn = _run_wave_same_fn(jax.default_backend() != "cpu")
+    donate = jax.default_backend() != "cpu"
+    fn = _run_wave_same_fn(donate)
     Lw = min(Lw, valid.shape[0])
-    return fn(cfg, na, carry, valid, table, wt, gd, statics, K, J, Lw,
-              fam, norm_live, anti_term, merge_on)
+    return LEDGER.measured_call("run_wave", fn, cfg, na, carry, valid,
+                                table, wt, gd, statics, K, J, Lw, fam,
+                                norm_live, anti_term, merge_on,
+                                donated=carry if donate else None)
 
 
 # ---------------------------------------------------------------------------
@@ -1694,9 +1729,10 @@ def _dry_run_spread_ok(sp: DryRunSpread, removed):
 
 
 @jax.jit
-def dry_run_select_victims(na: NodeArrays, pod: PodRow, cand,
-                           victim_req, victim_valid, ovl_used, ovl_npods,
-                           spread: DryRunSpread | None = None):
+def _dry_run_select_victims_jit(na: NodeArrays, pod: PodRow, cand,
+                                victim_req, victim_valid, ovl_used,
+                                ovl_npods,
+                                spread: DryRunSpread | None = None):
     """Batched select_victims_on_node (default_preemption.go:583) over the
     candidate-node axis.
 
@@ -1768,6 +1804,15 @@ def dry_run_select_victims(na: NodeArrays, pod: PodRow, cand,
     carry0 = (base_used, base_npods, removed0)
     _, reprieved = lax.scan(step, carry0, xs)
     return jnp.concatenate([fits[:, None], reprieved.T], axis=1)
+
+
+def dry_run_select_victims(na: NodeArrays, pod: PodRow, cand,
+                           victim_req, victim_valid, ovl_used, ovl_npods,
+                           spread: DryRunSpread | None = None):
+    """Ledger-instrumented entry for `_dry_run_select_victims_jit`."""
+    return LEDGER.measured_call("dry_run", _dry_run_select_victims_jit,
+                                na, pod, cand, victim_req, victim_valid,
+                                ovl_used, ovl_npods, spread)
 
 
 def initial_carry(na: NodeArrays, groups: GroupCarry | None = None) -> Carry:

@@ -152,9 +152,9 @@ def _sharded_step(cfg: ScoreConfig, axis: str, n_global: int,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh", "fam"))
-def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
-                      carry: Carry, pods: PodXs, table: PodTableDev,
-                      groups: GroupsDev | None = None, fam=None):
+def _run_batch_sharded_jit(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
+                           carry: Carry, pods: PodXs, table: PodTableDev,
+                           groups: GroupsDev | None = None, fam=None):
     """`ops.program.run_batch` with the node axis sharded over `mesh`.
 
     N (the padded node count) must be divisible by the mesh size; the
@@ -185,6 +185,18 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                   replicated_table, groups_spec),
         out_specs=(node_sharded_carry, P()))
     return fn(na, carry, pods, table, groups)
+
+
+def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
+                      carry: Carry, pods: PodXs, table: PodTableDev,
+                      groups: GroupsDev | None = None, fam=None):
+    """Ledger-instrumented entry for `_run_batch_sharded_jit` (compile
+    ledger: perf/ledger.py — the sharded program's compiles are the
+    expensive ones, one executable per mesh shape)."""
+    from ..perf.ledger import GLOBAL as LEDGER
+    return LEDGER.measured_call("run_batch_sharded", _run_batch_sharded_jit,
+                                cfg, mesh, na, carry, pods, table, groups,
+                                fam)
 
 
 def shard_node_arrays(mesh: Mesh, na: NodeArrays) -> NodeArrays:

@@ -369,6 +369,11 @@ class WorkloadRunner:
             extras["waves"] = int(waves)
             extras["wave_conflict_ratio"] = round(
                 m.wave_conflict_ratio.sum() / max(nconf, 1), 4)
+        prof = getattr(sched, "profiler", None)
+        if prof is not None and prof.sample_count:
+            # hottest host frames of the run (continuous profiler): the
+            # function-level answer behind the host_*_s phase sums
+            extras["host_top_frames"] = prof.top_frames(5)
         for item in items:
             item.op_seconds = list(op_times)
             item.extras = dict(extras)
@@ -378,12 +383,15 @@ class WorkloadRunner:
 def run_config(path: str, case_filter: str = "", workload_filter: str = "",
                verbose: bool = False, scheduler_factory=None,
                metrics_path: str = "",
-               trace_dir: str = "") -> list[tuple[DataItem, float]]:
+               trace_dir: str = "",
+               profile_dir: str = "") -> list[tuple[DataItem, float]]:
     """Run matching (case, workload) pairs; returns [(item, threshold)].
     `metrics_path` appends each run's Prometheus exposition (the reference
     benchmark collects /metrics the same way, scheduler_perf/util.go);
     `trace_dir` writes one Chrome-trace JSON of the run's span trees per
-    workload (loadable at chrome://tracing / ui.perfetto.dev)."""
+    workload (loadable at chrome://tracing / ui.perfetto.dev);
+    `profile_dir` writes one collapsed-stack host profile per workload
+    (flamegraph.pl / speedscope.app ingest it directly)."""
     out = []
     for tc in load_test_cases(path):
         if case_filter and case_filter != tc.name:
@@ -406,4 +414,12 @@ def run_config(path: str, case_filter: str = "", workload_filter: str = "",
                 n = runner.last_tracer.export_chrome_trace(dest)
                 if verbose:
                     print(f"  trace: {dest} ({n} events)")
+            prof = getattr(runner.last_scheduler, "profiler", None)
+            if profile_dir and prof is not None:
+                os.makedirs(profile_dir, exist_ok=True)
+                dest = os.path.join(profile_dir,
+                                    f"{tc.name}_{wl.name}.collapsed.txt")
+                n = prof.write_collapsed(dest)
+                if verbose:
+                    print(f"  profile: {dest} ({n} stacks)")
     return out

@@ -1,0 +1,174 @@
+"""Compile ledger: device/compile cost capture for the JIT entry points.
+
+The scheduler's cold-start and tail latency are dominated by a handful of
+XLA executables (scan buckets, uniform L/K/J variants, wave kernels) and
+by host↔device transfers. The reference has nothing comparable to
+instrument — its hot path is host Go — but every measurement-driven
+placement system (Gavel, arXiv:2008.09213; topology-aware co-located LLM
+scheduling, arXiv:2411.11560) keeps an attributable cost profile of its
+own scheduler loop. This module is that profile's device half:
+
+- every public JIT entry (`ops/program.py` run_batch / run_uniform /
+  run_wave / run_wave_scan / wave_statics / diagnose_row /
+  dry_run_select_victims, `parallel/sharding.py` run_batch_sharded) calls
+  through `measured_call`, which detects fresh compiles via the jitted
+  function's `_cache_size()` delta and records per-kernel compile wall
+  seconds, call counts, retraces (compiles beyond the first) and
+  donated-buffer misses (a donated carry whose buffer survived the call —
+  the donation was ignored, so the dispatch paid a full carry copy);
+- host↔device transfer sites (`state/tensorize.py` node-array uploads,
+  `ops/groups.py` group-tensor uploads, the signature-table upload and
+  the drain readbacks) report byte counts via `note_h2d`, keyed by the
+  drain phase that paid them.
+
+The ledger is PROCESS-GLOBAL (`GLOBAL`) because the jit caches it
+observes are process-global; `SchedulerMetrics` mirrors it into
+`scheduler_xla_compiles_total{kernel}`,
+`scheduler_xla_compile_seconds{kernel}` and
+`scheduler_h2d_bytes_total{phase}` at exposition time, and
+`/debug/compileledger` serves the full snapshot (retraces and donation
+misses included). A warm process re-running identical shapes must show a
+ZERO compile delta — that invariant is the "no hidden retraces" test.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class KernelRecord:
+    """Per-kernel compile/call accounting."""
+
+    calls: int = 0
+    compiles: int = 0            # fresh executables minted (cache-size delta)
+    compile_seconds: float = 0.0  # wall time of calls that compiled
+    donation_misses: int = 0     # donated carry not consumed by the call
+
+    @property
+    def retraces(self) -> int:
+        """Compiles beyond the first: shape/static-arg churn minting extra
+        executables for the same kernel (each one is 20-40s on a tunneled
+        TPU — the thing shape-stable dispatch exists to avoid)."""
+        return max(self.compiles - 1, 0)
+
+    def to_dict(self) -> dict:
+        return {"calls": self.calls, "compiles": self.compiles,
+                "retraces": self.retraces,
+                "compileSeconds": round(self.compile_seconds, 3),
+                "donationMisses": self.donation_misses}
+
+
+# every instrumented kernel, pre-seeded into the metric families so
+# dashboards see the series before the first dispatch
+KERNELS = ("run_batch", "run_uniform", "run_wave", "run_wave_scan",
+           "wave_statics", "diagnose", "dry_run", "run_batch_sharded")
+
+# h2d phase labels, aligned with scheduler_drain_phase_seconds{phase}
+# where the transfer is paid (device_readback is the d2h direction of the
+# same tunnel — kept in one family so transfer dashboards need one query)
+H2D_PHASES = ("host_snapshot", "host_group_seed", "host_cache",
+              "device_readback")
+
+
+class CompileLedger:
+    """Process-wide compile + transfer accounting (see module docstring)."""
+
+    def __init__(self) -> None:
+        self.kernels: dict[str, KernelRecord] = {}
+        self.h2d: dict[str, int] = {}
+
+    # -- compile capture ------------------------------------------------------
+
+    def _rec(self, kernel: str) -> KernelRecord:
+        rec = self.kernels.get(kernel)
+        if rec is None:
+            rec = self.kernels[kernel] = KernelRecord()
+        return rec
+
+    @staticmethod
+    def _cache_size(fn) -> int:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return -1
+        try:
+            return int(probe())
+        except Exception:  # pragma: no cover - backend specific
+            return -1
+
+    def measured_call(self, kernel: str, fn, *args, donated=None, **kw):
+        """Call jitted `fn`, attributing any fresh compile (cache-size
+        delta) to `kernel`. `donated` is the carry the caller donated (or
+        None when the backend compiles without donation): if its buffer
+        survives the call, the donation was ignored and the dispatch paid
+        a copy of the resident node state — counted as a miss."""
+        rec = self._rec(kernel)
+        before = self._cache_size(fn)
+        t0 = _time.perf_counter()
+        out = fn(*args, **kw)
+        rec.calls += 1
+        if before >= 0:
+            delta = self._cache_size(fn) - before
+            if delta > 0:
+                rec.compiles += delta
+                rec.compile_seconds += _time.perf_counter() - t0
+        if donated is not None:
+            # probe one leaf of the donated pytree; is_deleted() is the
+            # jax.Array donation witness (True = buffer consumed)
+            leaf = getattr(donated, "used", donated)
+            deleted = getattr(leaf, "is_deleted", None)
+            if deleted is not None and not deleted():
+                rec.donation_misses += 1
+        return out
+
+    def wrap(self, kernel: str, fn):
+        """Instrument a module-level jitted callable in place (the
+        non-factory entry points); keeps the wrapped signature."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kw):
+            return self.measured_call(kernel, fn, *args, **kw)
+
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    # -- transfer capture -----------------------------------------------------
+
+    def note_h2d(self, phase: str, nbytes: int) -> None:
+        self.h2d[phase] = self.h2d.get(phase, 0) + int(nbytes)
+
+    def note_h2d_tree(self, phase: str, tree) -> None:
+        """Account every array leaf of a NamedTuple/iterable (the upload
+        helpers all move whole structs)."""
+        total = 0
+        for leaf in tree:
+            nb = getattr(leaf, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+        if total:
+            self.note_h2d(phase, total)
+
+    # -- reporting ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "kernels": {k: r.to_dict()
+                        for k, r in sorted(self.kernels.items())},
+            "h2dBytes": dict(sorted(self.h2d.items())),
+            "totalCompiles": sum(r.compiles for r in self.kernels.values()),
+            "totalCompileSeconds": round(
+                sum(r.compile_seconds for r in self.kernels.values()), 3),
+            "totalRetraces": sum(r.retraces for r in self.kernels.values()),
+        }
+
+    def reset(self) -> None:
+        """Test hook: forget everything (the jit caches themselves are
+        untouched, so a reset ledger on a warm process records zero
+        compiles — exactly the warm-run invariant)."""
+        self.kernels.clear()
+        self.h2d.clear()
+
+
+GLOBAL = CompileLedger()

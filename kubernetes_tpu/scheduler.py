@@ -230,6 +230,9 @@ class _PendingDrain:
     ovl: object = None
     # per-pod self-nomination rows (i32 [n], -1 = none) paired with ovl
     nom: object = None
+    # monotonic drain id: correlates this drain's log lines, spans,
+    # FlightRecorder entry and Scheduled/FailedScheduling events
+    drain_id: int = 0
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -383,7 +386,8 @@ class Scheduler:
 
         from .metrics import SchedulerMetrics
         self.metrics = metrics or SchedulerMetrics(
-            queue_depths=self._queue_depths)
+            queue_depths=self._queue_depths,
+            inflight=self._inflight_depths)
         self.dispatcher.metrics = self.metrics
         for prof in self.profiles.values():
             prof.framework.metrics = self.metrics
@@ -401,6 +405,28 @@ class Scheduler:
         # jax.profiler session directory (config profilerTraceDir; "" = off)
         self.profiler_trace_dir = (
             config.profiler_trace_dir if config is not None else "")
+        # continuous host profiling (perf/profiler.py): a sampling thread
+        # follows the host-loop thread, tagging every stack sample with
+        # the open drain phase (PhaseTrack, pushed in lockstep with the
+        # tracer spans) and the dispatching drain's signature-cardinality
+        # bucket. The thread starts lazily on the first schedule call and
+        # exits when this Scheduler is collected (weakref owner).
+        from .utils.tracing import PhaseTrack
+        self.phase_track = PhaseTrack()
+        self._drain_seq = 0          # monotonic drain id (drain_id=0: none)
+        self._sig_bucket_cell = [0]  # profiler-visible drain sig count
+        self.profiler = None
+        self.host_profiler_hz = (
+            config.host_profiler_hz if config is not None else 200.0)
+        if (self.host_profiler_hz > 0
+                and self.feature_gates.enabled("ContinuousHostProfiling")):
+            from .perf.profiler import HostProfiler
+            cell = self._sig_bucket_cell
+            self.profiler = HostProfiler(
+                hz=self.host_profiler_hz,
+                phase_fn=self.phase_track.current,
+                bucket_fn=(lambda c=cell: c[0]),
+                owner=self)
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -568,6 +594,12 @@ class Scheduler:
                 ("unschedulable",): float(
                     len(self.queue.unschedulable_pods) - gated),
                 ("gated",): float(gated)}
+
+    def _inflight_depths(self) -> dict:
+        """scheduler_dispatcher_inflight{kind} callback: the async commit
+        pipeline's live depth at scrape time."""
+        return {("api_calls",): float(len(self.dispatcher)),
+                ("drains",): float(len(self._pending))}
 
     # -- framework.Handle surface for Permit plugins --------------------------
 
@@ -824,6 +856,8 @@ class Scheduler:
         device results still in flight commit on a later call (or
         `wait_pending()`), which is what lets ingestion of the next pod
         chunk overlap the tunneled device readback."""
+        if self.profiler is not None:
+            self.profiler.ensure_running()
         start = self.scheduled_count
         batches = 0
         while True:
@@ -964,8 +998,10 @@ class Scheduler:
         async host copies land. Returns binds committed inside this call
         (only the host-fallback retry path commits synchronously)."""
         from .ops.groups import scatter_new_rows, to_device
+        from .utils.logging import log_context
 
         t_entry = _time.perf_counter()
+        did = self._drain_seq = self._drain_seq + 1
         if not self._device_available():
             # circuit breaker open: the device tier is sidelined until the
             # cooldown expires; the host oracle takes the drain
@@ -975,12 +1011,21 @@ class Scheduler:
                 profile=profile.name, pods=len(qpis), bound=0, failed=0,
                 signatures=0, kinds=(), groups=False, phases={},
                 breaker_open=True, consecutive_faults=self._device_faults,
-                fallback="circuit_open")
+                fallback="circuit_open", drain_id=did)
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
 
+        with log_context(drain=did):
+            return self._dispatch_device_drain_inner(qpis, profile, prebuilt,
+                                                     t_entry, did)
+
+    def _dispatch_device_drain_inner(self, qpis, profile, prebuilt,
+                                     t_entry, did):
+        from .ops.groups import scatter_new_rows, to_device
+
         ph: dict[str, float] = {}
-        with self.tracer.span("host_build", pods=len(qpis)):
+        with self.tracer.span("host_build", pods=len(qpis), drain=did), \
+                self.phase_track.scope("host_build"):
             carry = self._device_carry
             nominator = self.queue.nominator
             ovl_fp = nominator.version if nominator.nominated_pods else -1
@@ -1137,13 +1182,19 @@ class Scheduler:
         for name, dt in ph.items():
             self.metrics.drain_phase.observe(dt, name)
         ph["host_build"] = t0 - t_entry
+        if self.profiler is not None:
+            # profiler tag: this drain's distinct-signature count (pow2
+            # bucketed by the profiler) — host cost per cardinality regime
+            self._sig_bucket_cell[0] = int(
+                np.unique(segment_batch.tidx[:n]).size)
         try:
             with self.tracer.span("device_dispatch", pods=n,
-                                  groups=groups_needed,
+                                  groups=groups_needed, drain=did,
                                   batch_bucket=len(segment_batch.valid)) as ds:
-                carry, records = self._dispatch_runs(
-                    profile, na, carry, segment_batch, table, n,
-                    groups_needed, ovl=ovl, nom=nom)
+                with self.phase_track.scope("device"):
+                    carry, records = self._dispatch_runs(
+                        profile, na, carry, segment_batch, table, n,
+                        groups_needed, ovl=ovl, nom=nom)
                 ds.set(runs=",".join(r.kind for r in records))
         except Exception as e:
             # XLA/dispatch fault: earlier in-flight drains predate the
@@ -1161,17 +1212,22 @@ class Scheduler:
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
-            dispatched_at=t0, ovl=ovl, nom=nom, phases=ph))
+            dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did))
         return 0
 
     @contextmanager
     def _phase(self, name: str, ph: dict, **attrs):
-        """Time one host-build sub-phase: tracer child span + an entry in
-        `ph` (flight recorder + drain_phase sub-phase series)."""
+        """Time one host-build sub-phase: tracer child span, an entry in
+        `ph` (flight recorder + drain_phase sub-phase series), and the
+        PhaseTrack mark the sampling profiler attributes against."""
         t0 = _time.perf_counter()
-        with self.tracer.span(name, **attrs):
-            yield
-        ph[name] = ph.get(name, 0.0) + (_time.perf_counter() - t0)
+        self.phase_track.push(name)
+        try:
+            with self.tracer.span(name, **attrs):
+                yield
+        finally:
+            self.phase_track.pop()
+            ph[name] = ph.get(name, 0.0) + (_time.perf_counter() - t0)
 
     def _nominated_rows(self, qpis: list[QueuedPodInfo]):
         """i32 [n] row index of each drain pod's OWN nomination (-1 =
@@ -1287,9 +1343,11 @@ class Scheduler:
         self.metrics.device_batch_size.observe(n)
         self.metrics.device_batch_duration.observe(
             max(_time.perf_counter() - t0, 0.0))
+        self._drain_seq += 1
         pd = _PendingDrain(qpis=qpis, profile=profile, batch=batch,
                            table=None, na=None, n=n, groups_needed=True,
-                           records=[], dispatched_at=t0)
+                           records=[], dispatched_at=t0,
+                           drain_id=self._drain_seq)
         return self._commit_assignments(pd, out)
 
     def _node_arrays(self):
@@ -1722,7 +1780,8 @@ class Scheduler:
         out = np.full((pd.n,), -1, np.int32)
         t0 = _time.perf_counter()
         try:
-            self._resolve_records(pd, out)
+            with self.phase_track.scope("device"):
+                self._resolve_records(pd, out)
         except Exception as e:
             # XLA fault surfacing at readback/replay: degrade this drain
             # (and the chained later ones) to the host oracle
@@ -1750,10 +1809,12 @@ class Scheduler:
     def _resolve_records(self, pd: "_PendingDrain", out) -> None:
         """Resolve a drain's device results into `out`, replaying inexact
         uniform runs (and everything chained downstream) as needed."""
+        from .perf.ledger import GLOBAL as _ledger
         idx = 0
         while idx < len(pd.records):
             rec = pd.records[idx]
             r = np.asarray(rec.result)
+            _ledger.note_h2d("device_readback", r.nbytes)
             m = rec.j - rec.i
             if rec.kind == "scan":
                 out[rec.i:rec.j] = r[:m]
@@ -1835,7 +1896,18 @@ class Scheduler:
     def _commit_assignments(self, pd: _PendingDrain, out) -> int:
         """Host commit of a resolved drain: bulk assume + bind enqueue for
         hook-free pods, the full reserve/permit/pre-bind chain for the
-        rest, failure handling for the unassigned."""
+        rest, failure handling for the unassigned. Runs under the drain's
+        id (log context + event tagging) and the `commit` phase mark."""
+        from .utils.logging import log_context
+        self.events.current_drain = pd.drain_id
+        try:
+            with log_context(drain=pd.drain_id), \
+                    self.phase_track.scope("commit"):
+                return self._commit_assignments_inner(pd, out)
+        finally:
+            self.events.current_drain = 0
+
+    def _commit_assignments_inner(self, pd: _PendingDrain, out) -> int:
         t_commit = _time.perf_counter()
         qpis = pd.qpis
         profile = pd.profile
@@ -1890,6 +1962,14 @@ class Scheduler:
         commit_s = max(_time.perf_counter() - t_commit, 0.0)
         self.metrics.drain_phase.observe(commit_s, "commit")
         pd.phases["commit"] = pd.phases.get("commit", 0.0) + commit_s
+        hot: tuple = ()
+        if self.profiler is not None:
+            total_s = sum(pd.phases.values())
+            if total_s >= self.profiler.slow_drain_s:
+                # pin the hottest frames of the drain's wall window onto
+                # the flight entry — "slow drain 17" answers itself
+                hot = tuple(self.profiler.top_frames(
+                    5, seconds=max(total_s, 1.0) + 1.0))
         self.flight.record(
             profile=profile.name, pods=n, bound=bound,
             failed=len(failures),
@@ -1901,7 +1981,8 @@ class Scheduler:
             consecutive_faults=self._device_faults,
             fallback="" if pd.records else "host_greedy",
             events={"Scheduled": bound,
-                    "FailedScheduling": len(failures)})
+                    "FailedScheduling": len(failures)},
+            drain_id=pd.drain_id, hot_frames=hot)
         klog.v(2).info("batch committed", profile=profile.name, pods=n,
                        bound=bound, unschedulable=len(failures),
                        latency_ms=round(per_pod * n * 1e3, 1))
@@ -2282,6 +2363,8 @@ class Scheduler:
 
     def schedule_one(self) -> bool:
         """Reference ScheduleOne: pop + host-schedule a single pod."""
+        if self.profiler is not None:
+            self.profiler.ensure_running()
         self._drain_pending()
         qpi = self.queue.pop()
         if qpi is None:

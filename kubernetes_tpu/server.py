@@ -13,8 +13,12 @@ in-process model:
 - /debug/* are the observability surfaces: /debug/flightrecorder (the
   per-drain flight ring), /debug/slowcycles (slow span trees + slowest
   drains), /debug/events (the event recorder, ?reason=FailedScheduling to
-  filter), /debug/cachedump (CacheDebugger.dump) and /debug/cache (dump +
-  full divergence sweep).
+  filter), /debug/cachedump (CacheDebugger.dump), /debug/cache (dump +
+  full divergence sweep), /debug/hostprofile?seconds=N&format=collapsed|
+  speedscope (the continuous host profiler's phase-attributed stacks —
+  pipe the collapsed form into flamegraph.pl or drop either form onto
+  speedscope.app) and /debug/compileledger (per-kernel XLA compile
+  seconds, retraces, donation misses, h2d bytes).
 - `LeaderElector` drives a Lease object stored in the APIServer
   (coordination.k8s.io/Lease semantics: acquire when unheld or expired,
   renew while holding, release on stop). Multiple scheduler instances
@@ -178,6 +182,26 @@ class SchedulerServer:
                                        for sp in tracer.slow_cycles],
                         "slowestDrains": outer.scheduler.flight.slowest(),
                     }, indent=2), "application/json")
+                elif self.path.startswith("/debug/hostprofile"):
+                    prof = getattr(outer.scheduler, "profiler", None)
+                    if prof is None:
+                        self._send(404, "host profiler off "
+                                        "(ContinuousHostProfiling gate / "
+                                        "hostProfilerHz=0)")
+                        return
+                    q = self._query()
+                    secs = (float(q["seconds"])
+                            if q.get("seconds") else None)
+                    if q.get("format") == "speedscope":
+                        self._send(200, json.dumps(
+                            prof.speedscope(seconds=secs)),
+                            "application/json")
+                    else:
+                        self._send(200, prof.collapsed(seconds=secs))
+                elif self.path.startswith("/debug/compileledger"):
+                    from .perf.ledger import GLOBAL as ledger
+                    self._send(200, json.dumps(ledger.snapshot(), indent=2),
+                               "application/json")
                 elif self.path.startswith("/debug/events"):
                     q = self._query()
                     self._send(200, json.dumps(

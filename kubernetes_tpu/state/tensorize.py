@@ -351,6 +351,8 @@ class ClusterState:
             a = self.ensure_arrays()
             self._device = NodeArrays(*(jnp.asarray(x) for x in a))
             self._device_dirty = False
+            from ..perf.ledger import GLOBAL as _ledger
+            _ledger.note_h2d_tree("host_snapshot", a)
         return self._device
 
     def adopt_carry(self, used, nonzero_used, npods, ports,

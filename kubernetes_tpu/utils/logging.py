@@ -12,12 +12,19 @@ pkg/scheduler/schedule_one.go:830-838) on top of the stdlib logging module:
 kube-scheduler). V-levels map onto stdlib levels beneath INFO so standard
 handlers/formatters keep working; key/values render as k=v suffixes the way
 klog's structured output does.
+
+`log_context(drain=N)` scopes ambient key/values onto every line emitted
+inside it (klog's WithValues / logr context analog): the scheduler tags
+dispatch and commit blocks with the drain id, so one grep of `drain=17`
+correlates log lines with the matching span tree, FlightRecorder entry
+and Scheduled/FailedScheduling events.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+from contextlib import contextmanager
 
 _logger = logging.getLogger("kubernetes_tpu")
 if not _logger.handlers:  # library default: stderr handler, not propagated
@@ -40,7 +47,33 @@ def verbosity() -> int:
     return _verbosity
 
 
+# ambient key/values appended to every line (log_context); a plain dict —
+# the host loop is single-threaded and the profiler/server threads only
+# ever emit with an empty context of their own
+_context: dict = {}
+
+
+@contextmanager
+def log_context(**kv):
+    """Scope ambient key/values onto every klog line emitted inside."""
+    saved = {k: _context.get(k, _MISSING) for k in kv}
+    _context.update(kv)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is _MISSING:
+                _context.pop(k, None)
+            else:
+                _context[k] = v
+
+
+_MISSING = object()
+
+
 def _fmt(msg: str, kv: dict) -> str:
+    if _context:
+        kv = {**kv, **{k: v for k, v in _context.items() if k not in kv}}
     if not kv:
         return msg
     parts = " ".join(f"{k}={v!r}" if isinstance(v, str) else f"{k}={v}"

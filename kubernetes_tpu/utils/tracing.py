@@ -22,6 +22,13 @@ klog verbosity. This is the dependency-free analog:
 - `jax_profiler_session(dir)` optionally brackets a workload with a
   jax.profiler trace (XLA/TPU-level view under the host spans), gated by
   the `profilerTraceDir` config knob.
+- `PhaseTrack` is the continuous-profiler hook: a plain-list span-name
+  stack the Scheduler pushes/pops in lockstep with its phase spans
+  (host_snapshot/host_tensorize/host_group_seed/host_cache/device/
+  commit), readable from ANY thread — the sampling host profiler
+  (perf/profiler.py) tags every sample with `current()`. Kept separate
+  from Tracer so attribution works even under NOOP_TRACER (two list ops
+  per phase per drain — cheap enough to never turn off).
 """
 
 from __future__ import annotations
@@ -70,6 +77,38 @@ class Span:
             if hit is not None:
                 return hit
         return None
+
+
+class PhaseTrack:
+    """Cross-thread-readable stack of open phase/span names.
+
+    The owner (single-threaded host loop) pushes and pops; the profiler
+    thread only reads the top — CPython list append/pop/index are atomic
+    under the GIL, so no lock is needed and a torn read is impossible."""
+
+    __slots__ = ("_stack",)
+
+    def __init__(self) -> None:
+        self._stack: list = []
+
+    def push(self, name: str) -> None:
+        self._stack.append(name)
+
+    def pop(self) -> None:
+        if self._stack:
+            self._stack.pop()
+
+    def current(self) -> str:
+        s = self._stack
+        return s[-1] if s else ""
+
+    @contextmanager
+    def scope(self, name: str):
+        self._stack.append(name)
+        try:
+            yield
+        finally:
+            self.pop()
 
 
 class _NullSpan:

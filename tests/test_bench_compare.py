@@ -1,0 +1,157 @@
+"""Bench regression sentinel (tools/bench_compare.py, ISSUE 5).
+
+Fast self-tests: the real r04→r05 pair passes, an injected 20%
+SchedulingBasic regression is flagged (module-level and via the CLI exit
+code), both bench JSON formats normalize. The slow test runs
+`bench_compare.py --check` against a FRESH bench — the trajectory as an
+enforced contract rather than archaeology.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "bench_compare.py")
+
+_spec = importlib.util.spec_from_file_location("bench_compare", TOOL)
+bench_compare = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_compare)
+
+
+def _load(name):
+    with open(os.path.join(REPO, name)) as f:
+        return json.load(f)
+
+
+def _has_trail():
+    return (os.path.exists(os.path.join(REPO, "BENCH_r04.json"))
+            and os.path.exists(os.path.join(REPO, "BENCH_r05.json")))
+
+
+class TestNormalize:
+    def test_legacy_headline_plus_extra(self):
+        payload = {"parsed": {
+            "metric": "SchedulingBasic_5000_throughput", "value": 100.0,
+            "unit": "pods/s",
+            "extra": {
+                "TopologySpreading_5000": {"value": 50.0, "p50": 55,
+                                           "p99": 60,
+                                           "attempt_p99_ms": 2.0},
+                "Sharded_8dev": {"pods_per_s": 99.0},   # no "value": skip
+            }}}
+        s = bench_compare.normalize(payload)
+        assert s["SchedulingBasic_5000"]["pods_per_s"] == 100.0
+        assert s["TopologySpreading_5000"]["attempt_p99_ms"] == 2.0
+        assert "Sharded_8dev" not in s
+
+    def test_new_summary_block_wins(self):
+        payload = {"summary": {"A": {"pods_per_s": 10.0, "p50": 1,
+                                     "p99": 2}},
+                   "metric": "B_throughput", "value": 999.0}
+        assert set(bench_compare.normalize(payload)) == {"A"}
+
+    def test_garbage_rejected(self):
+        with pytest.raises(ValueError):
+            bench_compare.normalize({"nothing": True})
+
+
+class TestCompare:
+    def test_drop_within_noise_passes(self):
+        base = {"TopologySpreading_x": {"pods_per_s": 100.0}}
+        new = {"TopologySpreading_x": {"pods_per_s": 80.0}}   # -20% < 30%
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_throughput_drop_fails_default_gate(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 89.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert any("THROUGHPUT" in f for f in failures)
+
+    def test_p99_growth_fails(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "attempt_p99_ms": 10.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "attempt_p99_ms": 13.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert any("P99" in f for f in failures)
+
+    def test_p99_skipped_when_absent(self):
+        base = {"A_x": {"pods_per_s": 100.0}}
+        new = {"A_x": {"pods_per_s": 100.0, "attempt_p99_ms": 99.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_disjoint_workloads_fail_loudly(self):
+        failures, _ = bench_compare.compare(
+            {"A_x": {"pods_per_s": 1.0}}, {"B_x": {"pods_per_s": 1.0}})
+        assert any("no shared workloads" in f for f in failures)
+
+    def test_sharded_probe_excluded(self):
+        base = {"Sharded_8dev": {"pods_per_s": 100.0},
+                "A_x": {"pods_per_s": 100.0}}
+        new = {"Sharded_8dev": {"pods_per_s": 1.0},
+               "A_x": {"pods_per_s": 100.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+
+@pytest.mark.skipif(not _has_trail(), reason="BENCH_r04/r05 not present")
+class TestRealTrail:
+    def test_r04_to_r05_pair_passes(self):
+        base = bench_compare.normalize(_load("BENCH_r04.json"))
+        new = bench_compare.normalize(_load("BENCH_r05.json"))
+        failures, report = bench_compare.compare(base, new)
+        assert not failures, failures
+        assert report
+
+    def test_injected_20pct_regression_flagged(self, tmp_path):
+        """The acceptance gate: a copied BENCH json with SchedulingBasic
+        scaled to 80% must trip the sentinel (module AND cli)."""
+        doc = copy.deepcopy(_load("BENCH_r05.json"))
+        doc["parsed"]["value"] = round(doc["parsed"]["value"] * 0.8, 1)
+        injected = tmp_path / "injected.json"
+        injected.write_text(json.dumps(doc))
+
+        failures, _ = bench_compare.compare(
+            bench_compare.normalize(_load("BENCH_r05.json")),
+            bench_compare.normalize(doc))
+        assert any("THROUGHPUT" in f and "SchedulingBasic" in f
+                   for f in failures)
+
+        out = subprocess.run(
+            [sys.executable, TOOL, "--baseline",
+             os.path.join(REPO, "BENCH_r05.json"), "--new", str(injected)],
+            capture_output=True, text=True)
+        assert out.returncode == 2
+        assert "SENTINEL: FAIL" in out.stdout
+
+    def test_cli_green_exit_zero(self):
+        out = subprocess.run(
+            [sys.executable, TOOL, "--baseline",
+             os.path.join(REPO, "BENCH_r04.json"), "--new",
+             os.path.join(REPO, "BENCH_r05.json")],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "SENTINEL: OK" in out.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _has_trail(), reason="BENCH_r04/r05 not present")
+class TestFreshBenchCheck:
+    def test_check_fresh_schedulingbasic_vs_latest(self):
+        """`bench_compare --check --cases SchedulingBasic`: a fresh bench
+        run must not regress the latest BENCH_r* SchedulingBasic number
+        beyond the noise gate."""
+        out = subprocess.run(
+            [sys.executable, TOOL, "--check", "--cases", "SchedulingBasic"],
+            capture_output=True, text=True, cwd=REPO, timeout=1800)
+        assert out.returncode == 0, (
+            f"sentinel tripped on a fresh bench:\n{out.stdout}\n{out.stderr}")
+        assert "SENTINEL: OK" in out.stdout

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff a bench summary against the BENCH_r* trail.
+
+The BENCH_r01..rNN JSONs record every PR's measured throughput; until now
+they were archaeology — nothing failed when a PR silently walked the
+numbers back. This tool makes the trajectory an enforced contract:
+
+  python tools/bench_compare.py                        # newest vs previous
+  python tools/bench_compare.py --baseline BENCH_r04.json --new BENCH_r05.json
+  python tools/bench_compare.py --check                # run a FRESH bench
+  python tools/bench_compare.py --check --cases SchedulingBasic
+
+It normalizes either format — the driver's BENCH_r wrapper
+({"parsed": {...}}), the old headline+extra bench line, or the new
+`summary` block — into {workload: {pods_per_s, p50, p99, attempt_p99_ms}}
+and fails (exit 2) on:
+
+  * throughput drop beyond the workload's noise threshold (default >10%;
+    group/preemption workloads run wider — their pass-to-pass jitter in
+    the BENCH history is ±20%, see NOISE);
+  * attempt p99 latency growth >25% (when both sides carry the
+    attempt_p99_ms extra; older BENCH files predate it and skip the check).
+
+Workloads present on only one side are reported but never fail (the case
+set grows over time); the `Sharded_` CPU-mesh probe is excluded — it is
+compile evidence, not a throughput contract. `--check` is also wired in
+as a `slow`-marked pytest (tests/test_bench_compare.py), so CI enforces
+the trajectory instead of trusting the changelog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# default gates
+MAX_THROUGHPUT_DROP = 0.10     # fraction of baseline pods/s
+MAX_P99_GROWTH = 0.25          # fraction of baseline attempt_p99_ms
+
+# per-workload noise thresholds (throughput drop), keyed by case-name
+# prefix: the group/preemption workloads' measured passes jitter ±20%
+# against sub-second windows (see `passes` in any BENCH_r file), so a 10%
+# gate there would cry wolf
+NOISE = {
+    "TopologySpreading": 0.30,
+    "SchedulingPodAntiAffinity": 0.30,
+    "PreemptionChurn": 0.30,
+    "MixedSchedulingBasePod": 0.20,
+    "SchedulingNodeAffinity": 0.20,
+}
+
+SKIP_PREFIXES = ("Sharded_",)
+
+
+def throughput_gate(workload: str) -> float:
+    for prefix, thr in NOISE.items():
+        if workload.startswith(prefix):
+            return thr
+    return MAX_THROUGHPUT_DROP
+
+
+def normalize(payload: dict) -> dict:
+    """Any bench JSON shape → {workload: {pods_per_s, p50, p99,
+    attempt_p50_ms, attempt_p99_ms}}."""
+    bench = payload.get("parsed", payload)
+    if not isinstance(bench, dict):
+        raise ValueError("unrecognized bench payload")
+    if isinstance(bench.get("summary"), dict):
+        return {k: dict(v) for k, v in bench["summary"].items()
+                if isinstance(v, dict)}
+    # legacy headline + extra form
+    out: dict = {}
+
+    def entry(key: str, d: dict) -> None:
+        out[key] = {
+            "pods_per_s": float(d["value"]),
+            "p50": float(d.get("p50", 0)), "p99": float(d.get("p99", 0)),
+            "attempt_p50_ms": float(d.get("attempt_p50_ms", 0.0)),
+            "attempt_p99_ms": float(d.get("attempt_p99_ms", 0.0)),
+        }
+
+    metric = bench.get("metric", "")
+    if metric.endswith("_throughput") and isinstance(
+            bench.get("value"), (int, float)):
+        entry(metric[:-len("_throughput")], bench)
+    for key, d in (bench.get("extra") or {}).items():
+        if isinstance(d, dict) and isinstance(d.get("value"), (int, float)):
+            entry(key, d)
+    if not out:
+        raise ValueError("no workload numbers found in bench payload")
+    return out
+
+
+def load_summary(path: str) -> dict:
+    if path == "-":
+        return normalize(json.load(sys.stdin))
+    with open(path) as f:
+        return normalize(json.load(f))
+
+
+def bench_files(directory: str = REPO) -> list:
+    """BENCH_r*.json paths, oldest → newest by their rNN number."""
+    paths = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+
+    def rnum(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return sorted((p for p in paths if rnum(p) >= 0), key=rnum)
+
+
+def compare(base: dict, new: dict) -> tuple[list, list]:
+    """Returns (failures, report_lines); failures empty = sentinel green."""
+    failures: list[str] = []
+    report: list[str] = []
+    shared = [w for w in sorted(set(base) & set(new))
+              if not w.startswith(SKIP_PREFIXES)]
+    for w in shared:
+        b, n = base[w], new[w]
+        b_tp, n_tp = float(b["pods_per_s"]), float(n["pods_per_s"])
+        if b_tp <= 0:
+            continue
+        delta = n_tp / b_tp - 1.0
+        gate = throughput_gate(w)
+        line = (f"{w}: {b_tp:.1f} -> {n_tp:.1f} pods/s "
+                f"({delta:+.1%}, gate -{gate:.0%})")
+        if delta < -gate:
+            failures.append(f"THROUGHPUT REGRESSION {line}")
+        report.append(line)
+        b_p99 = float(b.get("attempt_p99_ms") or 0.0)
+        n_p99 = float(n.get("attempt_p99_ms") or 0.0)
+        if b_p99 > 0 and n_p99 > 0:
+            growth = n_p99 / b_p99 - 1.0
+            line = (f"{w}: attempt p99 {b_p99:.1f} -> {n_p99:.1f} ms "
+                    f"({growth:+.1%}, gate +{MAX_P99_GROWTH:.0%})")
+            if growth > MAX_P99_GROWTH:
+                failures.append(f"P99 LATENCY REGRESSION {line}")
+            report.append(line)
+    for w in sorted(set(base) - set(new)):
+        report.append(f"{w}: only in baseline (skipped)")
+    for w in sorted(set(new) - set(base)):
+        report.append(f"{w}: new workload (no baseline)")
+    if not shared:
+        failures.append("no shared workloads between baseline and new "
+                        "summary — nothing was actually compared")
+    return failures, report
+
+
+def run_fresh_bench(cases: str = "") -> dict:
+    """Run bench.py in a subprocess; returns the normalized summary."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    if cases:
+        cmd += ["--cases", cases]
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py exited {out.returncode}:\n"
+                           f"{out.stderr.strip()[-2000:]}")
+    line = out.stdout.strip().splitlines()[-1]
+    return normalize(json.loads(line))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--baseline", default="",
+                    help="baseline bench JSON (default: the newest "
+                         "BENCH_r*.json — or the second newest when "
+                         "--new is omitted)")
+    ap.add_argument("--new", default="", dest="new_path",
+                    help="candidate bench JSON ('-' = stdin; default: "
+                         "the newest BENCH_r*.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="run a FRESH bench.py as the candidate instead "
+                         "of reading a file")
+    ap.add_argument("--cases", default="",
+                    help="with --check: forwarded to bench.py --cases")
+    args = ap.parse_args(argv)
+
+    trail = bench_files()
+    if args.check:
+        if not (args.baseline or trail):
+            print("bench_compare: no BENCH_r*.json baseline found",
+                  file=sys.stderr)
+            return 3
+        base_path = args.baseline or trail[-1]
+        base = load_summary(base_path)
+        print(f"baseline: {os.path.basename(base_path)}; "
+              "running fresh bench...", file=sys.stderr)
+        new = run_fresh_bench(args.cases)
+    else:
+        if args.new_path:
+            new = load_summary(args.new_path)
+            base_path = args.baseline or (trail[-1] if trail else "")
+        else:
+            if len(trail) < 2 and not args.baseline:
+                print("bench_compare: need two BENCH_r*.json files (or "
+                      "--baseline/--new)", file=sys.stderr)
+                return 3
+            base_path = args.baseline or trail[-2]
+            new = load_summary(trail[-1])
+            print(f"candidate: {os.path.basename(trail[-1])}",
+                  file=sys.stderr)
+        if not base_path:
+            print("bench_compare: no baseline", file=sys.stderr)
+            return 3
+        base = load_summary(base_path)
+        print(f"baseline: {os.path.basename(base_path)}", file=sys.stderr)
+
+    failures, report = compare(base, new)
+    for line in report:
+        print(f"  {line}")
+    if failures:
+        print("\nSENTINEL: FAIL")
+        for f in failures:
+            print(f"  {f}")
+        return 2
+    print("\nSENTINEL: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
