@@ -1,0 +1,36 @@
+"""jaxsan: device-path static analysis + runtime sanitizer rails.
+
+The whole architecture bets that the Filter→Score→bind cycle compiles to
+a STATIC device program (SURVEY §7): retraces, hidden host↔device
+transfers, donated-buffer reuse and cross-thread races are therefore
+correctness-and-throughput bugs, not style issues. This package is the
+lint-time half of that contract (the compile ledger in perf/ledger.py is
+the runtime half):
+
+- `jaxsan` — an AST linter that walks every function reachable from the
+  JIT entry points and flags device-path hazards (traced-branch,
+  np-in-jit, dynamic-shape, tracer-leak, donation-after-use,
+  nondeterministic-iteration);
+- `locks` — a lock-discipline checker for the threaded subsystems
+  (`# guarded_by:` annotations → unguarded-shared-state findings, plus
+  lock-acquisition-order cycle detection);
+- `rails` — runtime sanitizer rails behind the `SanitizerRails` feature
+  gate (transfer guard on the drain path, per-kernel retrace budgets,
+  donation-after-use poisoning, NaN/inf guards).
+
+`tools/check.py` drives the static half over the repo; the pytest
+wrapper in tests/test_jaxsan.py makes it a tier-1 gate.
+"""
+
+from .findings import Finding, RULES, parse_waivers
+from .jaxsan import JaxsanAnalyzer, analyze_tree
+from .locks import LockChecker
+from .rails import (SanitizerRails, SanitizerError, RetraceBudgetExceeded,
+                    GLOBAL as RAILS)
+
+__all__ = [
+    "Finding", "RULES", "parse_waivers",
+    "JaxsanAnalyzer", "analyze_tree",
+    "LockChecker",
+    "SanitizerRails", "SanitizerError", "RetraceBudgetExceeded", "RAILS",
+]

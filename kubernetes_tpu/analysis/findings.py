@@ -1,0 +1,148 @@
+"""Finding model, rule registry and inline-waiver parsing for jaxsan.
+
+A finding is one (rule, file, line) hazard with a fix-it hint. Rules are
+a closed registry — the fixture self-test in tests/test_jaxsan.py seeds
+one violation per rule class and asserts each is detected, so adding a
+rule here without a fixture is itself a test failure.
+
+Waiver syntax (the inline baseline mechanism `tools/check.py` honors):
+
+    x = int(score_floor)  # jaxsan: waive[traced-branch] host replay path
+
+A waiver comment on the flagged line (or the line directly above, for
+findings on long expressions) suppresses the named rule(s) there;
+`waive[*]` suppresses every rule on that line. Waivers are deliberately
+per-line and per-rule — a file-wide opt-out would rot the moment new
+code lands next to old baselines.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+# rule id → (summary, fix-it hint). The first six are the device-path
+# (traced-region) rules; the last two come from the lock checker.
+RULES: dict[str, tuple[str, str]] = {
+    "traced-branch": (
+        "Python control flow or host cast on a traced value",
+        "use jnp.where/lax.cond/lax.select instead of if/while, and keep "
+        "int()/float()/bool() casts on the host side of the dispatch"),
+    "np-in-jit": (
+        "numpy call inside traced code",
+        "np.* executes at trace time on the host and bakes a constant "
+        "into (or breaks) the compiled program; use jnp.* so the op "
+        "stays on device"),
+    "dynamic-shape": (
+        "array shape derived from a non-static value",
+        "shapes must come from constants, .shape, or static argnums — a "
+        "data-dependent shape re-traces per value (retrace bomb) or "
+        "fails to trace"),
+    "tracer-leak": (
+        "traced value escapes the traced function",
+        "writing a tracer to a global/closure/attribute leaks it past "
+        "the trace; return the value through the function result pytree "
+        "instead"),
+    "donation-after-use": (
+        "donated buffer read after dispatch",
+        "the callee donates this argument's buffers to XLA; reads after "
+        "the call see deleted (or silently reused) memory on accelerator "
+        "backends — rebind the variable to the returned carry"),
+    "nondeterministic-iteration": (
+        "unordered set iteration feeds tensor construction",
+        "set iteration order varies per process and changes trace "
+        "constants / tensor layouts between runs; iterate sorted(...) "
+        "or a list"),
+    "unguarded-shared-state": (
+        "shared attribute accessed outside its declared lock",
+        "this attribute is annotated `# guarded_by: <lock>`; take the "
+        "lock (`with self.<lock>:`) around the access, or mark the "
+        "helper `# jaxsan: holds <lock>` if every caller already "
+        "holds it"),
+    "lock-order-cycle": (
+        "locks acquired in inconsistent order",
+        "two code paths nest these locks in opposite orders — a classic "
+        "deadlock; pick one global order and acquire in it everywhere"),
+}
+
+_WAIVE_RE = re.compile(r"#\s*jaxsan:\s*waive\[([^\]]*)\]")
+_HOLDS_RE = re.compile(r"#\s*jaxsan:\s*holds\s+(\w+)")
+_GUARDED_RE = re.compile(r"#\s*guarded_by:\s*(\w+)")
+
+
+@dataclass
+class Finding:
+    """One hazard at file:line. `waived` findings are kept (so
+    `tools/check.py --list-waivers` can audit the baseline) but do not
+    fail the check."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    func: str = ""          # enclosing function/class qualname
+    hint: str = ""
+    waived: bool = False
+
+    def __post_init__(self) -> None:
+        if not self.hint:
+            self.hint = RULES.get(self.rule, ("", ""))[1]
+
+    def format(self, fix_hints: bool = False) -> str:
+        loc = f"{self.path}:{self.line}"
+        where = f" (in {self.func})" if self.func else ""
+        out = f"{loc}: [{self.rule}] {self.message}{where}"
+        if self.waived:
+            out += "  [waived]"
+        if fix_hints and self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "func": self.func,
+                "hint": self.hint, "waived": self.waived}
+
+
+def parse_waivers(source: str) -> dict[int, set[str]]:
+    """line number (1-based) → waived rule ids (`{"*"}` = all). A waiver
+    comment covers its own line and the line below it, so wrapped
+    expressions can carry the waiver on their first line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _WAIVE_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        for line in (i, i + 1):
+            out.setdefault(line, set()).update(rules)
+    return out
+
+
+def is_waived(waivers: dict[int, set[str]], line: int, rule: str) -> bool:
+    rules = waivers.get(line)
+    return bool(rules) and ("*" in rules or rule in rules)
+
+
+def parse_holds(source_line: str) -> str | None:
+    """`# jaxsan: holds <lock>` on a def line: the method's contract is
+    that every caller already holds <lock> (the lock checker treats the
+    whole body as guarded)."""
+    m = _HOLDS_RE.search(source_line)
+    return m.group(1) if m else None
+
+
+def parse_guarded_by(source_line: str) -> str | None:
+    """`# guarded_by: <lock>` on an attribute assignment."""
+    m = _GUARDED_RE.search(source_line)
+    return m.group(1) if m else None
+
+
+def apply_waivers(findings: list[Finding],
+                  waivers_by_path: dict[str, dict[int, set[str]]]
+                  ) -> list[Finding]:
+    for f in findings:
+        w = waivers_by_path.get(f.path)
+        if w and is_waived(w, f.line, f.rule):
+            f.waived = True
+    return findings

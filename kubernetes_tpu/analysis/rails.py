@@ -1,0 +1,249 @@
+"""Runtime sanitizer rails (`SanitizerRails` feature gate).
+
+The static linter (jaxsan.py) rejects device-path hazards it can see;
+these rails catch the ones only runtime can: an implicit host↔device
+transfer on the steady-state drain path, a shape-churn retrace slipping
+past the ledger, a donated carry silently resurrected by the CPU
+backend's donation no-op, a NaN crawling into the score surface. All
+rails are OFF by default (`SanitizerRails` is an Alpha gate): they exist
+for tests, soaks and staging environments, not the hot path.
+
+The four rails:
+
+- **transfer guard** — the scheduler's `_phase` sub-phase contexts
+  declare the phases where transfers are LEGAL (host_snapshot /
+  host_tensorize / host_group_seed / host_cache / device_readback);
+  `stage()` explicitly `jax.device_put`s the per-dispatch pod rows
+  (device_put is the blessed escape under `jax.transfer_guard`). With
+  rails on, a whole drain runs correctly under an ambient
+  `jax.transfer_guard("disallow")` — the transfer-guard test in
+  tests/test_sanitizer_rails.py holds exactly that.
+- **retrace budget** — `retrace_budget(n)` snapshots the compile
+  ledger's per-kernel compile counts and raises RetraceBudgetExceeded
+  if the block mints more than `n` fresh executables (warm soak ⇒ 0).
+- **donation poisoning** — CPU compiles without donation (ops/program.py
+  run_batch), so a use-after-donate bug is invisible until it corrupts
+  state on a real accelerator. `poison_donated(donated, out)` deletes
+  the donated input's buffers (skipping any buffer aliased by the
+  output) so a later read raises immediately — the runtime twin of the
+  linter's donation-after-use rule.
+- **NaN/inf guard** — `check_scores(...)` runs the score-probe kernel
+  over a drain's first signature row and `assert_finite` raises
+  SanitizerError on any non-finite score; `nan_guard()` additionally
+  scopes `jax.debug_nans` for ad-hoc hunts.
+
+Like the compile ledger, the rails instance is process-global (`GLOBAL`)
+because the jit caches and the transfer-guard config it drives are
+process-global; the scheduler enables it from its feature gate.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+
+class SanitizerError(RuntimeError):
+    """A sanitizer rail tripped (NaN score, poisoned-buffer read, ...)."""
+
+
+class RetraceBudgetExceeded(SanitizerError):
+    """More fresh XLA executables minted than the declared budget."""
+
+
+# drain phases where host↔device transfers are declared/legal — aligned
+# with perf/ledger.py H2D_PHASES plus the pod-row tensorize phase
+DECLARED_PHASES = ("host_snapshot", "host_tensorize", "host_group_seed",
+                   "host_cache", "device_readback")
+
+
+class SanitizerRails:
+    """Feature-gated runtime rails (see module docstring)."""
+
+    def __init__(self, enabled: bool = False):
+        self._enabled = bool(enabled)
+        self.poisoned = 0          # buffers deleted by donation poisoning
+        self.staged_bytes = 0      # bytes explicitly staged by stage()
+
+    # -- gating ---------------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True) -> None:
+        self._enabled = bool(on)
+
+    @contextlib.contextmanager
+    def enabled(self, on: bool = True):
+        """Scoped toggle (test helper)."""
+        prev = self._enabled
+        self._enabled = bool(on)
+        try:
+            yield self
+        finally:
+            self._enabled = prev
+
+    # -- transfer guard -------------------------------------------------------
+
+    def declared(self, phase: str):
+        """Context for a phase where transfers are part of the contract:
+        opens a transfer-guard allow window iff the phase is declared.
+        The scheduler's `_phase` helper calls this with every host
+        sub-phase name; undeclared phases keep the ambient guard."""
+        if not self._enabled or phase not in DECLARED_PHASES:
+            return contextlib.nullcontext()
+        import jax
+        return jax.transfer_guard("allow")
+
+    def guard_dispatch(self):
+        """Disallow implicit transfers for the scope (the device-dispatch
+        region must consume only device-resident inputs)."""
+        if not self._enabled:
+            return contextlib.nullcontext()
+        import jax
+        return jax.transfer_guard("disallow")
+
+    def stage(self, tree):
+        """Explicitly move host-side (numpy) array leaves of a pytree to
+        device. device_put is exempt from the transfer guard by design —
+        staging is the DECLARED way per-dispatch host values reach the
+        device. Device-resident leaves and non-array leaves pass through
+        untouched (static NamedTuple config fields must stay hashable);
+        bytes are attributed to the ledger's host_cache phase like the
+        table upload."""
+        if not self._enabled:
+            return tree
+        import jax
+
+        def put(leaf):
+            if isinstance(leaf, jax.Array) or not hasattr(leaf, "nbytes"):
+                return leaf
+            self.staged_bytes += int(leaf.nbytes)
+            return jax.device_put(leaf)
+
+        before = self.staged_bytes
+        staged = jax.tree_util.tree_map(put, tree)
+        delta = self.staged_bytes - before
+        if delta:
+            from ..perf.ledger import GLOBAL as _ledger
+            _ledger.note_h2d("host_cache", delta)
+        return staged
+
+    # -- retrace budget -------------------------------------------------------
+
+    @contextlib.contextmanager
+    def retrace_budget(self, budget: int = 0,
+                       kernels: Optional[tuple] = None):
+        """Assert at most `budget` fresh compiles happen inside the
+        block (across `kernels`, default all ledger kernels). A warm
+        steady-state drain must fit budget 0 — the no-hidden-retraces
+        invariant the compile ledger documents."""
+        from ..perf.ledger import GLOBAL as ledger
+
+        def counts():
+            return {k: r.compiles for k, r in ledger.kernels.items()
+                    if kernels is None or k in kernels}
+
+        before = counts()
+        yield
+        after = counts()
+        deltas = {k: after[k] - before.get(k, 0)
+                  for k in after if after[k] - before.get(k, 0) > 0}
+        total = sum(deltas.values())
+        if total > budget:
+            raise RetraceBudgetExceeded(
+                f"{total} fresh XLA compiles (budget {budget}): "
+                + ", ".join(f"{k}+{v}" for k, v in sorted(deltas.items())))
+
+    # -- donation poisoning ---------------------------------------------------
+
+    def poison_donated(self, donated, out=None) -> int:
+        """Delete the donated pytree's buffers, simulating donation on
+        backends that compiled without it (CPU). Buffers the output
+        aliases (pass-through leaves) are kept — deleting them would
+        poison live results. Returns buffers deleted."""
+        if not self._enabled or donated is None:
+            return 0
+        import jax
+
+        def pointer(leaf):
+            probe = getattr(leaf, "unsafe_buffer_pointer", None)
+            if probe is None:
+                return None
+            try:
+                return probe()
+            except Exception:   # committed elsewhere / multi-shard
+                return None
+
+        keep = set()
+        if out is not None:
+            for leaf in jax.tree_util.tree_leaves(out):
+                p = pointer(leaf)
+                if p is not None:
+                    keep.add(p)
+        deleted = 0
+        for leaf in jax.tree_util.tree_leaves(donated):
+            delete = getattr(leaf, "delete", None)
+            is_deleted = getattr(leaf, "is_deleted", None)
+            if delete is None or is_deleted is None or is_deleted():
+                continue
+            p = pointer(leaf)
+            if p is not None and p in keep:
+                continue
+            try:
+                delete()
+                deleted += 1
+            except Exception:   # pragma: no cover - backend specific
+                continue
+        self.poisoned += deleted
+        return deleted
+
+    # -- NaN / inf guard ------------------------------------------------------
+
+    def assert_finite(self, name: str, tree) -> None:
+        """Raise SanitizerError if any float leaf holds NaN/inf."""
+        if not self._enabled:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        for leaf in jax.tree_util.tree_leaves(tree):
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+                continue
+            if not bool(jnp.isfinite(leaf).all()):
+                raise SanitizerError(
+                    f"non-finite value in {name} "
+                    f"(dtype {dtype}, shape {getattr(leaf, 'shape', ())})")
+
+    def check_scores(self, cfg, na, carry, table, tidx) -> None:
+        """Probe the score surface of signature row `tidx` against the
+        current carry and raise on NaN/inf. One tiny shape-stable kernel
+        per drain — cheap, and exactly the check no int-typed assignment
+        output can perform for us."""
+        if not self._enabled:
+            return
+        import numpy as np
+        from ..ops.program import score_probe
+
+        score = score_probe(cfg, na, carry, table,
+                            self.stage(np.int32(tidx)))
+        self.assert_finite("score surface", score)
+
+    @contextlib.contextmanager
+    def nan_guard(self):
+        """Scope `jax.debug_nans` (op-level NaN hunt; slow, debug only)."""
+        if not self._enabled:
+            yield
+            return
+        import jax
+        try:
+            ctx = jax.debug_nans(True)
+        except TypeError:   # pragma: no cover - much older jax
+            ctx = contextlib.nullcontext()
+        with ctx:
+            yield
+
+
+GLOBAL = SanitizerRails()

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import enum
 import random
+import threading
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -72,38 +73,45 @@ class APIDispatcher:
     retry_max_delay_seconds: float = 1.0
     sleep: Callable[[float], None] = _time.sleep
     _rng: random.Random = field(default_factory=lambda: random.Random(0))
-    _queue: dict[str, APICall] = field(default_factory=dict)  # uid → pending
+    # the scheduler enqueues and flushes single-threaded, but __len__ is
+    # read by the metrics HTTP thread (dispatcher_inflight callback
+    # gauge): the RLock covers the pending structures; execution happens
+    # on snapshots taken under it (so retry backoff sleeps never block a
+    # scrape), and reentrant on_bind_error callbacks stay safe
+    _lock: threading.RLock = field(default_factory=threading.RLock)
+    _queue: dict[str, APICall] = field(default_factory=dict)   # guarded_by: _lock
     # bulk fast path: (bound pod, the original object it was derived from)
-    _binds: list[tuple[Pod, Pod]] = field(default_factory=list)
+    _binds: list[tuple[Pod, Pod]] = field(default_factory=list)  # guarded_by: _lock
     executed: int = 0
     errors: int = 0
     retries: int = 0
 
     def add(self, call: APICall) -> None:
         uid = call.pod.uid
-        pending = self._queue.get(uid)
-        if pending is not None:
-            if _RELEVANCE[call.call_type] < _RELEVANCE[pending.call_type]:
-                # less relevant than what's queued: suppress. A BIND
-                # suppressed by a pending DELETE carries an assumed pod —
-                # silently dropping it would leak the assume; route it
-                # through the forget/requeue path like a failed bind.
-                if (call.call_type == CallType.BIND
-                        and pending.call_type == CallType.DELETE
-                        and self.on_bind_error is not None):
-                    self.on_bind_error(call.pod, call.node_name, Conflict(
-                        f"bind of {uid} superseded by pending delete"))
-                return
-            if (call.call_type == CallType.STATUS_PATCH
-                    and pending.call_type == CallType.STATUS_PATCH):
-                # merge, don't replace (reference call_queue.go Merge): the
-                # newer condition wins, but an unset nominated_node_name
-                # must not drop the pending call's
-                if call.nominated_node_name is None:
-                    call.nominated_node_name = pending.nominated_node_name
-                if call.condition is None:
-                    call.condition = pending.condition
-        self._queue[uid] = call
+        with self._lock:
+            pending = self._queue.get(uid)
+            if pending is not None:
+                if _RELEVANCE[call.call_type] < _RELEVANCE[pending.call_type]:
+                    # less relevant than what's queued: suppress. A BIND
+                    # suppressed by a pending DELETE carries an assumed pod —
+                    # silently dropping it would leak the assume; route it
+                    # through the forget/requeue path like a failed bind.
+                    if (call.call_type == CallType.BIND
+                            and pending.call_type == CallType.DELETE
+                            and self.on_bind_error is not None):
+                        self.on_bind_error(call.pod, call.node_name, Conflict(
+                            f"bind of {uid} superseded by pending delete"))
+                    return
+                if (call.call_type == CallType.STATUS_PATCH
+                        and pending.call_type == CallType.STATUS_PATCH):
+                    # merge, don't replace (reference call_queue.go Merge):
+                    # the newer condition wins, but an unset
+                    # nominated_node_name must not drop the pending call's
+                    if call.nominated_node_name is None:
+                        call.nominated_node_name = pending.nominated_node_name
+                    if call.condition is None:
+                        call.condition = pending.condition
+            self._queue[uid] = call
 
     def add_binds(self, pairs: list) -> None:
         """Bulk enqueue of bind calls: (assumed pod with node set, the
@@ -111,25 +119,26 @@ class APIDispatcher:
         commit: one list extend instead of B dict transactions. The
         original lets bind_all prove by identity that no interleaved
         update landed, and reuse the assumed copy as the stored object."""
-        if self._queue:
-            # a bind supersedes a pending patch — but never a DELETE,
-            # which outranks it (same relevance ordering as add()). The
-            # superseded pod was already assumed: forget/requeue it
-            # instead of leaking the assume.
-            for pair in pairs:
-                pending = self._queue.get(pair[0].uid)
-                if pending is not None:
-                    if pending.call_type == CallType.DELETE:
-                        if self.on_bind_error is not None:
-                            self.on_bind_error(
-                                pair[0], pair[0].spec.node_name, Conflict(
-                                    f"bind of {pair[0].uid} superseded by "
-                                    "pending delete"))
-                        continue
-                    del self._queue[pair[0].uid]
-                self._binds.append(pair)
-            return
-        self._binds.extend(pairs)
+        with self._lock:
+            if self._queue:
+                # a bind supersedes a pending patch — but never a DELETE,
+                # which outranks it (same relevance ordering as add()). The
+                # superseded pod was already assumed: forget/requeue it
+                # instead of leaking the assume.
+                for pair in pairs:
+                    pending = self._queue.get(pair[0].uid)
+                    if pending is not None:
+                        if pending.call_type == CallType.DELETE:
+                            if self.on_bind_error is not None:
+                                self.on_bind_error(
+                                    pair[0], pair[0].spec.node_name, Conflict(
+                                        f"bind of {pair[0].uid} superseded by "
+                                        "pending delete"))
+                            continue
+                        del self._queue[pair[0].uid]
+                    self._binds.append(pair)
+                return
+            self._binds.extend(pairs)
 
     # -- retry machinery ------------------------------------------------------
 
@@ -198,27 +207,31 @@ class APIDispatcher:
     def flush(self) -> int:
         """Execute all pending calls; returns count executed. Order:
         queued DELETEs (preemption victims) → bulk binds → everything
-        else (single binds, status patches)."""
+        else (single binds, status patches). Calls execute on snapshots
+        taken under the lock — never while holding it (retry backoff
+        sleeps must not block the metrics thread's __len__)."""
         n = 0
-        if self._queue:
+        with self._lock:
             deletes = [c for c in self._queue.values()
                        if c.call_type == CallType.DELETE]
-            if deletes:
-                for c in deletes:
-                    del self._queue[c.pod.uid]
-                n += self._execute_calls(deletes)
+            for c in deletes:
+                del self._queue[c.pod.uid]
+        if deletes:
+            n += self._execute_calls(deletes)
         n += self._flush_bulk_binds()
-        if self._queue:
+        with self._lock:
             calls = list(self._queue.values())
             self._queue.clear()
+        if calls:
             n += self._execute_calls(calls)
         return n
 
     def _flush_bulk_binds(self) -> int:
-        if not self._binds:
+        with self._lock:
+            binds = self._binds
+            self._binds = []
+        if not binds:
             return 0
-        binds = self._binds
-        self._binds = []
         n_bulk = len(binds)
         failures = self._execute_binds(binds)
         n_fail = len(failures)
@@ -264,8 +277,10 @@ class APIDispatcher:
     def is_delete_pending(self, uid: str) -> bool:
         """A victim whose DELETE is queued but not flushed is the in-memory
         analog of a terminating pod (preemption.go:431 eligibility)."""
-        pending = self._queue.get(uid)
+        with self._lock:
+            pending = self._queue.get(uid)
         return pending is not None and pending.call_type == CallType.DELETE
 
     def __len__(self) -> int:
-        return len(self._queue) + len(self._binds)
+        with self._lock:
+            return len(self._queue) + len(self._binds)

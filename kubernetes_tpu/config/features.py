@@ -66,6 +66,12 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # cost per drain phase + signature-cardinality bucket; served at
     # /debug/hostprofile. Off = no sampler thread, no attribution.
     "ContinuousHostProfiling": FeatureSpec(True, BETA),
+    # runtime sanitizer rails (analysis/rails.py): transfer guard on the
+    # drain path (implicit host↔device transfers raise), per-kernel
+    # retrace budgets, donation-after-use poisoning on non-donating
+    # backends, NaN/inf score probes. For tests, soaks and staging —
+    # not the production hot path.
+    "SanitizerRails": FeatureSpec(False, ALPHA),
 }
 
 

@@ -24,6 +24,7 @@ Both are served by the SchedulerServer's /debug endpoints.
 from __future__ import annotations
 
 import itertools
+import threading
 import time as _time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -76,12 +77,17 @@ class EventRecorder:
         self.capacity = capacity
         self.clock = clock
         self.metrics = metrics
-        self._events: "OrderedDict[tuple, Event]" = OrderedDict()
+        # the recorder is written by the scheduling thread and read by
+        # the debug HTTP thread (/debug/events): one lock covers the
+        # ring, the fast-path deque and the counters
+        self._lock = threading.Lock()
+        self._events: "OrderedDict[tuple, Event]" = OrderedDict()  # guarded_by: _lock
         # Scheduled fast path: (object_ref, node_name, timestamp, drain)
         # tuples; message formatting deferred to query time
-        self._scheduled: deque = deque(maxlen=capacity)
-        self.counts: dict[tuple[str, str], int] = {}
-        # the drain whose commit is currently emitting (scheduler-set)
+        self._scheduled: deque = deque(maxlen=capacity)  # guarded_by: _lock
+        self.counts: dict[tuple[str, str], int] = {}     # guarded_by: _lock
+        # the drain whose commit is currently emitting (scheduler-set;
+        # only the scheduling thread reads or writes it)
         self.current_drain = 0
 
     # -- recording ------------------------------------------------------------
@@ -91,28 +97,30 @@ class EventRecorder:
         """Record one event, aggregating with prior identical ones."""
         now = self.clock()
         key = (object_ref, type_, reason, message)
-        ev = self._events.get(key)
-        if ev is not None:
-            ev.count += 1
-            ev.last_timestamp = now
-            ev.drain_id = self.current_drain
-            self._events.move_to_end(key)
-        else:
-            self._events[key] = Event(object_ref=object_ref, type=type_,
-                                      reason=reason, message=message,
-                                      first_timestamp=now,
-                                      last_timestamp=now,
-                                      drain_id=self.current_drain)
-            while len(self._events) > self.capacity:
-                self._events.popitem(last=False)
-        self._count(type_, reason)
+        with self._lock:
+            ev = self._events.get(key)
+            if ev is not None:
+                ev.count += 1
+                ev.last_timestamp = now
+                ev.drain_id = self.current_drain
+                self._events.move_to_end(key)
+            else:
+                self._events[key] = Event(object_ref=object_ref, type=type_,
+                                          reason=reason, message=message,
+                                          first_timestamp=now,
+                                          last_timestamp=now,
+                                          drain_id=self.current_drain)
+                while len(self._events) > self.capacity:
+                    self._events.popitem(last=False)
+            self._count(type_, reason)
 
     def scheduled(self, object_ref: str, node_name: str) -> None:
         """Cheap Scheduled event (per-bind hot path): no string formatting,
         one deque append + one counter bump."""
-        self._scheduled.append((object_ref, node_name, self.clock(),
-                                self.current_drain))
-        self._count(EVENT_NORMAL, REASON_SCHEDULED)
+        with self._lock:
+            self._scheduled.append((object_ref, node_name, self.clock(),
+                                    self.current_drain))
+            self._count(EVENT_NORMAL, REASON_SCHEDULED)
 
     def scheduled_bulk(self, refs_nodes: list, now: Optional[float] = None
                        ) -> None:
@@ -121,11 +129,12 @@ class EventRecorder:
             return
         t = self.clock() if now is None else now
         did = self.current_drain
-        self._scheduled.extend((ref, node, t, did)
-                               for ref, node in refs_nodes)
-        self._count(EVENT_NORMAL, REASON_SCHEDULED, by=len(refs_nodes))
+        with self._lock:
+            self._scheduled.extend((ref, node, t, did)
+                                   for ref, node in refs_nodes)
+            self._count(EVENT_NORMAL, REASON_SCHEDULED, by=len(refs_nodes))
 
-    def _count(self, type_: str, reason: str, by: int = 1) -> None:
+    def _count(self, type_: str, reason: str, by: int = 1) -> None:  # jaxsan: holds _lock
         key = (type_, reason)
         self.counts[key] = self.counts.get(key, 0) + by
         if self.metrics is not None:
@@ -145,8 +154,11 @@ class EventRecorder:
         """Newest-last event list, optionally filtered; Scheduled fast-path
         entries are materialized into full Events here."""
         out: list[Event] = []
+        with self._lock:
+            scheduled = list(self._scheduled)
+            ring = list(self._events.values())
         if reason in (None, REASON_SCHEDULED):
-            for ref, node, t, did in self._scheduled:
+            for ref, node, t, did in scheduled:
                 if object_ref is not None and ref != object_ref:
                     continue
                 out.append(Event(object_ref=ref, type=EVENT_NORMAL,
@@ -154,7 +166,7 @@ class EventRecorder:
                                  message=self.scheduled_message(ref, node),
                                  first_timestamp=t, last_timestamp=t,
                                  drain_id=did))
-        for ev in self._events.values():
+        for ev in ring:
             if reason is not None and ev.reason != reason:
                 continue
             if object_ref is not None and ev.object_ref != object_ref:
@@ -166,8 +178,10 @@ class EventRecorder:
         return out
 
     def dump(self, reason: Optional[str] = None, limit: int = 0) -> dict:
-        return {"counts": {f"{t}/{r}": c
-                           for (t, r), c in sorted(self.counts.items())},
+        with self._lock:
+            counts = {f"{t}/{r}": c
+                      for (t, r), c in sorted(self.counts.items())}
+        return {"counts": counts,
                 "events": [e.to_dict()
                            for e in self.events(reason=reason, limit=limit)]}
 
@@ -218,25 +232,33 @@ class FlightRecord:
 
 
 class FlightRecorder:
-    """Fixed-size ring of per-drain FlightRecords."""
+    """Fixed-size ring of per-drain FlightRecords.
+
+    Written by the scheduling thread at commit time, read by the debug
+    HTTP thread (/debug/flightrecorder, /debug/slowcycles)."""
 
     def __init__(self, capacity: int = 256):
-        self.ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.ring: deque = deque(maxlen=capacity)  # guarded_by: _lock
         self._seq = itertools.count(1)
 
     def record(self, **kw) -> FlightRecord:
         rec = FlightRecord(seq=next(self._seq), wall_time=_time.time(), **kw)
-        self.ring.append(rec)
+        with self._lock:
+            self.ring.append(rec)
         return rec
 
     def dump(self, limit: int = 0) -> list[dict]:
-        records = list(self.ring)
+        with self._lock:
+            records = list(self.ring)
         if limit and len(records) > limit:
             records = records[-limit:]
         return [r.to_dict() for r in records]
 
     def slowest(self, n: int = 16) -> list[dict]:
         """The n slowest recorded drains by total phase time."""
+        with self._lock:
+            records = list(self.ring)
         return [r.to_dict()
-                for r in sorted(self.ring, key=FlightRecord.total_seconds,
+                for r in sorted(records, key=FlightRecord.total_seconds,
                                 reverse=True)[:n]]

@@ -46,6 +46,13 @@ from ..state.tensorize import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
 # through LEDGER.measured_call so fresh compiles/retraces/donation misses
 # are attributed per kernel (scheduler_xla_compiles_total{kernel})
 from ..perf.ledger import GLOBAL as LEDGER
+# sanitizer rails (analysis/rails.py, `SanitizerRails` gate): with rails
+# active, every entry explicitly stages its host-side array args
+# (device_put — the declared escape under jax.transfer_guard) and the
+# donating entries poison the consumed carry on backends that compiled
+# without donation (CPU), so use-after-donate raises here instead of
+# corrupting state on a real accelerator
+from ..analysis.rails import GLOBAL as RAILS
 
 MAX_SCORE = 100
 
@@ -626,10 +633,47 @@ def diagnose_row(na: NodeArrays, table: PodTableDev, tidx: int,
     carry the per-reason detail for DIAG_FIT nodes ("Too many pods" /
     per-column Insufficient)."""
     if gd is not None:
+        na, table, gd, gc = RAILS.stage((na, table, gd, gc))
         return LEDGER.measured_call("diagnose", _diagnose_groups, na, table,
                                     jnp.int32(tidx), gd, gc, fam)
+    na, table = RAILS.stage((na, table))
     return LEDGER.measured_call("diagnose", _diagnose_lean, na, table,
                                 jnp.int32(tidx))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _score_probe_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                     table: PodTableDev, tidx):
+    pod = _gather_row(table, PodXs(valid=jnp.bool_(True), sig=jnp.int32(0),
+                                   tidx=tidx, nom_idx=jnp.int32(-1)))
+    s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
+    # re-derive the FLOAT balanced-allocation intermediates: the int
+    # floor in balanced_allocation() buries a NaN as garbage, so the
+    # probe must observe the std surface before the cast
+    cols = jnp.array(cfg.score_cols, jnp.int32)
+    cap_cols = na.cap[:, cols]
+    used_bal = carry.used[:, cols] + pod.req[cols][None, :]
+    col_ok = cap_cols > 0
+    frac = jnp.where(col_ok,
+                     jnp.minimum(used_bal / jnp.maximum(cap_cols, 1), 1.0),
+                     0.0)
+    cnt = jnp.sum(col_ok, axis=1)
+    mean = jnp.sum(frac, axis=1) / jnp.maximum(cnt, 1)
+    var = (jnp.sum(jnp.where(col_ok, (frac - mean[:, None]) ** 2, 0.0),
+                   axis=1) / jnp.maximum(cnt, 1))
+    std = jnp.sqrt(var)
+    total = (cfg.w_fit * s_fit + cfg.w_balanced * s_bal).astype(jnp.float32)
+    return total, std.astype(jnp.float32)
+
+
+def score_probe(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                table: PodTableDev, tidx):
+    """Score surface of signature row `tidx` against `carry`, in float:
+    (combined fit+balanced score f32 [N], balanced-allocation std f32
+    [N]). The sanitizer rails' NaN/inf probe (analysis/rails.py
+    check_scores) — one tiny shape-stable kernel per drain."""
+    return LEDGER.measured_call("score_probe", _score_probe_jit, cfg, na,
+                                carry, table, tidx)
 
 
 def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
@@ -723,9 +767,14 @@ def run_batch(cfg: ScoreConfig, na: NodeArrays, carry: Carry, pods: PodXs,
     carry_in only for run_uniform records, which do not donate."""
     donate = jax.default_backend() != "cpu"
     fn = _run_batch_fn(donate)
-    return LEDGER.measured_call("run_batch", fn, cfg, na, carry, pods,
-                                table, groups, fam, overlay,
-                                donated=carry if donate else None)
+    na, carry, pods, table, groups, overlay = RAILS.stage(
+        (na, carry, pods, table, groups, overlay))
+    out = LEDGER.measured_call("run_batch", fn, cfg, na, carry, pods,
+                               table, groups, fam, overlay,
+                               donated=carry if donate else None)
+    if not donate:
+        RAILS.poison_donated(carry, out)
+    return out
 
 
 def _uniform_matrix(cfg: ScoreConfig, na: NodeArrays, fit_used, fit_npods,
@@ -926,6 +975,8 @@ def run_uniform(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     """Ledger-instrumented entry for `_run_uniform_jit` (the closed-form
     top-L path; see its docstring for the exactness argument). Never
     donates: the scheduler keeps the input carry for rewind/replay."""
+    na, carry, x, table, n_actual, overlay = RAILS.stage(
+        (na, carry, x, table, n_actual, overlay))
     return LEDGER.measured_call("run_uniform", _run_uniform_jit, cfg, na,
                                 carry, x, table, n_actual, L, K, J,
                                 overlay=overlay)
@@ -1271,10 +1322,15 @@ def run_wave_scan(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs: WaveXs,
     one-slot signature cache."""
     donate = jax.default_backend() != "cpu"
     fn = _run_wave_scan_fn(donate)
-    return LEDGER.measured_call("run_wave_scan", fn, cfg, na, carry, xs,
-                                table, wt, gd, statics, fam, norm_live,
-                                has_groups,
-                                donated=carry if donate else None)
+    na, carry, xs, table, wt, gd, statics = RAILS.stage(
+        (na, carry, xs, table, wt, gd, statics))
+    out = LEDGER.measured_call("run_wave_scan", fn, cfg, na, carry, xs,
+                               table, wt, gd, statics, fam, norm_live,
+                               has_groups,
+                               donated=carry if donate else None)
+    if not donate:
+        RAILS.poison_donated(carry, out)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("feats",))
@@ -1322,6 +1378,7 @@ def _wave_statics_jit(na: NodeArrays, table: PodTableDev, wt,
 def wave_statics(na: NodeArrays, table: PodTableDev, wt,
                  feats: tuple = (True, True, True)):
     """Ledger-instrumented entry for `_wave_statics_jit`."""
+    na, table, wt = RAILS.stage((na, table, wt))
     return LEDGER.measured_call("wave_statics", _wave_statics_jit, na,
                                 table, wt, feats)
 
@@ -1695,10 +1752,15 @@ def run_wave(cfg: ScoreConfig, na: NodeArrays, carry: Carry, valid,
     donate = jax.default_backend() != "cpu"
     fn = _run_wave_same_fn(donate)
     Lw = min(Lw, valid.shape[0])
-    return LEDGER.measured_call("run_wave", fn, cfg, na, carry, valid,
-                                table, wt, gd, statics, K, J, Lw, fam,
-                                norm_live, anti_term, merge_on,
-                                donated=carry if donate else None)
+    na, carry, valid, table, wt, gd, statics = RAILS.stage(
+        (na, carry, valid, table, wt, gd, statics))
+    out = LEDGER.measured_call("run_wave", fn, cfg, na, carry, valid,
+                               table, wt, gd, statics, K, J, Lw, fam,
+                               norm_live, anti_term, merge_on,
+                               donated=carry if donate else None)
+    if not donate:
+        RAILS.poison_donated(carry, out)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1810,6 +1872,9 @@ def dry_run_select_victims(na: NodeArrays, pod: PodRow, cand,
                            victim_req, victim_valid, ovl_used, ovl_npods,
                            spread: DryRunSpread | None = None):
     """Ledger-instrumented entry for `_dry_run_select_victims_jit`."""
+    (na, pod, cand, victim_req, victim_valid, ovl_used, ovl_npods,
+     spread) = RAILS.stage((na, pod, cand, victim_req, victim_valid,
+                            ovl_used, ovl_npods, spread))
     return LEDGER.measured_call("dry_run", _dry_run_select_victims_jit,
                                 na, pod, cand, victim_req, victim_valid,
                                 ovl_used, ovl_npods, spread)

@@ -83,14 +83,17 @@ class HostProfiler:
         self.bucket_fn = bucket_fn
         self._owner_ref = weakref.ref(owner) if owner is not None else None
         self.max_depth = max_depth
-        # per-second aggregation ring: (epoch_second, ProfileAggregate)
-        self._ring: deque[tuple[int, ProfileAggregate]] = deque(
-            maxlen=max(int(window_s), 1))
         self._lock = threading.Lock()
-        self._frame_names: dict[object, str] = {}   # code object → label
+        # per-second aggregation ring: (epoch_second, ProfileAggregate) —
+        # written by the sampler thread, read by the debug HTTP thread
+        self._ring: deque[tuple[int, ProfileAggregate]] = deque(
+            maxlen=max(int(window_s), 1))               # guarded_by: _lock
+        # code object → label memo: sampler-thread-private (built during
+        # the stack walk, before the lock is taken)
+        self._frame_names: dict[object, str] = {}
         self.target_tid: Optional[int] = None
-        self.sample_count = 0
-        self.dropped = 0           # ticks where the target had no frame
+        self.sample_count = 0      # guarded_by: _lock
+        self.dropped = 0           # sampler-thread-private miss counter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # drains slower than this get their top frames pinned onto the

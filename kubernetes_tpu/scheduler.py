@@ -427,6 +427,13 @@ class Scheduler:
                 phase_fn=self.phase_track.current,
                 bucket_fn=(lambda c=cell: c[0]),
                 owner=self)
+        # runtime sanitizer rails (analysis/rails.py): like the compile
+        # ledger, the instance is process-global (the jit caches and the
+        # transfer-guard config it drives are process-global) — the gate
+        # of the most recently constructed Scheduler wins
+        from .analysis.rails import GLOBAL as _rails
+        self.rails = _rails
+        self.rails.enable(self.feature_gates.enabled("SanitizerRails"))
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -1191,12 +1198,30 @@ class Scheduler:
             with self.tracer.span("device_dispatch", pods=n,
                                   groups=groups_needed, drain=did,
                                   batch_bucket=len(segment_batch.valid)) as ds:
-                with self.phase_track.scope("device"):
+                # rails: the dispatch region must consume only
+                # device-resident (or explicitly staged) inputs — implicit
+                # transfers raise here with the SanitizerRails gate on
+                with self.phase_track.scope("device"), \
+                        self.rails.guard_dispatch():
                     carry, records = self._dispatch_runs(
                         profile, na, carry, segment_batch, table, n,
                         groups_needed, ovl=ovl, nom=nom)
+                if self.rails.active and n > 0:
+                    # NaN/inf probe of the drain's first signature row
+                    # against the post-dispatch carry
+                    self.rails.check_scores(
+                        profile.score_config, na, carry, table,
+                        int(segment_batch.tidx[0]))
                 ds.set(runs=",".join(r.kind for r in records))
         except Exception as e:
+            # a sanitizer rail tripping is a finding, not a device fault:
+            # degrading to the host oracle would mask exactly the bug the
+            # rails exist to surface
+            from .analysis.rails import SanitizerError
+            if self.rails.active and (
+                    isinstance(e, SanitizerError)
+                    or "Disallowed host-to-device" in str(e)):
+                raise
             # XLA/dispatch fault: earlier in-flight drains predate the
             # fault and commit normally; THIS drain degrades to the host
             # oracle and the resident carry reseeds on the next dispatch
@@ -1223,7 +1248,10 @@ class Scheduler:
         t0 = _time.perf_counter()
         self.phase_track.push(name)
         try:
-            with self.tracer.span(name, **attrs):
+            # rails.declared opens a transfer-guard allow window for the
+            # host phases whose uploads are part of the drain contract
+            # (no-op with the SanitizerRails gate off)
+            with self.tracer.span(name, **attrs), self.rails.declared(name):
                 yield
         finally:
             self.phase_track.pop()
@@ -1466,23 +1494,28 @@ class Scheduler:
         a = self.state.arrays
         has_taints = a is None or bool(
             ((a.taint_key != 0) & a.valid[:, None]).any())
-        for c0 in range(0, len(missing), 4):
-            chunk = missing[c0:c0 + 4]
-            # pad only to the next pow2 row count — the common one-new-sig
-            # case must not pay the 4-row kernel 4× over
-            S = 1 if len(chunk) == 1 else (2 if len(chunk) == 2 else 4)
-            wts = (chunk + [chunk[-1]] * S)[:S]
-            # feature flags trim wave_statics to the kernels the rows can
-            # actually exercise (an unconstrained signature skips the
-            # padded taint/selector/image broadcasts entirely)
-            feats = (has_taints,
-                     any(bool(t.ns_sel_val[u].any()) or bool(t.aff_has[u])
-                         or bool(t.pref_weight[u].any()) for u in chunk),
-                     any(bool(t.img_containers[u]) for u in chunk))
-            m_, tr, nr, si = wave_statics(
-                na, table, jnp.asarray(np.array(wts, np.int32)), feats)
-            for k, u in enumerate(chunk):
-                self._wave_statics[u] = (m_[k], tr[k], nr[k], si[k])
+        # host cache maintenance that runs lazily inside the dispatch
+        # region: the row-index upload and per-row slice reads are part of
+        # the declared host_cache contract, so open its allow window here
+        # too (no-op with the SanitizerRails gate off)
+        with self.rails.declared("host_cache"):
+            for c0 in range(0, len(missing), 4):
+                chunk = missing[c0:c0 + 4]
+                # pad only to the next pow2 row count — the common
+                # one-new-sig case must not pay the 4-row kernel 4× over
+                S = 1 if len(chunk) == 1 else (2 if len(chunk) == 2 else 4)
+                wts = (chunk + [chunk[-1]] * S)[:S]
+                # feature flags trim wave_statics to the kernels the rows
+                # can actually exercise (an unconstrained signature skips
+                # the padded taint/selector/image broadcasts entirely)
+                feats = (has_taints,
+                         any(bool(t.ns_sel_val[u].any()) or bool(t.aff_has[u])
+                             or bool(t.pref_weight[u].any()) for u in chunk),
+                         any(bool(t.img_containers[u]) for u in chunk))
+                m_, tr, nr, si = wave_statics(
+                    na, table, jnp.asarray(np.array(wts, np.int32)), feats)
+                for k, u in enumerate(chunk):
+                    self._wave_statics[u] = (m_[k], tr[k], nr[k], si[k])
         return [self._wave_statics[u] for u in rows]
 
     def _wave_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,

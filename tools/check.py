@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""jaxsan driver: run the device-path linter + lock checker over the repo.
+
+    python tools/check.py                 # lint, exit 0 iff clean
+    python tools/check.py --fix-hints     # include fix-it hints
+    python tools/check.py --list-waivers  # audit the waiver baseline
+    python tools/check.py --json          # machine-readable findings
+
+Exit codes: 0 = no unwaived findings; 1 = findings; 2 = configuration
+error (a declared JIT entry point no longer reaches a jitted function —
+the lint silently lost device-path coverage).
+
+The same analysis runs in tier-1 via tests/test_jaxsan.py, so CI fails
+on any unwaived finding; this CLI is the local/fix-up loop. Waiver
+syntax (see kubernetes_tpu/analysis/findings.py):
+
+    risky_line()  # jaxsan: waive[rule-id] why this is safe here
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def run_check(root: str = _REPO, package: str = "kubernetes_tpu",
+              entry_points=None):
+    """Returns (all findings, analyzer) — import surface for the pytest
+    wrapper."""
+    from kubernetes_tpu.analysis.findings import apply_waivers, parse_waivers
+    from kubernetes_tpu.analysis.jaxsan import JaxsanAnalyzer
+    from kubernetes_tpu.analysis.locks import LockChecker
+
+    an = JaxsanAnalyzer(root, package=package,
+                        entry_points=entry_points).load()
+    findings = an.run()
+    findings.extend(LockChecker(an.modules).run())
+    waivers = {mi.path: parse_waivers(mi.source)
+               for mi in an.modules.values()}
+    apply_waivers(findings, waivers)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, an
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_REPO)
+    ap.add_argument("--package", default="kubernetes_tpu")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--fix-hints", action="store_true",
+                    help="print a fix-it hint under every finding")
+    ap.add_argument("--list-waivers", action="store_true",
+                    help="also print findings suppressed by inline waivers")
+    ap.add_argument("--entries", action="append", default=None,
+                    metavar="MOD:NAME,NAME",
+                    help="override the JIT entry points (repeatable); "
+                         "default: the eight kubernetes_tpu entries")
+    args = ap.parse_args(argv)
+
+    entry_points = None
+    if args.entries:
+        entry_points = {}
+        for spec in args.entries:
+            mod, _, names = spec.partition(":")
+            entry_points[mod] = tuple(n for n in names.split(",") if n)
+
+    findings, an = run_check(args.root, args.package, entry_points)
+    live = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in live],
+            "waived": [f.to_dict() for f in waived],
+            "missingEntries": an.missing_entries,
+            "modules": len(an.modules),
+            "tracedFunctions": sum(1 for fi in an.fns.values()
+                                   if fi.traced),
+        }, indent=2))
+    else:
+        for f in live:
+            print(f.format(fix_hints=args.fix_hints))
+        if args.list_waivers:
+            for f in waived:
+                print(f.format(fix_hints=False))
+        print(f"jaxsan: {len(an.modules)} modules, "
+              f"{sum(1 for fi in an.fns.values() if fi.traced)} traced "
+              f"functions, {len(live)} findings "
+              f"({len(waived)} waived)")
+
+    if an.missing_entries:
+        print("jaxsan: CONFIG ERROR — entries without jit coverage: "
+              + ", ".join(an.missing_entries), file=sys.stderr)
+        return 2
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
