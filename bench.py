@@ -58,6 +58,13 @@ CASES = [
     # the SchedulingBasic floor (the stream being scheduled THROUGH the
     # pending nominations is plain pods)
     ("PreemptionChurn", "5000Nodes_10000Pods", "500Nodes", 270.0),
+    # gang workload suite (ISSUE 7 / ROADMAP item 3): trace-driven LLM
+    # training gangs solved as one all-or-nothing device dispatch each,
+    # and co-located inference + training with gang-on-gang preemption.
+    # No reference workloads exist; vs_baseline reuses the SchedulingBasic
+    # floor (gang members are plain pods)
+    ("GangTraining", "5000Nodes", "500Nodes", 270.0),
+    ("CoLocatedInference", "5000Nodes", "500Nodes", 270.0),
 ]
 
 # PreemptionChurn's preemptor wave is the createPods op at this template

@@ -6,7 +6,7 @@ syntactic — `if x:` is fine on the host and a trace-time crash (or a
 silently baked-in constant) on a traced value; `np.zeros(n)` is fine in
 `build_dev` and a retrace bomb inside `_run_batch_impl`. Telling the two
 apart requires (a) knowing WHICH functions execute under `jax.jit` — the
-call-graph closure of the jitted impls behind the eight public entries
+call-graph closure of the jitted impls behind the nine public entries
 (run_batch, run_uniform, run_wave, run_wave_scan, wave_statics,
 diagnose_row, dry_run_select_victims, run_batch_sharded; the same set the
 compile ledger wraps) — and (b) knowing WHICH values are traced inside
@@ -48,13 +48,14 @@ from dataclasses import dataclass, field
 
 from .findings import Finding, RULES
 
-# the eight public JIT entries (perf/ledger.py KERNELS wraps the same
+# the nine public JIT entries (perf/ledger.py KERNELS wraps the same
 # set); tools/check.py asserts each one resolves to at least one
 # discovered jit root, so the lint cannot silently lose coverage
 ENTRY_POINTS = {
     "kubernetes_tpu.ops.program": (
         "run_batch", "run_uniform", "run_wave", "run_wave_scan",
         "wave_statics", "diagnose_row", "dry_run_select_victims"),
+    "kubernetes_tpu.ops.gang": ("run_gang",),
     "kubernetes_tpu.parallel.sharding": ("run_batch_sharded",),
 }
 
@@ -66,6 +67,7 @@ DONATING_ENTRIES = {
     "run_batch": (2, "carry"),
     "run_wave": (2, "carry"),
     "run_wave_scan": (2, "carry"),
+    "run_gang": (2, "carry"),
 }
 
 # attribute reads that always yield host-static values, even on tracers

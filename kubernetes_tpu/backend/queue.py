@@ -223,6 +223,11 @@ class SchedulingQueue:
         self.backoff_q = _Heap(self._backoff_less)
         self.unschedulable_pods: dict[str, QueuedPodInfo] = {}
         self.unschedulable_since: dict[str, float] = {}
+        # gated gang members indexed by workload ref: a member-pod event
+        # re-runs PreEnqueue for THAT gang's gated members only (the
+        # retry_gated(ref=...) fast path) instead of sweeping every gated
+        # pod in the cluster
+        self.gated_by_ref: dict[str, set[str]] = {}
         self.nominator = Nominator()
 
         self.scheduling_cycle = 0
@@ -256,6 +261,27 @@ class SchedulingQueue:
     def _is_backing_off(self, qpi: QueuedPodInfo) -> bool:
         return self._backoff_expiry(qpi) > self.clock()
 
+    # -- gated-gang index ------------------------------------------------------
+
+    def _index_gated(self, pod: Pod) -> None:
+        ref = pod.spec.workload_ref
+        if ref:
+            self.gated_by_ref.setdefault(ref, set()).add(pod.uid)
+
+    def _unindex_gated(self, pod: Pod) -> None:
+        ref = pod.spec.workload_ref
+        if not ref:
+            return
+        uids = self.gated_by_ref.get(ref)
+        if uids is not None:
+            uids.discard(pod.uid)
+            if not uids:
+                del self.gated_by_ref[ref]
+
+    def gated_refs(self) -> set:
+        """Workload refs that currently have gated members."""
+        return set(self.gated_by_ref)
+
     # -- add paths -----------------------------------------------------------
 
     def add(self, pod: Pod) -> None:
@@ -283,6 +309,7 @@ class SchedulingQueue:
                     qpi.gating_plugin = status.plugin
                     self.unschedulable_pods[pod.uid] = qpi
                     self.unschedulable_since[pod.uid] = now
+                    self._index_gated(pod)
                     gated += 1
                     continue
             active_add(pod.uid, qpi)
@@ -298,6 +325,7 @@ class SchedulingQueue:
                 qpi.gating_plugin = status.plugin
                 self.unschedulable_pods[qpi.pod.uid] = qpi
                 self.unschedulable_since[qpi.pod.uid] = self.clock()
+                self._index_gated(qpi.pod)
                 return
         qpi.gated = False
         self.active_q.add(qpi.pod.uid, qpi)
@@ -314,8 +342,10 @@ class SchedulingQueue:
                 return
         existing = self.unschedulable_pods.get(uid)
         if existing is not None:
-            existing.pod_info = PodInfo.of(new)
             was_gated = existing.gated
+            if was_gated:
+                self._unindex_gated(existing.pod)
+            existing.pod_info = PodInfo.of(new)
             # updated pods get re-evaluated (scheduling_queue.go Update:
             # spec change may make it schedulable)
             del self.unschedulable_pods[uid]
@@ -335,7 +365,9 @@ class SchedulingQueue:
         uid = pod.uid
         self.active_q.delete(uid)
         self.backoff_q.delete(uid)
-        self.unschedulable_pods.pop(uid, None)
+        gone = self.unschedulable_pods.pop(uid, None)
+        if gone is not None and gone.gated:
+            self._unindex_gated(gone.pod)
         self.unschedulable_since.pop(uid, None)
         self.nominator.delete(pod)
 
@@ -394,6 +426,8 @@ class SchedulingQueue:
             self.unschedulable_pods.pop(pod.uid, None)
             self.unschedulable_since.pop(pod.uid, None)
             self.backoff_q.delete(pod.uid)
+            if qpi.gated:
+                self._unindex_gated(qpi.pod)
             qpi.gated = False
             self.active_q.add(pod.uid, qpi)
             self.nominator.add(qpi)
@@ -494,19 +528,30 @@ class SchedulingQueue:
     def gated_pods_could_be_ungated(self) -> list[QueuedPodInfo]:
         return [q for q in self.unschedulable_pods.values() if q.gated]
 
-    def retry_gated(self, predicate=None) -> int:
+    def retry_gated(self, predicate=None, ref: Optional[str] = None) -> int:
         """Re-runs PreEnqueue for gated pods (the reference re-evaluates on
-        pod-update events; we expose an explicit sweep too). `predicate`
-        narrows the sweep to the pods an event could actually un-gate —
-        e.g. only one gang's members on a member-pod add."""
+        pod-update events; we expose an explicit sweep too). `ref` narrows
+        the sweep to ONE gang's gated members via the gated_by_ref index
+        (O(gang) on a member-pod add, not O(all gated pods)); `predicate`
+        is the general filter for everything else."""
+        if ref is not None:
+            uids = self.gated_by_ref.get(ref)
+            if not uids:
+                return 0
+            candidates = [(uid, self.unschedulable_pods[uid])
+                          for uid in list(uids)
+                          if uid in self.unschedulable_pods]
+        else:
+            candidates = list(self.unschedulable_pods.items())
         moved = 0
-        for uid, qpi in list(self.unschedulable_pods.items()):
+        for uid, qpi in candidates:
             if not qpi.gated:
                 continue
             if predicate is not None and not predicate(qpi.pod):
                 continue
             del self.unschedulable_pods[uid]
             self.unschedulable_since.pop(uid, None)
+            self._unindex_gated(qpi.pod)
             self._add_qpi(qpi)
             if not qpi.gated:
                 moved += 1

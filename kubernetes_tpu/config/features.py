@@ -42,6 +42,13 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     "SchedulerAsyncAPICalls": FeatureSpec(True, BETA),
     # Workload / gang scheduling API (kube_features.go:338)
     "GenericWorkload": FeatureSpec(True, ALPHA),
+    # whole-gang all-or-nothing assignment as one device dispatch
+    # (ops/gang.py run_gang): once PreEnqueue quorum is met, the gang is
+    # solved atomically — accept commits without Reserve/Permit churn,
+    # reject unwinds on device. Off = gangs ride the per-pod path with
+    # the reference's Permit-barrier dance (members park holding assumed
+    # resources until quorum or timeout).
+    "GangDevicePlacement": FeatureSpec(True, BETA),
     # queueing hints consulted on requeue (SchedulerQueueingHint)
     "SchedulerQueueingHints": FeatureSpec(True, BETA),
     # nodedeclaredfeatures plugin

@@ -349,6 +349,18 @@ class SchedulerMetrics:
             "Conflict-free prefix length accepted by the first wave of "
             "each group drain.",
             buckets=[1, 4, 16, 64, 256, 1024, 4096]))
+        self.gang_dispatch = r.register(Counter(
+            n + "gang_dispatch_total",
+            "Whole-gang device dispatches by outcome: placed (all-or-"
+            "nothing accept committed), rejected (quorum infeasible, "
+            "unwound on device), fallback (gang degraded to the serial "
+            "Permit-barrier host path).",
+            ("outcome",)))
+        self.gang_quorum_wait = r.register(Histogram(
+            n + "gang_quorum_wait_seconds",
+            "Time a gang's members spent PreEnqueue-gated before quorum "
+            "was met (first gated member to un-gate).",
+            buckets=exponential_buckets(0.001, 4, 12)))
         self.drain_phase = r.register(Histogram(
             n + "drain_phase_seconds",
             "Per-drain wall time by phase: host_build (snapshot + batch "
@@ -401,6 +413,9 @@ class SchedulerMetrics:
                        "circuit_open"):
             self.device_fallbacks.inc(reason, by=0)
         self.resyncs.inc(by=0)
+        for outcome in ("placed", "rejected", "fallback"):
+            self.gang_dispatch.inc(outcome, by=0)
+        self.gang_quorum_wait.seed()
         self.wave_placement_waves.inc(by=0)
         self.wave_conflict_ratio.seed()
         self.wave_accepted_prefix.seed()

@@ -846,10 +846,9 @@ def _uniform_matrix(cfg: ScoreConfig, na: NodeArrays, fit_used, fit_npods,
     return fit_kj, s_fit_kj, s_bal_kj
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
-def _run_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
-                     table: PodTableDev, n_actual, L: int, K: int, J: int,
-                     overlay=None):
+def _uniform_core(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
+                  table: PodTableDev, n_actual, L: int, K: int, J: int,
+                  overlay=None):
     """Closed-form batch assignment for a run of SAME-SIGNATURE pods — the
     top-k trick of reference runtime/batch.go:97 (sortedNodes.Pop) taken to
     its TPU limit: the whole run becomes ONE top_k instead of L scan steps.
@@ -957,6 +956,15 @@ def _run_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
         s_bal=parts.s_bal.at[cand].set(s_bal_kj[ar, cnt_i]))
     new_carry = carry._replace(used=used, nonzero_used=nonzero, npods=npods,
                                cache=new_cache)
+    return new_carry, assignments, mono_ok & norm_ok, depth_ok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "L", "K", "J"))
+def _run_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
+                     table: PodTableDev, n_actual, L: int, K: int, J: int,
+                     overlay=None):
+    new_carry, assignments, ok, depth_ok = _uniform_core(
+        cfg, na, carry, x, table, n_actual, L, K, J, overlay)
     # pack [assignments; exact; depth] into ONE i32[L+2]: the tunneled-TPU
     # cost model is dominated by device→host round trips (~100ms each once
     # the first readback forces synchronous mode), so a run must cost the
@@ -965,7 +973,7 @@ def _run_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry, x: PodXs,
     # otherwise); packed[L+1] = depth sufficed (escalate J otherwise).
     packed = jnp.concatenate([
         assignments,
-        jnp.stack([mono_ok & norm_ok, depth_ok]).astype(jnp.int32)])
+        jnp.stack([ok, depth_ok]).astype(jnp.int32)])
     return new_carry, packed
 
 

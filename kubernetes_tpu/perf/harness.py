@@ -312,6 +312,63 @@ class WorkloadRunner:
                 if col:
                     col.end(sched.scheduled_count)
                     items.append(col.item(f"{tc.name}/{wl.name}"))
+            elif code == "gangTrace":
+                # trace-driven gang traffic (testing/workloads.py): LLM
+                # training gangs + co-located inference + gangs-preempt-
+                # gangs, streamed in arrival chunks like createPods
+                from ..testing.workloads import GangWorkloadGenerator
+                gen = GangWorkloadGenerator(
+                    seed=int(op.get("seed", params.get("seed", 0))))
+                gangs = int(_resolve(op, "gangs", params, 0))
+                gang_size = _resolve(op, "gangSize", params, None)
+                size = (int(gang_size) if gang_size is not None
+                        else (int(op.get("gangSizeMin", 8)),
+                              int(op.get("gangSizeMax", 512))))
+                specs = gen.training_gangs(
+                    gangs, size=size,
+                    min_count_frac=float(op.get("minCountFrac", 1.0)),
+                    cpu=op.get("gangCpu", "900m"),
+                    memory=op.get("gangMemory", "1Gi"),
+                    priority=int(op.get("gangPriority", 0)))
+                pre_specs = gen.training_gangs(
+                    int(_resolve(op, "preemptorGangs", params, 0)),
+                    size=int(op.get("preemptorSize", 8)),
+                    cpu=op.get("preemptorCpu", "900m"),
+                    memory=op.get("gangMemory", "1Gi"),
+                    priority=int(op.get("preemptorPriority", 200)),
+                    prefix="preemptor")
+                contig = op.get("contiguityWeight",
+                                params.get("contiguityWeight"))
+                if contig is not None:
+                    sched.gang_contiguity_weight = int(contig)
+                collect = op.get("collectMetrics", False)
+                col = ThroughputCollector() if collect else None
+                if col:
+                    col.begin(sched.scheduled_count)
+                create_batch = int(op.get("createBatch", self.create_batch))
+                for kind, obj in gen.trace(
+                        specs,
+                        inference_count=int(
+                            _resolve(op, "inferencePods", params, 0)),
+                        inference_cpu=op.get("inferenceCpu", "250m"),
+                        inference_priority=int(
+                            op.get("inferencePriority", 100)),
+                        preemptor_gangs=pre_specs,
+                        chunk=create_batch):
+                    if kind == "workload":
+                        api.create_workload(obj)
+                        continue
+                    api.create_pods(obj)
+                    sched.schedule_pending(wait=False)
+                    if col:
+                        col.sample(sched.scheduled_count)
+                    if verbose:
+                        print(f"  gangTrace: scheduled="
+                              f"{sched.scheduled_count}")
+                sched.schedule_pending()
+                if col:
+                    col.end(sched.scheduled_count)
+                    items.append(col.item(f"{tc.name}/{wl.name}"))
             elif code == "createWorkloads":
                 from ..api.types import ObjectMeta, PodGroup, Workload
                 count = int(_resolve(op, "count", params, 1))

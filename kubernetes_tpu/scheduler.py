@@ -233,6 +233,12 @@ class _PendingDrain:
     # monotonic drain id: correlates this drain's log lines, spans,
     # FlightRecorder entry and Scheduled/FailedScheduling events
     drain_id: int = 0
+    # whole-gang drain (ops/gang.py): (workload ref, remaining quorum,
+    # minCount) when this drain is one gang solved all-or-nothing
+    gang: object = None
+    gang_accepted: bool = False
+    gang_raw: object = None      # raw per-member assignments (pre-unwind)
+    gang_placed: int = 0
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -438,6 +444,23 @@ class Scheduler:
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
         self._waiting_pods: dict[str, _WaitingPodRec] = {}
+        # gang device placement (ops/gang.py run_gang): whole pod groups
+        # solved as ONE all-or-nothing device dispatch once PreEnqueue
+        # quorum is met — no Reserve/Permit/Unreserve churn on either the
+        # accept or the reject path. Ineligible gangs (host-fallback
+        # signatures, group constraints, pending nominations, parked
+        # members) keep the reference's Permit-barrier host path.
+        self.gang_device_enabled = (
+            self.feature_gates.enabled("GenericWorkload")
+            and self.feature_gates.enabled("GangDevicePlacement"))
+        # Tesserae-style topology-contiguous slice packing: weight of the
+        # per-domain member-count score column in the gang scan (0 = off,
+        # keeping gang placements bit-identical to the serial oracle)
+        self.gang_contiguity_weight = 0
+        self._gang_dom = None        # device i32[N] node→domain ids
+        self._gang_dom_key = (-1, -1)  # (staging_gen, node bucket) it fits
+        # first-gated time per workload ref → gang_quorum_wait_seconds
+        self._gang_gated_since: dict[str, float] = {}
         # hand every GangScheduling plugin its Handle (this Scheduler)
         from .plugins.gangscheduling import GangScheduling
         for prof in self.profiles.values():
@@ -732,22 +755,34 @@ class Scheduler:
             self.metrics.queue_incoming_pods.inc(
                 "gated" if gated else "active", "PodAdd")
             if pod.spec.workload_ref:
-                # a new gang member can un-gate ITS group (PreEnqueue
-                # quorum); other gangs' quorums are unaffected
                 ref = pod.spec.workload_ref
-                self.queue.retry_gated(
-                    predicate=lambda p: p.spec.workload_ref == ref)
+                if gated:
+                    self._gang_gated_since.setdefault(ref, self.clock())
+                # a new gang member can un-gate ITS group (PreEnqueue
+                # quorum); other gangs' quorums are unaffected — and only
+                # once the group can actually reach quorum (below
+                # minCount the retry cannot move anything, so a 512-pod
+                # gang's ingest skips 511 pointless sweeps)
+                if self._gang_quorum_possible(pod):
+                    self.queue.retry_gated(ref=ref)
+                    self._observe_quorum_waits()
 
     def _on_pod_add_bulk(self, pods: list[Pod]) -> None:
         """Batch ingest (create_pods fan-out): plain unbound pods owned by
-        this scheduler take the queue's bulk add; anything else — bound,
-        gang-labeled, foreign schedulerName — falls back to the per-pod
-        path, preserving its semantics exactly."""
+        this scheduler take the queue's bulk add; bound or foreign pods
+        fall back to the per-pod path. Gang members also bulk-add, but
+        their WorkloadManager registration happens FIRST for the whole
+        chunk — a gang arriving complete in one chunk then passes
+        PreEnqueue quorum at its own add (no gate → un-gate churn at
+        all), and the quorum retry runs once per gang, not per member."""
         plain: list[Pod] = []
+        gang_pods: list[Pod] = []
         for pod in pods:
-            if (pod.spec.node_name or pod.spec.workload_ref
-                    or not self._responsible(pod)):
+            if pod.spec.node_name or not self._responsible(pod):
                 self._on_pod_add(pod)
+            elif pod.spec.workload_ref:
+                self.workload_manager.add_pod(pod)
+                gang_pods.append(pod)
             else:
                 self.workload_manager.add_pod(pod)
                 plain.append(pod)
@@ -757,6 +792,25 @@ class Scheduler:
                                                  by=len(plain) - n)
             if n:
                 self.metrics.queue_incoming_pods.inc("gated", "PodAdd", by=n)
+        if gang_pods:
+            n = self.queue.add_bulk(gang_pods)
+            self.metrics.queue_incoming_pods.inc("active", "PodAdd",
+                                                 by=len(gang_pods) - n)
+            if n:
+                self.metrics.queue_incoming_pods.inc("gated", "PodAdd",
+                                                     by=n)
+            now = self.clock()
+            refs = dict.fromkeys(p.spec.workload_ref for p in gang_pods)
+            gated_refs = self.queue.gated_refs() if n else set()
+            for ref in refs:
+                if ref in gated_refs:
+                    self._gang_gated_since.setdefault(ref, now)
+            for ref in refs:
+                member = next(p for p in gang_pods
+                              if p.spec.workload_ref == ref)
+                if self._gang_quorum_possible(member):
+                    self.queue.retry_gated(ref=ref)
+            self._observe_quorum_waits()
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
         self.workload_manager.update_pod(old, new)
@@ -831,11 +885,46 @@ class Scheduler:
 
     def _on_workload_add(self, workload) -> None:
         """A Workload's arrival can un-gate its gang's pods (PreEnqueue)
-        and requeue unschedulable members (gangscheduling.go:100)."""
-        self.queue.retry_gated()
+        and requeue unschedulable members (gangscheduling.go:100). Only
+        the arriving workload's refs are re-evaluated (gated_by_ref
+        index) — other gangs' quorums are unaffected by this event."""
+        from .backend.workloadmanager import parse_workload_ref
+        name = workload.metadata.name
+        for ref in self.queue.gated_refs():
+            if parse_workload_ref(ref)[0] == name:
+                self.queue.retry_gated(ref=ref)
+        self._observe_quorum_waits()
         self.queue.move_all_to_active_or_backoff_queue(
             ClusterEvent(EventResource.WORKLOAD, ActionType.ADD),
             None, workload)
+
+    def _gang_quorum_possible(self, pod: Pod) -> bool:
+        """True when the pod's group has reached its minCount in KNOWN
+        pods — the only state in which a gated-member retry can move
+        anything (PreEnqueue quorum, gangscheduling.go:120-158)."""
+        from .backend.workloadmanager import (parse_workload_ref,
+                                              pod_group_min_count)
+        name, group = parse_workload_ref(pod.spec.workload_ref)
+        workload = self.client.get_workload(name)
+        if workload is None:
+            return False
+        min_count = pod_group_min_count(workload, group)
+        if min_count is None:
+            return False
+        info = self.workload_manager.pod_group_info(pod)
+        return info is not None and len(info.all_pods) >= min_count
+
+    def _observe_quorum_waits(self) -> None:
+        """Record gang_quorum_wait_seconds for every gang whose gated
+        members just cleared (quorum met → retry_gated moved them)."""
+        if not self._gang_gated_since:
+            return
+        live = self.queue.gated_refs()
+        for ref in list(self._gang_gated_since):
+            if ref not in live:
+                self.metrics.gang_quorum_wait.observe(
+                    max(self.clock() - self._gang_gated_since.pop(ref),
+                        0.0))
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -972,14 +1061,21 @@ class Scheduler:
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0
                        for q in qpis)
+        bound = 0
+        gangs, qpis = self._extract_gangs(qpis)
+        for members, ref, needed, min_count in gangs:
+            # whole pod group → ONE all-or-nothing device dispatch
+            bound += self._dispatch_device_drain(
+                members, profile, gang=(ref, needed, min_count))
+        if not qpis:
+            return bound
         pods = [q.pod for q in qpis]
         batch = self.builder.build(pods, pad_to=self.batch_size)
         if not batch.host_fallback.any():
             # common case: whole drain is device-eligible; reuse this build
-            return self._dispatch_device_drain(qpis, profile,
-                                               prebuilt=batch)
+            return bound + self._dispatch_device_drain(qpis, profile,
+                                                       prebuilt=batch)
         fallback = batch.host_fallback
-        bound = 0
         i = 0
         while i < len(qpis):
             if fallback[i]:
@@ -998,8 +1094,64 @@ class Scheduler:
             i = j
         return bound
 
+    def _extract_gangs(self, qpis: list[QueuedPodInfo]):
+        """Partition a profile stretch into whole-gang drains and the
+        rest. A gang is extracted when the drain holds at least its
+        remaining quorum of members and the group is device-eligible
+        (gates on, single device, no parked members, no volumes/claims —
+        the hook chain the atomic commit bypasses must be vacuous).
+        Ineligible gangs stay in the generic flow: per-pod placement with
+        the reference's Permit-barrier dance at commit."""
+        if (not self.gang_device_enabled or self.mesh is not None
+                or self.queue.nominator.nominated_pods
+                or not any(q.pod.spec.workload_ref for q in qpis)):
+            return [], qpis
+        from .backend.workloadmanager import (parse_workload_ref,
+                                              pod_group_min_count)
+        groups: dict[str, list] = {}
+        order: list[str] = []
+        rest: list[QueuedPodInfo] = []
+        for q in qpis:
+            ref = q.pod.spec.workload_ref
+            if ref:
+                if ref not in groups:
+                    groups[ref] = []
+                    order.append(ref)
+                groups[ref].append(q)
+            else:
+                rest.append(q)
+        out = []
+        for ref in order:
+            members = groups[ref]
+            name, group = parse_workload_ref(ref)
+            workload = self.client.get_workload(name)
+            min_count = (pod_group_min_count(workload, group)
+                         if workload is not None else None)
+            if min_count is None:
+                rest.extend(members)
+                continue
+            info = self.workload_manager.pod_group_info(members[0].pod)
+            assigned = len(info.assigned) if info is not None else 0
+            needed = max(min_count - assigned, 0)
+            if needed == 0:
+                # quorum already satisfied by bound members: the surplus
+                # members schedule individually (Permit passes instantly)
+                rest.extend(members)
+                continue
+            if (len(members) < needed
+                    or any(m.pod.uid in self._waiting_pods
+                           for m in members)
+                    or any(m.pod.spec.volumes or m.pod.spec.resource_claims
+                           for m in members)):
+                self.metrics.gang_dispatch.inc("fallback")
+                rest.extend(members)
+                continue
+            out.append((members, ref, needed, min_count))
+        return out, rest
+
     def _dispatch_device_drain(self, qpis: list[QueuedPodInfo],
-                               profile: Profile, prebuilt=None) -> int:
+                               profile: Profile, prebuilt=None,
+                               gang=None) -> int:
         """Build + dispatch one drain's device programs WITHOUT waiting for
         the results; appends a _PendingDrain whose commit happens when the
         async host copies land. Returns binds committed inside this call
@@ -1014,6 +1166,8 @@ class Scheduler:
             # cooldown expires; the host oracle takes the drain
             self.device_fallbacks += 1
             self.metrics.device_fallbacks.inc("circuit_open")
+            if gang is not None:
+                self.metrics.gang_dispatch.inc("fallback")
             self.flight.record(
                 profile=profile.name, pods=len(qpis), bound=0, failed=0,
                 signatures=0, kinds=(), groups=False, phases={},
@@ -1024,10 +1178,10 @@ class Scheduler:
 
         with log_context(drain=did):
             return self._dispatch_device_drain_inner(qpis, profile, prebuilt,
-                                                     t_entry, did)
+                                                     t_entry, did, gang)
 
     def _dispatch_device_drain_inner(self, qpis, profile, prebuilt,
-                                     t_entry, did):
+                                     t_entry, did, gang=None):
         from .ops.groups import scatter_new_rows, to_device
 
         ph: dict[str, float] = {}
@@ -1069,6 +1223,8 @@ class Scheduler:
                 # state moved between routing and segment build (e.g. a node
                 # update surfaced images): honor queue order and let the
                 # oracle take the segment
+                if gang is not None:
+                    self.metrics.gang_dispatch.inc("fallback")
                 self._drain_pending()
                 return sum(1 if self._schedule_one_host(q) else 0
                            for q in qpis)
@@ -1082,6 +1238,15 @@ class Scheduler:
                 or bool(self.snapshot.have_pods_with_affinity_list)
                 or bool(
                     self.snapshot.have_pods_with_required_anti_affinity_list))
+            if gang is not None and (
+                    groups_needed
+                    or (segment_batch.sig[:len(qpis)] == 0).any()
+                    or not segment_batch.valid[:len(qpis)].all()):
+                # group kernels / host-port signatures are outside the gang
+                # program: this gang rides the generic path instead (per-pod
+                # placement + the Permit barrier at commit)
+                self.metrics.gang_dispatch.inc("fallback")
+                gang = None
             if groups_needed and self._classify_wave(segment_batch,
                                                      len(qpis)) is None:
                 # host greedy is the FALLBACK tier for group drains the wave
@@ -1183,6 +1348,10 @@ class Scheduler:
                                    for q in qpis)
                     ovl = self._build_overlay(na)
                     nom = self._nominated_rows(qpis)
+                    if gang is not None:
+                        # the overlay two-pass is outside the gang program
+                        self.metrics.gang_dispatch.inc("fallback")
+                        gang = None
         t0 = _time.perf_counter()
         self.metrics.drain_phase.observe(max(t0 - t_entry, 0.0),
                                          "host_build")
@@ -1205,7 +1374,8 @@ class Scheduler:
                         self.rails.guard_dispatch():
                     carry, records = self._dispatch_runs(
                         profile, na, carry, segment_batch, table, n,
-                        groups_needed, ovl=ovl, nom=nom)
+                        groups_needed, ovl=ovl, nom=nom,
+                        gang=(gang[1] if gang is not None else None))
                 if self.rails.active and n > 0:
                     # NaN/inf probe of the drain's first signature row
                     # against the post-dispatch carry
@@ -1226,6 +1396,8 @@ class Scheduler:
             # fault and commit normally; THIS drain degrades to the host
             # oracle and the resident carry reseeds on the next dispatch
             self._record_device_fault("dispatch", e)
+            if gang is not None:
+                self.metrics.gang_dispatch.inc("fallback")
             self._drain_pending()
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
         ph["device_dispatch"] = _time.perf_counter() - t0
@@ -1237,7 +1409,8 @@ class Scheduler:
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
-            dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did))
+            dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did,
+            gang=gang))
         return 0
 
     @contextmanager
@@ -1582,8 +1755,91 @@ class Scheduler:
             statics, fam, norm_live, has_groups=has_groups)
         return carry2, packed, bucket
 
+    # -- gang placement (whole-group all-or-nothing dispatch) ------------------
+
+    def _gang_domains(self, na, need: bool):
+        """Device i32[N] topology-domain id per node row for the gang
+        contiguity column: the node's interned zone label, or a unique
+        per-node domain when unlabeled (contiguity then has no surface to
+        prefer). Cached until node state moves; identity ids when the
+        contiguity weight is off (the kernel never reads them)."""
+        key = (self.state.staging_gen, na.used.shape[0])
+        if self._gang_dom is not None and self._gang_dom_key == key:
+            return self._gang_dom
+        N = na.used.shape[0]
+        dom = np.arange(N, dtype=np.int32)
+        if need:
+            ids: dict[str, int] = {}
+            for name, idx in self.state.node_index.items():
+                if idx >= N:
+                    continue
+                ni = self.snapshot.get(name)
+                labels = (ni.node.metadata.labels if ni is not None else {})
+                zone = (labels.get("topology.kubernetes.io/zone")
+                        or f"\x00{idx}")
+                dom[idx] = ids.setdefault(zone, len(ids))
+        self._gang_dom = jnp.asarray(dom)
+        self._gang_dom_key = key
+        return self._gang_dom
+
+    def _gang_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
+                       j: int, table, span, force_scan: bool = False):
+        """Dispatch ops/gang.py run_gang over members [i:j). Returns
+        (carry', packed, pack_width, uniform_tier). A single-signature
+        gang under LeastAllocated rides the closed-form top-L tier (the
+        whole gang is one top_k); anything else — mixed member roles, a
+        live contiguity column, MostAllocated, preferred surfaces — takes
+        the scan tier with per-signature surfaces hoisted once."""
+        from .ops.gang import GangXs, run_gang
+
+        _, needed = span
+        m = j - i
+        w_contig = int(self.gang_contiguity_weight)
+        tid = batch.tidx[i:j]
+        uniq = list(dict.fromkeys(int(t) for t in tid))
+        # gang-sized matrix, not the full batch bucket: a 256-member gang
+        # must not pay an 8192-wide top-L. Gang sizes quantize to pow2, so
+        # the executable count stays log-bounded per workload.
+        L = pow2_at_least(m, 16)
+        K = min(L, na.cap.shape[0])
+        n_q = pow2_at_least(max(self.cache.node_count(), 1))
+        J = min(max(pow2_at_least(4 * L // n_q + 4), 8), L + 1)
+        if (not force_scan and len(uniq) == 1 and w_contig == 0 and m <= L
+                and cfg.strategy == "LeastAllocated"
+                and self.feature_gates.enabled("OpportunisticBatching")
+                and not self._cluster_has_prefer_taints()
+                and not self.builder.table.pref_weight[uniq[0]].any()):
+            c2, packed = run_gang(cfg, na, carry, self._xone(batch, i),
+                                  table, needed=np.int32(needed),
+                                  uniform=True, n_actual=np.int32(m),
+                                  L=L, K=K, J=J)
+            return c2, packed, L, True
+        bucket = pow2_at_least(m)
+        S = pow2_at_least(len(uniq), 1)
+        wt_list = (uniq + [uniq[-1]] * S)[:S]
+        slot: dict = {}
+        for s, u in enumerate(wt_list):
+            slot.setdefault(u, s)
+        widx = np.zeros((bucket,), np.int32)
+        for k in range(m):
+            widx[k] = slot[int(tid[k])]
+        widx[m:] = widx[m - 1]
+        tidx = np.full((bucket,), tid[m - 1], np.int32)
+        tidx[:m] = tid
+        valid = np.zeros((bucket,), bool)
+        valid[:m] = batch.valid[i:j]
+        xs = GangXs(valid=jnp.asarray(valid), tidx=jnp.asarray(tidx),
+                    widx=jnp.asarray(widx))
+        dom = self._gang_domains(na, need=w_contig > 0)
+        c2, packed = run_gang(
+            cfg, na, carry, xs, table,
+            wt=jnp.asarray(np.array(wt_list, np.int32)),
+            needed=np.int32(needed), dom=dom, w_contig=w_contig)
+        return c2, packed, bucket, False
+
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
-                       n: int, groups_needed: bool, ovl=None, nom=None):
+                       n: int, groups_needed: bool, ovl=None, nom=None,
+                       gang=None):
         """Dispatch the drain through the fastest exact program with ZERO
         host synchronization — results stream back asynchronously and the
         carry chains device-side.
@@ -1598,6 +1854,11 @@ class Scheduler:
         BalancedAllocation non-monotonicity, depth-J overflow) can rewind
         and replay. Returns (chain carry, [_RunRec])."""
         cfg = profile.score_config
+        if gang is not None:
+            # whole-gang drain: ONE all-or-nothing dispatch (ops/gang.py);
+            # `gang` is the remaining quorum (minCount - assigned members)
+            return self._dispatch_spans(cfg, na, batch, table,
+                                        [(0, n, ("gang", int(gang)))], carry)
         if groups_needed and ovl is None and nom is None:
             wave = self._classify_wave(batch, n)
             if wave is not None:
@@ -1681,6 +1942,13 @@ class Scheduler:
                     cfg, na, carry, batch, i, j, table, kind)
                 records.append(_RunRec("wavescan", i, j, None, packed,
                                        bucket, span=kind))
+            elif tag == "gang":
+                c2, packed, Lp, uni = self._gang_dispatch(
+                    cfg, na, carry, batch, i, j, table, kind)
+                # the uniform tier keeps its input carry (exactness-flag
+                # replay on the scan tier); the scan tier donates it
+                records.append(_RunRec("gang", i, j, carry if uni else None,
+                                       packed, Lp, uniform=uni, span=kind))
             else:
                 c2, assigns = self._scan_dispatch(cfg, na, carry, batch,
                                                   i, j, table, ovl=ovl,
@@ -1744,6 +2012,9 @@ class Scheduler:
         victims = [pd, *self._pending]
         self._pending.clear()
         for d in victims:
+            if d.gang is not None:
+                # the gang degrades to the serial Permit-barrier path
+                self.metrics.gang_dispatch.inc("fallback")
             for q in d.qpis:
                 self._schedule_one_host(q)
 
@@ -1763,6 +2034,7 @@ class Scheduler:
         self.snapshot = Snapshot()
         self.queue = SchedulingQueue(**self._queue_kwargs)
         self.workload_manager = WorkloadManager(clock=self.clock)
+        self._gang_gated_since.clear()
         from .backend.debugger import CacheDebugger
         self.debugger = CacheDebugger(self.client, self.cache, self.queue,
                                       metrics=self.metrics)
@@ -1858,6 +2130,29 @@ class Scheduler:
                 self._observe_wave(rec, r, m, pd)
                 idx += 1
                 continue
+            if rec.kind == "gang":
+                Lp = rec.L
+                if not (r[Lp + 2] and r[Lp + 3]):
+                    # the closed-form tier's exactness preconditions
+                    # failed on the data: replay on the scan tier from
+                    # the kept input carry and re-chain downstream
+                    cfg = pd.profile.score_config
+                    carry, packed, Lp, _ = self._gang_dispatch(
+                        cfg, pd.na, rec.carry_in, pd.batch, rec.i, rec.j,
+                        pd.table, rec.span, force_scan=True)
+                    r = np.asarray(packed)
+                    _ledger.note_h2d("device_readback", r.nbytes)
+                    self._replay_downstream(pd, idx, carry)
+                accepted = bool(r[Lp])
+                raw = np.array(r[:m], np.int32)
+                pd.gang_accepted = accepted
+                pd.gang_raw = raw
+                pd.gang_placed = int(r[Lp + 1])
+                # the all-or-nothing verdict: a rejected gang was already
+                # unwound ON DEVICE — the host only masks the assignments
+                out[rec.i:rec.j] = raw if accepted else np.int32(-1)
+                idx += 1
+                continue
             exact, depth = bool(r[rec.L]), bool(r[rec.L + 1])
             if exact and depth:
                 out[rec.i:rec.j] = r[:m]
@@ -1875,29 +2170,35 @@ class Scheduler:
                                                rec.i, rec.j, pd.table,
                                                ovl=pd.ovl, nom=pd.nom)
                 out[rec.i:rec.j] = np.asarray(a)[:m]
-            # re-dispatch the rest of this drain ...
-            spans = [(q.i, q.j, q.span) for q in pd.records[idx + 1:]]
-            carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
-                                                   pd.table, spans, carry,
-                                                   ovl=pd.ovl, nom=pd.nom)
-            pd.records[idx + 1:] = new_recs
-            # ... and every later pending drain, against the new chain. A
-            # profile OR overlay change between drains invalidates the sig
-            # cache, mirroring the dispatch-site checks.
-            prev_profile = pd.profile
-            prev_ovl = pd.ovl
-            for pd2 in self._pending:
-                if pd2.profile is not prev_profile or pd2.ovl is not prev_ovl:
-                    carry = carry._replace(
-                        cache=carry.cache._replace(sig=jnp.int32(0)))
-                    prev_profile = pd2.profile
-                    prev_ovl = pd2.ovl
-                carry, pd2.records = self._dispatch_runs(
-                    pd2.profile, pd2.na, carry, pd2.batch, pd2.table,
-                    pd2.n, pd2.groups_needed, ovl=pd2.ovl, nom=pd2.nom)
-            if self._device_carry is not None:
-                self._device_carry = carry
+            self._replay_downstream(pd, idx, carry)
             idx += 1
+
+    def _replay_downstream(self, pd: "_PendingDrain", idx: int,
+                           carry) -> None:
+        """Re-dispatch everything chained after record `idx`: the rest of
+        this drain's spans, then every later pending drain, against the
+        corrected carry. A profile OR overlay change between drains
+        invalidates the sig cache, mirroring the dispatch-site checks."""
+        cfg = pd.profile.score_config
+        spans = [(q.i, q.j, q.span) for q in pd.records[idx + 1:]]
+        carry, new_recs = self._dispatch_spans(cfg, pd.na, pd.batch,
+                                               pd.table, spans, carry,
+                                               ovl=pd.ovl, nom=pd.nom)
+        pd.records[idx + 1:] = new_recs
+        prev_profile = pd.profile
+        prev_ovl = pd.ovl
+        for pd2 in self._pending:
+            if pd2.profile is not prev_profile or pd2.ovl is not prev_ovl:
+                carry = carry._replace(
+                    cache=carry.cache._replace(sig=jnp.int32(0)))
+                prev_profile = pd2.profile
+                prev_ovl = pd2.ovl
+            carry, pd2.records = self._dispatch_runs(
+                pd2.profile, pd2.na, carry, pd2.batch, pd2.table,
+                pd2.n, pd2.groups_needed, ovl=pd2.ovl, nom=pd2.nom,
+                gang=(pd2.gang[1] if pd2.gang is not None else None))
+        if self._device_carry is not None:
+            self._device_carry = carry
 
     def _observe_wave(self, rec: _RunRec, r, m: int,
                       pd: Optional["_PendingDrain"] = None) -> None:
@@ -1965,13 +2266,18 @@ class Scheduler:
         bound = 0
         diag_cache: dict = {}
         failures: list[QueuedPodInfo] = []
+        # an accepted gang commits atomically through the fast path: the
+        # quorum the Permit barrier would enforce per pod was already
+        # proven by the device verdict, so the Reserve/Permit chain is
+        # vacuous (members with volumes/claims never reach a gang drain)
+        gang_fast = pd.gang is not None and pd.gang_accepted
         for i in range(n):
             a = out[i]
             qpi = qpis[i]
             if a < 0:
                 failures.append(qpi)
                 continue
-            if _needs_per_pod_hooks(profile, qpi.pod.spec):
+            if not gang_fast and _needs_per_pod_hooks(profile, qpi.pod.spec):
                 self._assume_and_bind(qpi, names[int(a)])
                 bound += 1
             else:
@@ -1986,12 +2292,18 @@ class Scheduler:
         for p in fwk.score_plugins:
             self.metrics.plugin_evaluation_total.inc(
                 p.name(), "Score", profile.name, by=n)
+        if pd.gang is not None:
+            self.metrics.gang_dispatch.inc(
+                "placed" if pd.gang_accepted else "rejected")
         if failures:
             # diagnosis reads the live snapshot (assumes included)
             self.cache.update_snapshot(self.snapshot)
-            for qpi in failures:
-                err = self._device_fit_error(qpi, profile, diag_cache)
-                self._handle_failure(qpi, err)
+            if pd.gang is not None and not pd.gang_accepted:
+                self._fail_rejected_gang(pd, qpis, diag_cache)
+            else:
+                for qpi in failures:
+                    err = self._device_fit_error(qpi, profile, diag_cache)
+                    self._handle_failure(qpi, err)
         commit_s = max(_time.perf_counter() - t_commit, 0.0)
         self.metrics.drain_phase.observe(commit_s, "commit")
         pd.phases["commit"] = pd.phases.get("commit", 0.0) + commit_s
@@ -2024,6 +2336,79 @@ class Scheduler:
                 klog.v(5).info("unschedulable", pod=qpi.pod.uid,
                                plugins=sorted(qpi.unschedulable_plugins))
         return bound
+
+    def _fail_rejected_gang(self, pd: _PendingDrain, qpis: list,
+                            diag_cache: dict) -> None:
+        """All-or-nothing rejection commit: no member binds, none ever
+        reserved — the Permit-barrier's partial-failure churn (Reserve →
+        park → timeout → Unreserve) collapses to straight failure
+        handling. Members with NO feasible node fail with the device mask
+        diagnosis (reference-format reasons histogram; preemption runs —
+        this is how a higher-priority gang preempts a lower one), while
+        members whose placement the quorum verdict unwound fail with the
+        gang reason and no preemption (the analog of a Permit rejection,
+        which never runs PostFilter)."""
+        from .framework.types import Diagnosis
+        profile = pd.profile
+        ref, _needed, min_count = pd.gang
+        raw = pd.gang_raw
+        # the infeasible members' rejector plugins become the whole gang's
+        # requeue triggers: the cluster event that could fix the stuck
+        # member is exactly the event that un-sticks the gang
+        plugins: set = {"GangScheduling"}
+        infeasible: list = []
+        unwound: list = []
+        names = self.state.node_names
+        for i, qpi in enumerate(qpis):
+            if raw is not None and i < len(raw) and raw[i] >= 0:
+                unwound.append((qpi, names[int(raw[i])]))
+            else:
+                infeasible.append(qpi)
+        # Diagnose the infeasible members against the state the serial
+        # oracle would have seen: the unwound members' placements
+        # TEMPORARILY assumed (parked members hold resources there), so
+        # the reasons histogram reads "2 Insufficient cpu", not "cluster
+        # empty". The assumes are forgotten before any failure handling —
+        # preemption must never see the phantom members as victims.
+        errs: list = []
+        if infeasible:
+            temp: list = []
+            for qpi, node_name in unwound:
+                pi = PodInfo(pod=qpi.pod.with_node_name(node_name),
+                             requests=qpi.pod_info.requests,
+                             cpu_nonzero=qpi.pod_info.cpu_nonzero,
+                             mem_nonzero=qpi.pod_info.mem_nonzero)
+                try:
+                    self.cache.assume_pod_info(pi)
+                    temp.append(pi.pod)
+                except KeyError:
+                    pass
+            try:
+                self.cache.update_snapshot(self.snapshot)
+                for qpi in infeasible:
+                    errs.append(self._device_fit_error(qpi, profile,
+                                                       diag_cache))
+            finally:
+                for pod in temp:
+                    try:
+                        self.cache.forget_pod(pod)
+                    except (KeyError, ValueError):
+                        pass
+                self.cache.update_snapshot(self.snapshot)
+                # the diagnosis context refreshed the staging arrays with
+                # the phantom members in them: restore the real truth
+                self.state.apply_snapshot(self.snapshot)
+        for qpi, err in zip(infeasible, errs):
+            plugins |= err.diagnosis.unschedulable_plugins
+            self._handle_failure(qpi, err)
+        n_nodes = len(self.snapshot.node_info_list)
+        msg = (f"gang {ref!r} rejected: {pd.gang_placed} of {min_count} "
+               f"required members placeable")
+        for qpi, _node in unwound:
+            err = FitError(qpi.pod, n_nodes)
+            err.diagnosis = Diagnosis(unschedulable_plugins=set(plugins),
+                                      pre_filter_msg=msg)
+            self._handle_failure(qpi, err, try_preempt=False)
 
     def _fast_commit(self, pairs: list, profile: Profile) -> int:
         """Vectorized commit for hook-free pods: the per-pod work of

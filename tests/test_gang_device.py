@@ -1,0 +1,498 @@
+"""Gang placement engine (ISSUE 7): whole-gang all-or-nothing device
+dispatch vs the serial Permit-barrier oracle.
+
+The standing gates this file establishes:
+
+- **Fuzzed parity**: for seeded random clusters + gangs, the device gang
+  verdict (accept/reject) AND the accepted placements are identical to
+  the serial Permit-barrier path (GangDevicePlacement off), including
+  min-count-not-met and partial-feasibility rejection; the closed-form
+  uniform tier and the scan tier agree with each other on the same
+  scenarios.
+- **Atomicity**: a rejected gang binds nothing, parks nothing and holds
+  no resources; an accepted gang binds in ONE device dispatch
+  (FlightRecorder run_kind=gang, zero Permit waits).
+- **Gang-preempts-gang**: a higher-priority gang rejected on a full
+  cluster preempts a lower-priority gang's members and lands, with the
+  same end state as the serial path.
+- **Chaos**: seeded API faults leave gang assignments identical to the
+  fault-free run (the ISSUE 2 gate extended to gang drains).
+- **Queue index**: a member-pod event re-runs PreEnqueue only for that
+  gang's gated members (queue.gated_by_ref satellite).
+"""
+
+import random
+
+from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.chaos import ChaosAPIServer, ChaosConfig
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _sched(api, device_gangs=True, batch_size=64, contig=0):
+    clock = Clock()
+    s = Scheduler(api, batch_size=batch_size, clock=clock)
+    s.dispatcher.sleep = lambda _s: None
+    s._clock = clock
+    if contig:
+        s.gang_contiguity_weight = contig
+    if not device_gangs:
+        s.feature_gates.set("GangDevicePlacement", False)
+        s.gang_device_enabled = False
+    return s
+
+
+def _workload(api, name, min_count):
+    api.create_workload(Workload(metadata=ObjectMeta(name=name),
+                                 pod_groups=[PodGroup(name="workers",
+                                                      min_count=min_count)]))
+
+
+def _gang(api, name, size, min_count, cpu="1", priority=0):
+    _workload(api, name, min_count)
+    for i in range(size):
+        api.create_pod(make_pod(f"{name}-{i}")
+                       .req({"cpu": cpu, "memory": "1Gi"})
+                       .workload(name).priority(priority).obj())
+
+
+def _assignments(api):
+    inner = getattr(api, "inner", api)
+    return {uid: p.spec.node_name for uid, p in inner.pods.items()}
+
+
+def _settle(api, sched, rounds=6):
+    """Drive to a fixed point: expired gang deadlines sweep, backoffs and
+    unschedulable leftovers flush, rejected gangs re-attempt."""
+    sched.schedule_pending()
+    for _ in range(rounds):
+        sched._clock.t += 400.0
+        sched.flush_queues()
+        sched.schedule_pending()
+
+
+# ---------------------------------------------------------------------------
+# atomicity + observability
+
+
+class TestGangDeviceBasics:
+    def test_accept_is_one_dispatch_no_permit(self):
+        api = APIServer()
+        for i in range(8):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _gang(api, "train", size=12, min_count=12)
+        assert sched.schedule_pending() == 12
+        gang_drains = [r for r in sched.flight.dump()
+                       if "gang" in r["kinds"]]
+        assert len(gang_drains) == 1 and gang_drains[0]["bound"] == 12
+        assert sched.metrics.gang_dispatch.value("placed") == 1.0
+        # zero Permit waits on the accept path
+        assert sched.metrics.permit_wait_duration.count("allowed") == 0
+        assert sched.metrics.permit_wait_duration.count("rejected") == 0
+        assert not sched._waiting_pods
+
+    def test_reject_is_atomic_and_holds_nothing(self):
+        api = APIServer()
+        for i in range(2):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _gang(api, "train", size=3, min_count=3)
+        assert sched.schedule_pending() == 0
+        assert api.binding_count == 0
+        assert not sched._waiting_pods
+        assert not sched.cache.assumed_pods
+        assert sched.metrics.gang_dispatch.value("rejected") == 1.0
+        # the FailedScheduling surface: infeasible members carry the
+        # reference-format reasons histogram; unwound members the gang
+        # verdict
+        msgs = [e.message for e in sched.events.events(
+            reason="FailedScheduling")]
+        assert any("nodes are available" in m and "Insufficient" in m
+                   for m in msgs), msgs
+        assert any("gang 'train' rejected" in m for m in msgs), msgs
+        # freed capacity is immediately usable
+        api.create_pod(make_pod("plain").req(
+            {"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 1
+
+    def test_min_count_partial_accept(self):
+        """size 5, minCount 3, capacity 3: the gang lands (3 bind), the
+        two surplus members fail individually — all in one dispatch."""
+        api = APIServer()
+        for i in range(3):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _gang(api, "train", size=5, min_count=3)
+        assert sched.schedule_pending() == 3
+        assert sched.metrics.gang_dispatch.value("placed") == 1.0
+        bound = [u for u, n in _assignments(api).items() if n]
+        assert len(bound) == 3
+
+    def test_quorum_wait_metric(self):
+        api = APIServer()
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _workload(api, "train", min_count=3)
+        api.create_pod(make_pod("train-0").req(
+            {"cpu": "1", "memory": "1Gi"}).workload("train").obj())
+        sched._clock.t += 2.0
+        api.create_pod(make_pod("train-1").req(
+            {"cpu": "1", "memory": "1Gi"}).workload("train").obj())
+        assert sched.metrics.gang_quorum_wait.count() == 0
+        sched._clock.t += 3.0
+        api.create_pod(make_pod("train-2").req(
+            {"cpu": "1", "memory": "1Gi"}).workload("train").obj())
+        assert sched.metrics.gang_quorum_wait.count() == 1
+        assert abs(sched.metrics.gang_quorum_wait.sum() - 5.0) < 1e-6
+
+    def test_host_port_gang_falls_back_and_still_binds(self):
+        """A gang whose members carry host ports (sig 0) degrades to the
+        Permit-barrier path — and still binds there."""
+        api = APIServer()
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _workload(api, "svc", min_count=3)
+        for i in range(3):
+            api.create_pod(make_pod(f"svc-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("svc").host_port(8000 + i).obj())
+        assert sched.schedule_pending() == 3
+        assert sched.metrics.gang_dispatch.value("fallback") >= 1.0
+        assert sched.metrics.gang_dispatch.value("placed") == 0.0
+
+    def test_contiguity_packs_topology_domains(self):
+        """Tesserae-style packing: with the contiguity column live, a
+        gang concentrates into fewer zones than the balance-driven
+        default spreads it across."""
+        def build(contig):
+            api = APIServer()
+            for i in range(16):
+                api.create_node(make_node(f"n{i}")
+                                .capacity({"cpu": 2, "memory": "32Gi",
+                                           "pods": 110})
+                                .zone(f"z{i % 4}").obj())
+            sched = _sched(api, contig=contig)
+            _gang(api, "train", size=8, min_count=8)
+            assert sched.schedule_pending() == 8
+            zones = set()
+            for uid, node in _assignments(api).items():
+                if node and uid.endswith(tuple(f"-{k}" for k in range(8))):
+                    zones.add(int(node[1:]) % 4)
+            return zones
+        spread_zones = build(0)
+        packed_zones = build(8)
+        assert len(packed_zones) < len(spread_zones)
+        # one zone (4 nodes × 2 cpu) holds all 8 members: perfect packing
+        assert len(packed_zones) == 1
+
+
+class TestGangSanitizerRails:
+    def test_gang_drain_under_transfer_guard(self):
+        """Both run_gang tiers are staged-entry clean: a gang drain
+        completes under ambient jax.transfer_guard('disallow') with the
+        SanitizerRails gate on and zero device fallbacks."""
+        import jax
+        for contig in (0, 2):   # closed-form tier, then scan tier
+            api = APIServer()
+            sched = _sched(api, contig=contig)
+            sched.rails.enable(True)
+            try:
+                for i in range(8):
+                    api.create_node(make_node(f"n{i}").capacity(
+                        {"cpu": 8, "memory": "32Gi", "pods": 110})
+                        .zone(f"z{i % 2}").obj())
+                _gang(api, "g", size=6, min_count=6)
+                with jax.transfer_guard("disallow"):
+                    assert sched.schedule_pending() == 6
+                assert sched.device_fallbacks == 0
+                assert sched.metrics.gang_dispatch.value("placed") == 1.0
+            finally:
+                sched.rails.enable(False)
+
+
+# ---------------------------------------------------------------------------
+# queue satellite: gated-gang index
+
+
+class TestGatedGangIndex:
+    def _counting(self, sched):
+        calls = []
+        inner = sched.queue.pre_enqueue
+
+        def counted(pod):
+            calls.append(pod.uid)
+            return inner(pod)
+        sched.queue.pre_enqueue = counted
+        return calls
+
+    def test_member_event_reevaluates_only_its_gang(self):
+        api = APIServer()
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        # two gangs below quorum: both fully gated
+        _workload(api, "a", min_count=3)
+        _workload(api, "b", min_count=3)
+        for i in range(2):
+            api.create_pod(make_pod(f"a-{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).workload("a").obj())
+        for i in range(2):
+            api.create_pod(make_pod(f"b-{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).workload("b").obj())
+        assert sched.queue.gated_refs() == {"a", "b"}
+        calls = self._counting(sched)
+        # a's quorum-completing member must re-run PreEnqueue for a's
+        # gated members ONLY — b's stay untouched
+        api.create_pod(make_pod("a-2").req(
+            {"cpu": "1", "memory": "1Gi"}).workload("a").obj())
+        assert not any(uid.startswith("default/b-") for uid in calls), calls
+        assert sched.queue.gated_refs() == {"b"}
+        assert sched.schedule_pending() == 3
+
+    def test_index_cleared_on_delete(self):
+        api = APIServer()
+        sched = _sched(api)
+        _workload(api, "a", min_count=2)
+        api.create_pod(make_pod("a-0").req(
+            {"cpu": "1", "memory": "1Gi"}).workload("a").obj())
+        assert sched.queue.gated_refs() == {"a"}
+        api.delete_pod("default/a-0")
+        assert sched.queue.gated_refs() == set()
+
+
+# ---------------------------------------------------------------------------
+# fuzzed parity vs the serial Permit-barrier oracle
+
+
+def _fuzz_scenario(rng):
+    """One seeded scenario: cluster + pre-bound fillers + gangs."""
+    n_nodes = rng.randint(3, 16)
+    cpu = rng.randint(2, 8)
+    nodes = [(f"n{i}", cpu) for i in range(n_nodes)]
+    bound = []
+    for i in range(rng.randint(0, n_nodes)):
+        node = rng.randrange(n_nodes)
+        bound.append((f"pre-{i}", f"n{node}", rng.randint(1, max(cpu // 2, 1))))
+    gangs = []
+    for g in range(rng.randint(1, 3)):
+        size = rng.randint(2, 8)
+        min_count = rng.randint(1, size)
+        gangs.append((f"gang{g}", size, min_count, rng.randint(1, 3)))
+    return nodes, bound, gangs
+
+
+def _run_fuzz(nodes, bound, gangs, device_gangs, uniform=True):
+    api = APIServer()
+    for name, cpu in nodes:
+        api.create_node(make_node(name).capacity(
+            {"cpu": cpu, "memory": "64Gi", "pods": 110}).obj())
+    sched = _sched(api, device_gangs=device_gangs)
+    if not uniform:
+        # force the scan tier (the closed-form tier needs the gate)
+        sched.feature_gates.set("OpportunisticBatching", False)
+    for name, node, cpu in bound:
+        api.create_pod(make_pod(name).req(
+            {"cpu": cpu, "memory": "1Gi"}).node(node).obj())
+    for name, size, min_count, cpu in gangs:
+        _gang(api, name, size=size, min_count=min_count, cpu=str(cpu))
+    _settle(api, sched)
+    return api, sched
+
+
+class TestGangParityFuzz:
+    def test_single_gang_parity(self):
+        """Device verdict + placements == serial Permit-barrier oracle,
+        per seeded scenario with one gang (min-count-not-met and
+        partial-feasibility rejection included by construction)."""
+        mismatches = []
+        rejects = accepts = 0
+        for seed in range(40):
+            rng = random.Random(1000 + seed)
+            nodes, bound, gangs = _fuzz_scenario(rng)
+            gangs = gangs[:1]
+            dev_api, dev = _run_fuzz(nodes, bound, gangs, device_gangs=True)
+            host_api, _ = _run_fuzz(nodes, bound, gangs, device_gangs=False)
+            a, b = _assignments(dev_api), _assignments(host_api)
+            if a != b:
+                mismatches.append((seed, a, b))
+            if dev.metrics.gang_dispatch.value("rejected"):
+                rejects += 1
+            if dev.metrics.gang_dispatch.value("placed"):
+                accepts += 1
+        assert not mismatches, mismatches[:3]
+        # the fuzz must actually exercise both verdicts
+        assert rejects >= 3 and accepts >= 10, (rejects, accepts)
+
+    def test_uniform_and_scan_tiers_agree(self):
+        """The closed-form tier and the scan tier are the same function:
+        identical verdicts and placements on every scenario."""
+        for seed in range(20):
+            rng = random.Random(2000 + seed)
+            nodes, bound, gangs = _fuzz_scenario(rng)
+            u_api, _ = _run_fuzz(nodes, bound, gangs, device_gangs=True,
+                                 uniform=True)
+            s_api, _ = _run_fuzz(nodes, bound, gangs, device_gangs=True,
+                                 uniform=False)
+            assert _assignments(u_api) == _assignments(s_api), seed
+
+    def test_multi_gang_decisions_match(self):
+        """Several gangs per scenario: per-gang accept/reject decisions
+        match the serial oracle; when every gang lands in both runs the
+        placements match exactly."""
+        for seed in range(25):
+            rng = random.Random(3000 + seed)
+            nodes, bound, gangs = _fuzz_scenario(rng)
+            dev_api, dev = _run_fuzz(nodes, bound, gangs, device_gangs=True)
+            host_api, _ = _run_fuzz(nodes, bound, gangs, device_gangs=False)
+            a, b = _assignments(dev_api), _assignments(host_api)
+            bound_a = {u for u, n in a.items() if n}
+            bound_b = {u for u, n in b.items() if n}
+            for name, size, min_count, _cpu in gangs:
+                landed_a = sum(1 for u in bound_a
+                               if u.startswith(f"default/{name}-"))
+                landed_b = sum(1 for u in bound_b
+                               if u.startswith(f"default/{name}-"))
+                assert (landed_a >= min_count) == (landed_b >= min_count), \
+                    (seed, name, landed_a, landed_b)
+            if bound_a == bound_b and len(bound_a) == sum(
+                    g[1] for g in gangs) + len(bound):
+                assert a == b, seed
+
+
+# ---------------------------------------------------------------------------
+# gang preempts gang
+
+
+class TestGangPreemptsGang:
+    def _scenario(self, device_gangs):
+        api = APIServer()
+        for i in range(3):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 4, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api, device_gangs=device_gangs)
+        # low-priority training gang fills the cluster
+        _gang(api, "low", size=6, min_count=6, cpu="2", priority=0)
+        _settle(api, sched, rounds=2)
+        assert sum(1 for n in _assignments(api).values() if n) == 6
+        # a higher-priority gang needs whole nodes: it must preempt
+        _gang(api, "high", size=3, min_count=3, cpu="4", priority=100)
+        _settle(api, sched, rounds=8)
+        return api, sched
+
+    def test_high_priority_gang_preempts_and_lands(self):
+        api, sched = self._scenario(device_gangs=True)
+        final = _assignments(api)
+        high = [u for u, n in final.items()
+                if n and u.startswith("default/high-")]
+        assert len(high) == 3, final
+        assert sched.preemption_attempts > 0
+
+    def test_end_state_matches_serial_oracle(self):
+        dev_api, _ = self._scenario(device_gangs=True)
+        host_api, _ = self._scenario(device_gangs=False)
+        dev_high = {u: n for u, n in _assignments(dev_api).items()
+                    if u.startswith("default/high-") and n}
+        host_high = {u: n for u, n in _assignments(host_api).items()
+                     if u.startswith("default/high-") and n}
+        assert len(dev_high) == len(host_high) == 3
+        # the surviving low-priority members match too
+        dev_low = {u for u, n in _assignments(dev_api).items()
+                   if u.startswith("default/low-") and n}
+        host_low = {u for u, n in _assignments(host_api).items()
+                    if u.startswith("default/low-") and n}
+        assert dev_low == host_low
+
+
+# ---------------------------------------------------------------------------
+# chaos gate: faults leave gang assignments identical
+
+
+def _run_gang_chaos_workload(api):
+    sched = _sched(api, batch_size=32)
+    _gang(api, "train-a", size=8, min_count=8)
+    sched.schedule_pending()
+    _gang(api, "train-b", size=6, min_count=4, cpu="2")
+    _gang(api, "too-big", size=40, min_count=40, cpu="3")  # must reject
+    _settle(api, sched, rounds=3)
+    return sched
+
+
+class TestWorkloadGenerator:
+    def test_trace_is_deterministic_and_spec_shared(self):
+        from kubernetes_tpu.testing.workloads import GangWorkloadGenerator
+
+        def shapes(seed):
+            gen = GangWorkloadGenerator(seed=seed)
+            specs = gen.training_gangs(5, size=(8, 64), min_count_frac=0.75)
+            return [(s.size, s.min_count) for s in specs]
+        assert shapes(42) == shapes(42)
+        assert shapes(42) != shapes(43)
+        gen = GangWorkloadGenerator(seed=1)
+        spec = gen.training_gangs(1, size=16)[0]
+        assert spec.min_count == 16
+        pods = gen.gang_pods(spec)
+        assert len(pods) == 16
+        # the spec OBJECT is shared → one signature row per gang
+        assert all(p.spec is pods[0].spec for p in pods)
+        assert all(p.spec.workload_ref == spec.ref for p in pods)
+        assert len({p.uid for p in pods}) == 16
+
+    def test_trace_interleaves_and_streams_chunks(self):
+        from kubernetes_tpu.testing.workloads import GangWorkloadGenerator
+        gen = GangWorkloadGenerator(seed=3)
+        specs = gen.training_gangs(3, size=8, priority=10)
+        pre = gen.training_gangs(1, size=4, priority=200,
+                                 prefix="preemptor")
+        events = list(gen.trace(specs, inference_count=12,
+                                preemptor_gangs=pre, chunk=16))
+        kinds = [k for k, _ in events]
+        assert kinds.count("workload") == 4
+        pods = [p for k, chunk in events if k == "pods" for p in chunk]
+        assert len(pods) == 3 * 8 + 12 + 4
+        # preemptor gangs arrive last
+        assert pods[-1].spec.workload_ref == "preemptor-0"
+        assert all(len(c) <= 16 for k, c in events if k == "pods")
+
+
+class TestGangChaos:
+    def test_seeded_faults_leave_gang_assignments_identical(self):
+        clean_api = APIServer()
+        for i in range(6):
+            clean_api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        _run_gang_chaos_workload(clean_api)
+        clean = _assignments(clean_api)
+        assert sum(1 for n in clean.values() if n) == 14
+
+        chaos = ChaosAPIServer(config=ChaosConfig(
+            seed=11,
+            error_rates={"bind": 0.15, "patch": 0.15, "delete": 0.15},
+            latency_rate=0.2, latency_seconds=(0.001, 0.02)))
+        for i in range(6):
+            chaos.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _run_gang_chaos_workload(chaos)
+        assert _assignments(chaos.inner) == clean
+        assert chaos.injected_errors["bind"] > 0
+        assert sched.dispatcher.errors == 0
+        assert not sched.cache.assumed_pods

@@ -21,10 +21,14 @@ class FakeClock:
         return self.t
 
 
-def _cluster(n_nodes=4, cpu=8):
+def _cluster(n_nodes=4, cpu=8, device_gangs=True):
     api = APIServer()
     clock = FakeClock()
     sched = Scheduler(api, batch_size=64, clock=clock)
+    if not device_gangs:
+        # legacy path: gangs ride per-pod placement + the Permit barrier
+        sched.feature_gates.set("GangDevicePlacement", False)
+        sched.gang_device_enabled = False
     sched._clock_handle = clock
     for i in range(n_nodes):
         api.create_node(make_node(f"n{i}").capacity(
@@ -68,10 +72,28 @@ class TestPreEnqueueQuorum:
 
 
 class TestAllOrNothing:
-    def test_partial_gang_holds_at_permit(self):
-        """Capacity admits only 2 of 3 members: nothing binds, the two
-        placeable pods park at Permit holding their resources."""
+    def test_partial_gang_rejects_atomically(self):
+        """Capacity admits only 2 of 3 members: the device verdict
+        rejects the WHOLE gang in one dispatch — nothing binds, nothing
+        parks at Permit, no member holds partial resources."""
         api, sched = _cluster(n_nodes=2, cpu=1)
+        _workload(api, min_count=3)
+        for i in range(3):
+            api.create_pod(_gang_pod(f"g{i}", cpu="1"))
+        assert sched.schedule_pending() == 0
+        assert len(sched._waiting_pods) == 0
+        assert api.binding_count == 0
+        assert sched.metrics.gang_dispatch.value("rejected") == 1.0
+        # the capacity was never held: ordinary pods use it immediately
+        api.create_pod(make_pod("plain0").req({"cpu": "1", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("plain1").req({"cpu": "1", "memory": "1Gi"}).obj())
+        assert sched.schedule_pending() == 2
+
+    def test_partial_gang_holds_at_permit_legacy(self):
+        """Gate off: capacity admits only 2 of 3 members — nothing binds,
+        the two placeable pods park at Permit holding their resources
+        (the reference's Permit-barrier dance)."""
+        api, sched = _cluster(n_nodes=2, cpu=1, device_gangs=False)
         _workload(api, min_count=3)
         for i in range(3):
             api.create_pod(_gang_pod(f"g{i}", cpu="1"))
@@ -80,7 +102,7 @@ class TestAllOrNothing:
         assert api.binding_count == 0
 
     def test_timeout_rejects_all_and_releases_resources(self):
-        api, sched = _cluster(n_nodes=2, cpu=1)
+        api, sched = _cluster(n_nodes=2, cpu=1, device_gangs=False)
         _workload(api, min_count=3)
         for i in range(3):
             api.create_pod(_gang_pod(f"g{i}", cpu="1"))
@@ -154,7 +176,7 @@ class TestWorkloadManagerState:
     def test_expired_deadline_rejects_immediately_on_retry(self):
         """After the group deadline passes, retries must not re-park for
         another full timeout while holding assumed resources."""
-        api, sched = _cluster(n_nodes=2, cpu=1)
+        api, sched = _cluster(n_nodes=2, cpu=1, device_gangs=False)
         _workload(api, min_count=3)
         for i in range(3):
             api.create_pod(_gang_pod(f"g{i}", cpu="1"))

@@ -54,6 +54,11 @@ NOISE = {
     "PreemptionChurn": 0.30,
     "MixedSchedulingBasePod": 0.20,
     "SchedulingNodeAffinity": 0.20,
+    # group-workload gates for the gang suite (r06+): gang drains commit
+    # in whole-gang lumps, so their per-window rates jitter like the
+    # other group workloads
+    "GangTraining": 0.30,
+    "CoLocatedInference": 0.30,
 }
 
 SKIP_PREFIXES = ("Sharded_",)

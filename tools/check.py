@@ -59,7 +59,7 @@ def main(argv=None) -> int:
     ap.add_argument("--entries", action="append", default=None,
                     metavar="MOD:NAME,NAME",
                     help="override the JIT entry points (repeatable); "
-                         "default: the eight kubernetes_tpu entries")
+                         "default: the nine kubernetes_tpu entries")
     args = ap.parse_args(argv)
 
     entry_points = None
