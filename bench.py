@@ -54,6 +54,11 @@ CASES = [
     ("TopologySpreading", "5000Nodes_5000Pods", "500Nodes", 85.0),
     ("SchedulingPodAntiAffinity", "5000Nodes_2000Pods", "500Nodes", 60.0),
     ("MixedSchedulingBasePod", "5000Nodes", "500Nodes", 140.0),
+    # >4 interacting signatures per drain (ISSUE 8 / ROADMAP item 4): the
+    # cliff the drain compiler removed, regression-guarded forever. The
+    # reference threshold reuses TopologySpreading's floor (same
+    # constraint family; no reference workload mixes signatures)
+    ("MixedHighSignature", "5000Nodes", "500Nodes", 85.0),
     # no reference workload exists for preemption churn; vs_baseline uses
     # the SchedulingBasic floor (the stream being scheduled THROUGH the
     # pending nominations is plain pods)

@@ -48,13 +48,16 @@ from dataclasses import dataclass, field
 
 from .findings import Finding, RULES
 
-# the nine public JIT entries (perf/ledger.py KERNELS wraps the same
+# the ten public JIT entries (perf/ledger.py KERNELS wraps the same
 # set); tools/check.py asserts each one resolves to at least one
-# discovered jit root, so the lint cannot silently lose coverage
+# discovered jit root, so the lint cannot silently lose coverage.
+# run_plan is the drain compiler's program (kubernetes_tpu/compiler/
+# emits DrainPlans whose wavescan spans dispatch it).
 ENTRY_POINTS = {
     "kubernetes_tpu.ops.program": (
         "run_batch", "run_uniform", "run_wave", "run_wave_scan",
-        "wave_statics", "diagnose_row", "dry_run_select_victims"),
+        "run_plan", "wave_statics", "diagnose_row",
+        "dry_run_select_victims"),
     "kubernetes_tpu.ops.gang": ("run_gang",),
     "kubernetes_tpu.parallel.sharding": ("run_batch_sharded",),
 }
@@ -67,6 +70,7 @@ DONATING_ENTRIES = {
     "run_batch": (2, "carry"),
     "run_wave": (2, "carry"),
     "run_wave_scan": (2, "carry"),
+    "run_plan": (2, "carry"),
     "run_gang": (2, "carry"),
 }
 

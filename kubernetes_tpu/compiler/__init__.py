@@ -1,0 +1,12 @@
+"""Drain compiler (ROADMAP item 4): any pod mix → one static device
+program. `DrainCompiler.compile_drain` emits a `DrainPlan` over a pow2
+signature lattice; `SurfaceCache` hoists the per-signature kernel
+surfaces once per node-state statics generation. The compiled program
+itself is ops/program.py `run_plan`."""
+
+from .plan import (PLAN_CACHE_LIMIT, PLAN_MAX_SIGS, DrainCompiler,
+                   DrainPlan)
+from .surfaces import SurfaceCache
+
+__all__ = ["DrainCompiler", "DrainPlan", "SurfaceCache", "PLAN_MAX_SIGS",
+           "PLAN_CACHE_LIMIT"]

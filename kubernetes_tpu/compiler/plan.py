@@ -1,0 +1,275 @@
+"""The drain compiler: one static device program for any pod mix.
+
+ROADMAP item 4 / SURVEY hard part 3. The device path grew as four special
+cases of one idea — the lean per-pod scan, the closed-form uniform run,
+the same-signature group wave, and the ≤4-signature mixed wave-scan —
+and every drain that fit none of them fell off onto the host greedy or a
+SigCache-thrashing per-pod scan (the ">4 interacting signatures" cliff:
+an alternating mixed drain recomputed the full kernel set every step).
+
+`DrainCompiler.compile_drain` replaces that case dispatch: it takes a
+drain's pod mix (signature sequence, group membership, gang span) plus
+the feature-gate set and emits a `DrainPlan` — an ordered list of spans,
+each mapped to the cheapest EXACT program:
+
+  ("gang", needed)            whole-gang all-or-nothing (ops/gang.py)
+  ("wave", u, anti, merge)    same-signature group wave (run_wave)
+  ("wavescan", rows, ports)   the plan program (ops/program.py run_plan):
+                              any mix of group / group-free / host-port
+                              rows, signature count padded to the pow2
+                              lattice, surfaces hoisted via SurfaceCache
+  ("uniform",)                closed-form top-L same-signature run
+  ("scan",)                   the per-pod reference scan (fallback tier)
+
+Padding policy (the static-shape contract): pod spans pad to pow2
+buckets, signature sets pad to the pow2 lattice {2, 4, 8, ..., 32}
+(`PLAN_MAX_SIGS`), so the whole workload's executable count is
+log-bounded per constraint family instead of per observed mix. Plans are
+cached by a key over (signature structure, flags, table generation): the
+compile ledger then proves a fixed retrace point over a steady workload
+— same traffic shape, zero fresh executables.
+
+Fallback matrix (what still routes to "scan"): nominated-pod overlays
+and per-pod self-exclusion, the sharded mesh, invalid rows, spans below
+`wave_min_span`, and mixes beyond PLAN_MAX_SIGS distinct signatures.
+Host-greedy remains the no-device tier for group drains whose plan is
+scan-only (`DrainPlan.scan_only` — gate off or short spans).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from .surfaces import SurfaceCache
+
+# signature-lattice ceiling for one plan span: S pads to the next pow2
+# ≤ this; beyond it the span keeps the reference scan (fallback matrix)
+PLAN_MAX_SIGS = 32
+
+# plan cache bound (structural keys are small; drains repeat heavily)
+PLAN_CACHE_LIMIT = 256
+
+
+@dataclass
+class DrainPlan:
+    """A compiled drain: spans in queue order + the static-shape audit."""
+
+    spans: list                  # [(i, j, kind)] — _dispatch_spans layout
+    key: tuple = ()
+    # padded-slot fraction over the plan's device programs: 1 − (real
+    # work slots / padded work slots), the cost of the pow2 lattice
+    pad_waste: float = 0.0
+    # no compiled program covers the drain (host greedy / oracle tier
+    # may take it instead)
+    scan_only: bool = False
+
+
+@dataclass
+class DrainCompiler:
+    """Maps a drain's pod mix to a DrainPlan (see module docstring).
+
+    Holds the per-signature SurfaceCache (hoisted kernel surfaces with
+    generation-diff retention) and the keyed plan cache; both are owned
+    by the scheduler and shared by every profile."""
+
+    state: object
+    builder: object
+    gates: object
+    metrics: object = None
+    max_sigs: int = PLAN_MAX_SIGS
+    surfaces: SurfaceCache = None
+    _plans: OrderedDict = field(default_factory=OrderedDict)
+
+    def __post_init__(self):
+        if self.surfaces is None:
+            self.surfaces = SurfaceCache(self.state, self.builder)
+
+    # -- plan cache ---------------------------------------------------------
+
+    def _cache_get(self, key):
+        plan = self._plans.get(key)
+        if plan is not None:
+            self._plans.move_to_end(key)
+            if self.metrics is not None:
+                self.metrics.compiler_plan_cache_hits.inc()
+        elif self.metrics is not None:
+            self.metrics.compiler_plan_cache_misses.inc()
+        return plan
+
+    def _cache_put(self, key, plan) -> None:
+        self._plans[key] = plan
+        if len(self._plans) > PLAN_CACHE_LIMIT:
+            self._plans.popitem(last=False)
+
+    # -- compilation --------------------------------------------------------
+
+    def compile_drain(self, batch, n: int, *, groups_needed: bool,
+                      gang_needed=None, overlay: bool = False,
+                      nominated: bool = False, mesh: bool = False,
+                      strategy: str = "LeastAllocated",
+                      prefer_taints: bool = False, wave_min_span: int = 24,
+                      uniform_min: int = 16) -> DrainPlan:
+        """Compile one drain's pod mix into a DrainPlan. Everything the
+        emitted spans depend on is either in the cache key or immutable
+        per signature row, so a cached plan is always valid."""
+        if gang_needed is not None:
+            # whole-gang drains are a single span by construction; the
+            # tier choice (closed-form vs scan) is data-dependent and
+            # made at dispatch (ops/gang.py)
+            return DrainPlan(spans=[(0, n, ("gang", int(gang_needed)))])
+        wave_on = (not mesh
+                   and self.gates.enabled("SpeculativeWavePlacement"))
+        batching_on = self.gates.enabled("OpportunisticBatching")
+        key = (self.builder.reset_count, self.builder.table_used,
+               groups_needed, overlay, nominated, mesh, strategy,
+               prefer_taints, wave_min_span, uniform_min, wave_on,
+               batching_on, n,
+               batch.sig[:n].tobytes(), batch.tidx[:n].tobytes(),
+               bool(batch.valid[:n].all()))
+        plan = self._cache_get(key)
+        if plan is None:
+            plan = self._compile(batch, n, groups_needed=groups_needed,
+                                 overlay=overlay, nominated=nominated,
+                                 mesh=mesh, strategy=strategy,
+                                 prefer_taints=prefer_taints,
+                                 wave_min_span=wave_min_span,
+                                 uniform_min=uniform_min, wave_on=wave_on,
+                                 batching_on=batching_on)
+            plan.key = key
+            self._cache_put(key, plan)
+        if self.metrics is not None:
+            self.metrics.compiler_pad_waste.observe(plan.pad_waste)
+        return plan
+
+    def _compile(self, batch, n, *, groups_needed, overlay, nominated,
+                 mesh, strategy, prefer_taints, wave_min_span, uniform_min,
+                 wave_on, batching_on) -> DrainPlan:
+        from ..state.tensorize import pow2_at_least
+
+        spans = None
+        if groups_needed and not overlay and not nominated:
+            wave = self._classify_wave(batch, n, wave_on, wave_min_span)
+            if wave is not None:
+                spans = [(0, n, wave)]
+        if spans is None:
+            # uniform/scan classification (the lean tiers). Nominated
+            # per-pod self-exclusion is outside the closed form; overlays
+            # ride the scan's fit overlay.
+            fast_ok = (not mesh and not nominated and batching_on
+                       and not groups_needed
+                       and strategy == "LeastAllocated"
+                       and not prefer_taints)
+            if not fast_ok:
+                spans = [(0, n, ("scan",))]
+            else:
+                spans = [(i, j, ("uniform",) if uniform else ("scan",))
+                         for (i, j, uniform)
+                         in self._classify_runs(batch, n, uniform_min)]
+            if not groups_needed and not overlay and not nominated:
+                # non-interacting signatures in one plan span: the
+                # alternating mixed drain that thrashed the scan's
+                # one-slot signature cache
+                spans = [self._lean_span(batch, s, wave_on, wave_min_span)
+                         for s in spans]
+        # pad-waste audit: real work slots vs the padded lattice slots of
+        # every compiled span (scan spans pad the pod bucket only)
+        real = padded = 0
+        for (i, j, kind) in spans:
+            m = j - i
+            if kind[0] == "wavescan":
+                S = len(kind[1])
+                real += m * S
+                padded += pow2_at_least(m) * pow2_at_least(S, 2)
+            elif kind[0] in ("scan", "wave"):
+                real += m
+                padded += pow2_at_least(m)
+            else:               # uniform: L is the standing batch bucket
+                real += m
+                padded += m
+        waste = 0.0 if padded == 0 else max(1.0 - real / padded, 0.0)
+        scan_only = all(k[0] == "scan" for (_i, _j, k) in spans)
+        return DrainPlan(spans=spans, pad_waste=round(waste, 4),
+                         scan_only=scan_only)
+
+    # -- classification (formerly scheduler.py case dispatch) ----------------
+
+    def _classify_runs(self, batch, n: int, uniform_min: int):
+        """Split [0, n) into maximal same-signature runs; mark each
+        uniform (closed-form eligible) or not; merge adjacent non-uniform
+        stretches so they cost one dispatch instead of many."""
+        sig, tidx = batch.sig, batch.tidx
+        pref_w = self.builder.table.pref_weight
+        runs: list[tuple[int, int, bool]] = []
+        i = 0
+        while i < n:
+            j = i + 1
+            while j < n and sig[j] == sig[i]:
+                j += 1
+            uniform = (sig[i] != 0 and j - i >= uniform_min
+                       and not pref_w[tidx[i]].any())
+            if runs and not uniform and not runs[-1][2]:
+                runs[-1] = (runs[-1][0], j, False)
+            else:
+                runs.append((i, j, uniform))
+            i = j
+        return runs
+
+    def _classify_wave(self, batch, n: int, wave_on: bool,
+                       wave_min_span: int):
+        """Whole-drain program for a group drain, or None (scan-only →
+        host greedy / reference scan). Same-signature port-free drains
+        ride the merge wave; ANY other mix up to PLAN_MAX_SIGS distinct
+        signatures — host-port rows included — compiles to one plan
+        program."""
+        if not wave_on or n < wave_min_span:
+            return None
+        if not batch.valid[:n].all():
+            return None
+        sig = batch.sig[:n]
+        has_ports = bool((sig == 0).any())
+        uniq = list(dict.fromkeys(batch.tidx[:n].tolist()))
+        if len(uniq) == 1 and not has_ports:
+            mode, anti = self._wave_same_mode(int(uniq[0]))
+            if mode is not None:
+                return ("wave", int(uniq[0]), anti, mode == "merge")
+        if len(uniq) <= self.max_sigs:
+            return ("wavescan", tuple(int(u) for u in uniq), has_ports)
+        return None
+
+    def _wave_same_mode(self, u: int):
+        """(mode, anti_term) for the same-signature kernel: "merge" runs
+        the closed-form wave loop (with `anti_term` the row's single
+        self-matching required-anti term, -1 = none), "serial" the exact
+        in-dispatch scan only, None = the row needs the multi-signature
+        program (its in-wave self-interactions — ScheduleAnyway counts,
+        required affinity, score terms — are outside the same-signature
+        state the kernel maintains)."""
+        g = self.builder.groups
+        if u >= len(g.rows):
+            return None, -1
+        if g.spr_s_active[u].any():
+            return None, -1
+        if g.m_ipa_a[u, u] and g.ipa_ra_active[u].any():
+            return None, -1
+        if g.w_stc[u, u].any() or g.w_stp[u, u].any():
+            return None, -1
+        terms = [t for t in range(g.m_ipa_aa.shape[2])
+                 if g.m_ipa_aa[u, u, t] or g.m_ipa_exist[u, u, t]]
+        if len(terms) > 1:
+            return "serial", -1
+        return "merge", (terms[0] if terms else -1)
+
+    def _lean_span(self, batch, span, wave_on: bool, wave_min_span: int):
+        """Upgrade an eligible scan span of a group-free drain to the
+        lean plan program; anything ineligible keeps its kind."""
+        i, j, kind = span
+        if (kind[0] != "scan" or not wave_on or j - i < wave_min_span):
+            return span
+        if not batch.valid[i:j].all():
+            return span
+        has_ports = bool((batch.sig[i:j] == 0).any())
+        uniq = list(dict.fromkeys(int(t) for t in batch.tidx[i:j]))
+        if len(uniq) > self.max_sigs:
+            return span
+        return (i, j, ("wavescan", tuple(uniq), has_ports))

@@ -361,6 +361,22 @@ class SchedulerMetrics:
             "Time a gang's members spent PreEnqueue-gated before quorum "
             "was met (first gated member to un-gate).",
             buckets=exponential_buckets(0.001, 4, 12)))
+        # drain compiler (kubernetes_tpu/compiler/): plan-cache traffic +
+        # the cost of the pow2 padding lattice
+        self.compiler_plan_cache_hits = r.register(Counter(
+            n + "compiler_plan_cache_hits_total",
+            "Drain-compiler plan cache hits (a drain whose pod-mix "
+            "structure matched a previously compiled DrainPlan)."))
+        self.compiler_plan_cache_misses = r.register(Counter(
+            n + "compiler_plan_cache_misses_total",
+            "Drain-compiler plan cache misses (a fresh pod-mix structure "
+            "compiled into a new DrainPlan)."))
+        self.compiler_pad_waste = r.register(Histogram(
+            n + "compiler_pad_waste_ratio",
+            "Per-drain fraction of padded work slots in the compiled "
+            "plan's device programs (pow2 pod buckets x pow2 signature "
+            "lattice): 1 - real/padded.",
+            buckets=[0.0, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0]))
         self.drain_phase = r.register(Histogram(
             n + "drain_phase_seconds",
             "Per-drain wall time by phase: host_build (snapshot + batch "
@@ -416,6 +432,9 @@ class SchedulerMetrics:
         for outcome in ("placed", "rejected", "fallback"):
             self.gang_dispatch.inc(outcome, by=0)
         self.gang_quorum_wait.seed()
+        self.compiler_plan_cache_hits.inc(by=0)
+        self.compiler_plan_cache_misses.inc(by=0)
+        self.compiler_pad_waste.seed()
         self.wave_placement_waves.inc(by=0)
         self.wave_conflict_ratio.seed()
         self.wave_accepted_prefix.seed()

@@ -49,9 +49,9 @@ from jax import lax
 from ..analysis.rails import GLOBAL as RAILS
 from ..perf.ledger import GLOBAL as LEDGER
 from ..state.tensorize import NodeArrays
-from .program import (Carry, PodTableDev, PodXs, ScoreConfig, _gather_row,
-                      _slow_parts, _uniform_core, balanced_allocation,
-                      default_normalize, least_allocated)
+from .program import (Carry, PodTableDev, PodXs, ScoreConfig, _fit_scores,
+                      _gather_row, _uniform_core, balanced_allocation,
+                      default_normalize, fit_mask, least_allocated)
 
 
 class GangXs(NamedTuple):
@@ -64,7 +64,7 @@ class GangXs(NamedTuple):
 
 def _run_gang_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
                         xs: GangXs, table: PodTableDev, wt, needed, dom,
-                        w_contig: int):
+                        statics, w_contig: int):
     """Scan-tier gang assignment; returns (carry', packed i32[B+4]).
 
     packed[:B] holds each member's RAW greedy assignment (-1 = no feasible
@@ -80,15 +80,25 @@ def _run_gang_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
     nzmask = jnp.array(cfg.col_nonzero)
     slots = jnp.array(cfg.nonzero_slot, jnp.int32)
 
-    # hoisted per-signature surfaces: the vmapped filter masks + the
-    # carry-dependent fit/score columns at the gang's entry state
-    def _slot_parts(u):
+    # per-signature surfaces: the carry-INDEPENDENT kernels (static filter
+    # mask, taint/affinity raw counts, ImageLocality) arrive precomputed —
+    # the drain compiler's SurfaceCache hoists them once per node-state
+    # statics generation, shared with the plan/wave programs. Gang rows
+    # carry sig != 0 (no host ports), so the ports term the full
+    # _slow_parts would fold in is vacuously true. Only the
+    # carry-DEPENDENT fit/score columns evaluate here, at the gang's
+    # entry state.
+    static_m, taint_raw, na_raw, s_img = statics                # each [S, N]
+
+    def _fit_parts(u):
         pod = _gather_row(table, PodXs(valid=jnp.bool_(True),
                                        sig=jnp.int32(0), tidx=u))
-        return _slow_parts(cfg, na, carry, pod)
+        fit_ok = fit_mask(na.cap, carry.used, carry.npods,
+                          na.allowed_pods, pod.req)
+        s_fit, s_bal = _fit_scores(cfg, na, carry, pod)
+        return fit_ok, s_fit, s_bal
 
-    (static_m, taint_raw, na_raw, s_img,
-     fit_ok0, s_fit0, s_bal0) = jax.vmap(_slot_parts)(wt)       # each [S, N]
+    fit_ok0, s_fit0, s_bal0 = jax.vmap(_fit_parts)(wt)          # each [S, N]
     req_s = table.req[wt]                                       # [S, R]
     nzreq_s = table.nonzero_req[wt]                             # [S, 2]
     skipb_s = table.skip_balanced[wt]                           # [S]
@@ -209,9 +219,9 @@ def _run_gang_uniform_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
 
 
 def run_gang(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs, table,
-             wt=None, needed=None, dom=None, w_contig: int = 0,
-             uniform: bool = False, n_actual=None, L: int = 0, K: int = 0,
-             J: int = 0):
+             wt=None, needed=None, dom=None, statics=None,
+             w_contig: int = 0, uniform: bool = False, n_actual=None,
+             L: int = 0, K: int = 0, J: int = 0):
     """JIT entry for whole-gang all-or-nothing assignment.
 
     `uniform=True` routes a single-signature gang to the closed-form tier
@@ -220,12 +230,14 @@ def run_gang(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs, table,
     keeps the input carry to replay failed exactness preconditions on the
     scan tier). `uniform=False` runs the general scan tier (`xs` a
     GangXs, `wt` the i32[S] signature rows, `dom` the i32[N] topology
-    domain ids for the contiguity column); the input carry is DONATED on
-    accelerator backends exactly like run_batch — both the accept and
-    the reject branch produce fresh output buffers, so the all-or-nothing
-    unwind costs nothing. `needed` is the gang's remaining quorum
-    (minCount minus already-assigned members), a dynamic i32 so quorum
-    values never mint executables."""
+    domain ids for the contiguity column, `statics` the rows' hoisted
+    carry-independent surfaces — the drain compiler's SurfaceCache rows,
+    stacked [S, N] each exactly like run_plan's); the input carry is
+    DONATED on accelerator backends exactly like run_batch — both the
+    accept and the reject branch produce fresh output buffers, so the
+    all-or-nothing unwind costs nothing. `needed` is the gang's remaining
+    quorum (minCount minus already-assigned members), a dynamic i32 so
+    quorum values never mint executables."""
     if uniform:
         na, carry, xs, table, n_actual, needed = RAILS.stage(
             (na, carry, xs, table, n_actual, needed))
@@ -234,10 +246,10 @@ def run_gang(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs, table,
                                     L, K, J)
     donate = jax.default_backend() != "cpu"
     fn = _run_gang_scan_fn(donate)
-    na, carry, xs, table, wt, needed, dom = RAILS.stage(
-        (na, carry, xs, table, wt, needed, dom))
+    na, carry, xs, table, wt, needed, dom, statics = RAILS.stage(
+        (na, carry, xs, table, wt, needed, dom, statics))
     out = LEDGER.measured_call("run_gang", fn, cfg, na, carry, xs, table,
-                               wt, needed, dom, w_contig,
+                               wt, needed, dom, statics, w_contig,
                                donated=carry if donate else None)
     if not donate:
         RAILS.poison_donated(carry, out)

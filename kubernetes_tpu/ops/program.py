@@ -1024,12 +1024,15 @@ class _WaveState(NamedTuple):
     clean: jnp.ndarray         # bool — no conflict seen yet
     n_conf: jnp.ndarray        # i32 — conflicting pods so far
     prefix: jnp.ndarray        # i32 — conflict-free prefix length
+    # host-port bookkeeping (None unless the plan program compiles the
+    # has_ports variant — a drain mixing host-port rows into the wave)
+    ports: jnp.ndarray = None  # i32 [N, P]
 
 
 def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
                         xs: WaveXs, table: PodTableDev, wt, gd: GroupsDev,
                         statics, fam: GroupFamilies, norm_live: bool,
-                        has_groups: bool):
+                        has_groups: bool, has_ports: bool = False):
     """One wave of group-constrained pods in ONE device dispatch.
 
     Phase A (speculative parallel scoring): every distinct signature's full
@@ -1131,13 +1134,19 @@ def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
         aa_cnt=gc.ipa_aa_cnt[wt] if has_groups else None,
         iscore=gc.ipa_score[wt] if has_groups else None,
         cnt_sn=jnp.zeros((S, n), jnp.int32) if has_groups else None,
-        clean=jnp.bool_(True), n_conf=jnp.int32(0), prefix=jnp.int32(0))
+        clean=jnp.bool_(True), n_conf=jnp.int32(0), prefix=jnp.int32(0),
+        ports=carry.ports if has_ports else None)
 
     def _eval(stx: _WaveState, w):
         """Feasibility + total score of signature slot `w` at the state —
         the same formula code as the scan's _eval_pod, over the wave's
         maintained counters (GroupView shared with ops/groups.py)."""
         feasible = static_mask[w] & stx.fit_ok[w]
+        if has_ports:
+            # host-port rows evaluate the live ports carry every step —
+            # exactly the scan's slow path for sig-0 pods (port-free rows
+            # carry all-zero port_ids, so this is vacuously true for them)
+            feasible &= ports_mask(stx.ports, rows.port_ids[w])
         if has_groups:
             view = GroupView(
                 f_act=f_act[w], f_skew=f_skew[w], f_self=f_self[w],
@@ -1280,6 +1289,20 @@ def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
                 d_cons + d_plcd)
 
         cnt_sn = (stx.cnt_sn.at[w, best].add(g_i) if has_groups else None)
+        ports2 = stx.ports
+        if has_ports:
+            # place the pod's port ids into the first free slots of the
+            # chosen node's row (_apply_assignment's exact port logic)
+            prow = stx.ports[best]
+            free = prow == 0
+            rank = jnp.cumsum(free) - 1
+            pp = rows.port_ids[w]
+            nport = pp.shape[0]
+            incoming = jnp.where((rank >= 0) & (rank < nport) & free,
+                                 pp[jnp.clip(rank, 0, nport - 1)], 0)
+            new_prow = jnp.where(free, incoming, prow)
+            ports2 = stx.ports.at[best].set(
+                jnp.where(assigned & jnp.any(pp != 0), new_prow, prow))
         y = jnp.where(assigned, best, jnp.int32(-1))
         conflict = x.valid & (y != spec_y[w])
         prefix = stx.prefix + (stx.clean & x.valid
@@ -1291,7 +1314,7 @@ def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
             a_total=a_total, aa_cnt=aa_cnt, iscore=iscore,
             cnt_sn=cnt_sn, clean=stx.clean & ~conflict,
             n_conf=stx.n_conf + conflict.astype(jnp.int32),
-            prefix=prefix), y
+            prefix=prefix, ports=ports2), y
 
     stf, ys = lax.scan(step, st0, xs)
 
@@ -1300,7 +1323,8 @@ def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
     new_gc = (wave_fold(gd, gc, wt, stf.cnt_sn, fam=fam) if has_groups
               else carry.groups)
     new_carry = Carry(used=stf.used, nonzero_used=stf.nonzero_used,
-                      npods=stf.npods, ports=carry.ports,
+                      npods=stf.npods,
+                      ports=stf.ports if has_ports else carry.ports,
                       cache=carry.cache._replace(sig=jnp.int32(0)),
                       groups=new_gc)
     packed = jnp.concatenate(
@@ -1311,7 +1335,8 @@ def _run_wave_scan_impl(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
 @functools.lru_cache(maxsize=None)
 def _run_wave_scan_fn(donate: bool):
     return jax.jit(_run_wave_scan_impl,
-                   static_argnames=("cfg", "fam", "norm_live", "has_groups"),
+                   static_argnames=("cfg", "fam", "norm_live", "has_groups",
+                                    "has_ports"),
                    donate_argnums=(2,) if donate else ())
 
 
@@ -1335,6 +1360,44 @@ def run_wave_scan(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs: WaveXs,
     out = LEDGER.measured_call("run_wave_scan", fn, cfg, na, carry, xs,
                                table, wt, gd, statics, fam, norm_live,
                                has_groups,
+                               donated=carry if donate else None)
+    if not donate:
+        RAILS.poison_donated(carry, out)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _run_plan_fn(donate: bool):
+    # a DISTINCT jit object over the shared wave-scan impl: the compile
+    # ledger attributes the drain compiler's plan executables to
+    # "run_plan", so the plan lattice's fixed retrace point is provable
+    # separately from the legacy run_wave_scan entry
+    return jax.jit(_run_wave_scan_impl,
+                   static_argnames=("cfg", "fam", "norm_live", "has_groups",
+                                    "has_ports"),
+                   donate_argnums=(2,) if donate else ())
+
+
+def run_plan(cfg: ScoreConfig, na: NodeArrays, carry: Carry, xs: WaveXs,
+             table: PodTableDev, wt, gd: GroupsDev | None, statics,
+             fam: GroupFamilies, norm_live: bool, has_groups: bool = True,
+             has_ports: bool = False):
+    """The drain compiler's program entry (kubernetes_tpu/compiler/): ONE
+    compiled dispatch for an arbitrary mixed-signature span — group rows,
+    group-free rows and (with `has_ports`) host-port rows alike, at any
+    pow2 signature-lattice width S. Shares the wave-scan implementation
+    (per-signature surfaces hoisted via `statics`, exact serial-order
+    replay over the maintained counters), compiled with `has_ports` to
+    additionally maintain the ports carry so sig-0 rows no longer force
+    a span split. The input carry is DONATED on accelerator backends
+    (run_batch's contract); CPU compiles without donation."""
+    donate = jax.default_backend() != "cpu"
+    fn = _run_plan_fn(donate)
+    na, carry, xs, table, wt, gd, statics = RAILS.stage(
+        (na, carry, xs, table, wt, gd, statics))
+    out = LEDGER.measured_call("run_plan", fn, cfg, na, carry, xs, table,
+                               wt, gd, statics, fam, norm_live, has_groups,
+                               has_ports,
                                donated=carry if donate else None)
     if not donate:
         RAILS.poison_donated(carry, out)

@@ -170,7 +170,16 @@ def _pod_from_template(name: str, template: Optional[dict], seq: int = 0,
                        zones: int = 16, gang_size: int = 1):
     w = make_pod(name)
     t = template or {}
-    w = w.req({"cpu": t.get("cpu", "900m"), "memory": t.get("memory", "1Gi")})
+    cpu = t.get("cpu", "900m")
+    cyc = int(t.get("signatureCycle", 0))
+    if cyc:
+        # rotate the cpu request over `cyc` distinct values: consecutive
+        # pods then interleave `cyc` distinct signatures (every other
+        # template field — labels, spread, affinity — identical), the
+        # high-signature mixed-drain shape the drain compiler maps to one
+        # plan program (MixedHighSignature workload)
+        cpu = f"{250 + 50 * (seq % cyc)}m"
+    w = w.req({"cpu": cpu, "memory": t.get("memory", "1Gi")})
     if t.get("priority"):
         w = w.priority(int(t["priority"]))
     for k, v in t.get("labels", {}).items():
@@ -211,6 +220,16 @@ class PodFactory:
             self.zone_protos = [
                 _pod_from_template(f"proto-z{z}", t, seq=z, zones=zones)
                 for z in range(zones)]
+        self.cycle_protos = None
+        cyc = int(t.get("signatureCycle", 0))
+        if cyc and not self.per_seq and self.zone_protos is None:
+            # one shared prototype per signature in the cycle: pods
+            # sharing a prototype share spec identity, so the builder's
+            # signature cache hits while the drain still interleaves
+            # `cyc` distinct signatures
+            self.cycle_protos = [
+                _pod_from_template(f"proto-c{c}", t, seq=c, zones=zones)
+                for c in range(cyc)]
         self.proto = _pod_from_template("proto", t, seq=0, zones=zones,
                                         gang_size=self.gang_size)
 
@@ -221,8 +240,12 @@ class PodFactory:
             return _pod_from_template(name, self.template, seq=seq,
                                       zones=self.zones,
                                       gang_size=self.gang_size)
-        proto = (self.zone_protos[seq % self.zones]
-                 if self.zone_protos is not None else self.proto)
+        if self.cycle_protos is not None:
+            proto = self.cycle_protos[seq % len(self.cycle_protos)]
+        elif self.zone_protos is not None:
+            proto = self.zone_protos[seq % self.zones]
+        else:
+            proto = self.proto
         p = _shallow(proto)
         m = _shallow(proto.metadata)
         m.name = name

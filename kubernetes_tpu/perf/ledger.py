@@ -63,8 +63,8 @@ class KernelRecord:
 # every instrumented kernel, pre-seeded into the metric families so
 # dashboards see the series before the first dispatch
 KERNELS = ("run_batch", "run_uniform", "run_wave", "run_wave_scan",
-           "wave_statics", "diagnose", "dry_run", "run_batch_sharded",
-           "run_gang")
+           "run_plan", "wave_statics", "diagnose", "dry_run",
+           "run_batch_sharded", "run_gang")
 
 # h2d phase labels, aligned with scheduler_drain_phase_seconds{phase}
 # where the transfer is paid (device_readback is the d2h direction of the

@@ -42,8 +42,8 @@ from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
 from .ops.program import (PodXs, ScoreConfig, WaveXs, initial_carry,
-                          run_batch, run_uniform, run_wave, run_wave_scan,
-                          table_from_batch, wave_statics)
+                          run_batch, run_plan, run_uniform, run_wave,
+                          table_from_batch)
 from .plugins import noderesources as nr
 from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
                                   PrioritySort, SchedulingGates,
@@ -576,11 +576,19 @@ class Scheduler:
         #                              for; any pow2 crossing of the live
         #                              row count (or node growth) reseeds
         self._seeded_rows = 0        # signature rows whose counts are seeded
-        # wave placement: per-signature carry-independent surface cache
-        # (ops/program.py wave_statics) — rebuilt when node state or the
-        # signature table moves
-        self._wave_statics: dict[int, tuple] = {}
-        self._wave_statics_key = (-1, -1)
+        # the drain compiler (kubernetes_tpu/compiler/): maps every
+        # drain's pod mix to a static device program over the pow2
+        # signature lattice — the case dispatch (uniform/scan/wave/
+        # wavescan, the >4-signature cliff, the host-greedy gate) lives
+        # there now. It owns the per-signature SurfaceCache (hoisted
+        # kernel surfaces, retained across placement-only generations)
+        # and the keyed plan cache the compile ledger's fixed retrace
+        # point rests on.
+        from .compiler import DrainCompiler
+        self.compiler = DrainCompiler(state=self.state,
+                                      builder=self.builder,
+                                      gates=self.feature_gates,
+                                      metrics=self.metrics)
         # below this span length the per-pod scan beats a wave dispatch
         self.wave_min_span = 24
 
@@ -1247,10 +1255,11 @@ class Scheduler:
                 # placement + the Permit barrier at commit)
                 self.metrics.gang_dispatch.inc("fallback")
                 gang = None
-            if groups_needed and self._classify_wave(segment_batch,
-                                                     len(qpis)) is None:
-                # host greedy is the FALLBACK tier for group drains the wave
-                # kernels can't take (gate off, short spans, >4 signatures)
+            if groups_needed and self._device_plan(
+                    segment_batch, len(qpis), profile).scan_only:
+                # host greedy is the FALLBACK tier for group drains no
+                # compiled program covers (gate off, short spans, mixes
+                # beyond the plan lattice)
                 bound = self._try_host_greedy(qpis, profile, segment_batch)
                 if bound is not None:
                     return bound
@@ -1576,75 +1585,23 @@ class Scheduler:
             ((a.taint_eff == EFFECT_PREFER_NO_SCHEDULE)
              & a.valid[:, None]).any())
 
-    def _classify_runs(self, batch, n: int) -> list[tuple[int, int, bool]]:
-        """Split [0, n) into maximal same-signature runs; mark each uniform
-        (closed-form eligible) or not; merge adjacent non-uniform stretches
-        so they cost one scan dispatch instead of many."""
-        sig, tidx = batch.sig, batch.tidx
-        pref_w = self.builder.table.pref_weight
-        runs: list[tuple[int, int, bool]] = []
-        i = 0
-        while i < n:
-            j = i + 1
-            while j < n and sig[j] == sig[i]:
-                j += 1
-            uniform = (sig[i] != 0 and j - i >= self.UNIFORM_RUN_MIN
-                       and not pref_w[tidx[i]].any())
-            if runs and not uniform and not runs[-1][2]:
-                runs[-1] = (runs[-1][0], j, False)
-            else:
-                runs.append((i, j, uniform))
-            i = j
-        return runs
-
     # -- speculative wave placement (group drains) ----------------------------
 
     def _wave_enabled(self) -> bool:
         return (self.mesh is None
                 and self.feature_gates.enabled("SpeculativeWavePlacement"))
 
-    def _classify_wave(self, batch, n: int) -> Optional[tuple]:
-        """Wave descriptor for a group drain, or None when the wave
-        kernels can't take it (caller falls back to host greedy / scan).
-        ("wave", tidx, anti_term, merge_on) routes a same-signature drain
-        to the merge+serial kernel; ("wavescan", rows) routes a mixed
-        drain of ≤ 4 signatures to the multi-signature serial kernel."""
-        if not self._wave_enabled() or n < self.wave_min_span:
-            return None
-        sig = batch.sig[:n]
-        if (sig == 0).any() or not batch.valid[:n].all():
-            return None
-        uniq = list(dict.fromkeys(batch.tidx[:n].tolist()))
-        if len(uniq) == 1:
-            mode, anti = self._wave_same_mode(int(uniq[0]))
-            if mode is not None:
-                return ("wave", int(uniq[0]), anti, mode == "merge")
-        if len(uniq) <= 4:
-            return ("wavescan", tuple(int(u) for u in uniq))
-        return None
-
-    def _wave_same_mode(self, u: int):
-        """(mode, anti_term) for the same-signature kernel: "merge" runs
-        the closed-form wave loop (with `anti_term` the row's single
-        self-matching required-anti term, -1 = none), "serial" the exact
-        in-dispatch scan only, None = the row needs the multi-signature
-        kernel (its in-wave self-interactions — ScheduleAnyway counts,
-        required affinity, score terms — are outside the same-signature
-        state the kernel maintains)."""
-        g = self.builder.groups
-        if u >= len(g.rows):
-            return None, -1
-        if g.spr_s_active[u].any():
-            return None, -1
-        if g.m_ipa_a[u, u] and g.ipa_ra_active[u].any():
-            return None, -1
-        if g.w_stc[u, u].any() or g.w_stp[u, u].any():
-            return None, -1
-        terms = [t for t in range(g.m_ipa_aa.shape[2])
-                 if g.m_ipa_aa[u, u, t] or g.m_ipa_exist[u, u, t]]
-        if len(terms) > 1:
-            return "serial", -1
-        return "merge", (terms[0] if terms else -1)
+    def _device_plan(self, batch, n: int, profile: Profile):
+        """The drain compiler's plan for this group drain under the
+        current gates (no overlay/nomination/gang context — those route
+        at the dispatch site)."""
+        return self.compiler.compile_drain(
+            batch, n, groups_needed=True,
+            mesh=self.mesh is not None,
+            strategy=profile.score_config.strategy,
+            prefer_taints=self._cluster_has_prefer_taints(),
+            wave_min_span=self.wave_min_span,
+            uniform_min=self.UNIFORM_RUN_MIN)
 
     def _wave_norm_static(self, rows: tuple) -> bool:
         from .ops.hostgreedy import static_norm_ok
@@ -1653,43 +1610,11 @@ class Scheduler:
                    for u in rows)
 
     def _get_wave_statics(self, na, table, rows: tuple) -> list:
-        """Cached wave_statics rows ([N] tuples per signature); the cache
-        lives until node state (staging generation) or the signature table
-        (reset) moves — the expensive per-signature kernels then run once
-        per workload change, not once per dispatch."""
-        key = (self.state.staging_gen, self.builder.reset_count)
-        if self._wave_statics_key != key:
-            self._wave_statics.clear()
-            self._wave_statics_key = key
-        missing = [u for u in dict.fromkeys(rows)
-                   if u not in self._wave_statics]
-        t = self.builder.table
-        a = self.state.arrays
-        has_taints = a is None or bool(
-            ((a.taint_key != 0) & a.valid[:, None]).any())
-        # host cache maintenance that runs lazily inside the dispatch
-        # region: the row-index upload and per-row slice reads are part of
-        # the declared host_cache contract, so open its allow window here
-        # too (no-op with the SanitizerRails gate off)
-        with self.rails.declared("host_cache"):
-            for c0 in range(0, len(missing), 4):
-                chunk = missing[c0:c0 + 4]
-                # pad only to the next pow2 row count — the common
-                # one-new-sig case must not pay the 4-row kernel 4× over
-                S = 1 if len(chunk) == 1 else (2 if len(chunk) == 2 else 4)
-                wts = (chunk + [chunk[-1]] * S)[:S]
-                # feature flags trim wave_statics to the kernels the rows
-                # can actually exercise (an unconstrained signature skips
-                # the padded taint/selector/image broadcasts entirely)
-                feats = (has_taints,
-                         any(bool(t.ns_sel_val[u].any()) or bool(t.aff_has[u])
-                             or bool(t.pref_weight[u].any()) for u in chunk),
-                         any(bool(t.img_containers[u]) for u in chunk))
-                m_, tr, nr, si = wave_statics(
-                    na, table, jnp.asarray(np.array(wts, np.int32)), feats)
-                for k, u in enumerate(chunk):
-                    self._wave_statics[u] = (m_[k], tr[k], nr[k], si[k])
-        return [self._wave_statics[u] for u in rows]
+        """Hoisted per-signature surfaces ([N] tuples per signature) from
+        the compiler's SurfaceCache — retained across placement-only
+        generation bumps (compiler/surfaces.py), recomputed only when a
+        node's static columns or the signature table move."""
+        return self.compiler.surfaces.get(na, table, rows)
 
     def _wave_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
                        j: int, table, span):
@@ -1719,13 +1644,16 @@ class Scheduler:
 
     def _wavescan_dispatch(self, cfg: ScoreConfig, na, carry, batch,
                            i: int, j: int, table, span):
-        """Dispatch the multi-signature wave kernel over pods [i:j).
-        Group drains carry the resident group tensors; LEAN spans
-        (non-interacting signatures of a group-free drain) compile the
-        variant without any group state."""
+        """Dispatch the compiler's plan program (ops/program.py run_plan)
+        over pods [i:j): an arbitrary mixed-signature span — group rows
+        ride the resident group tensors, LEAN spans (non-interacting
+        signatures of a group-free drain) compile the variant without any
+        group state, and spans holding host-port rows compile the
+        ports-carry variant. The signature set pads to the compiler's
+        pow2 lattice so the executable count stays log-bounded."""
         from .ops.groups import GroupFamilies
 
-        _, uniq = span
+        _, uniq, has_ports = span
         uniq = list(uniq)
         m = j - i
         bucket = pow2_at_least(m)
@@ -1741,18 +1669,17 @@ class Scheduler:
         widx[m:] = widx[m - 1]
         valid = np.zeros((bucket,), bool)
         valid[:m] = batch.valid[i:j]
-        statics_list = self._get_wave_statics(na, table, tuple(wt_list))
-        statics = tuple(jnp.stack([s[f] for s in statics_list])
-                        for f in range(4))
+        statics = self.compiler.surfaces.stacked(na, table, tuple(wt_list))
         norm_live = not self._wave_norm_static(tuple(wt_list))
         xs = WaveXs(valid=jnp.asarray(valid), widx=jnp.asarray(widx))
         has_groups = self._gd_dev is not None
         fam = self._gd_fam if has_groups else GroupFamilies(
             False, False, False, False, False)
-        carry2, packed = run_wave_scan(
+        carry2, packed = run_plan(
             cfg, na, carry, xs, table,
             jnp.asarray(np.array(wt_list, np.int32)), self._gd_dev,
-            statics, fam, norm_live, has_groups=has_groups)
+            statics, fam, norm_live, has_groups=has_groups,
+            has_ports=has_ports)
         return carry2, packed, bucket
 
     # -- gang placement (whole-group all-or-nothing dispatch) ------------------
@@ -1831,76 +1758,45 @@ class Scheduler:
         xs = GangXs(valid=jnp.asarray(valid), tidx=jnp.asarray(tidx),
                     widx=jnp.asarray(widx))
         dom = self._gang_domains(na, need=w_contig > 0)
+        # the gang scan tier consumes the SAME hoisted surfaces as the
+        # plan program — its per-dispatch cost collapses to the fit
+        # columns + the member scan (ROADMAP item 3's remaining headroom)
+        statics = self.compiler.surfaces.stacked(na, table, tuple(wt_list))
         c2, packed = run_gang(
             cfg, na, carry, xs, table,
             wt=jnp.asarray(np.array(wt_list, np.int32)),
-            needed=np.int32(needed), dom=dom, w_contig=w_contig)
+            needed=np.int32(needed), dom=dom, statics=statics,
+            w_contig=w_contig)
         return c2, packed, bucket, False
 
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
                        n: int, groups_needed: bool, ovl=None, nom=None,
                        gang=None):
-        """Dispatch the drain through the fastest exact program with ZERO
+        """Dispatch the drain through its compiled DrainPlan with ZERO
         host synchronization — results stream back asynchronously and the
         carry chains device-side.
 
-        Maximal same-signature runs collapse to closed-form top-L
-        assignment (ops/program.py run_uniform — reference batch.go:97's
-        sortedNodes trick, one top_k per run instead of one scan step per
-        pod); anything else — short runs, host-port pods (sig 0), group
-        constraints, MostAllocated, PreferNoSchedule taints, preferred
-        affinity — keeps the sequential scan. Each record carries its input
-        carry so commit-time validation (rare failures:
-        BalancedAllocation non-monotonicity, depth-J overflow) can rewind
-        and replay. Returns (chain carry, [_RunRec])."""
+        The drain compiler (kubernetes_tpu/compiler/) maps the pod mix to
+        spans: maximal same-signature runs collapse to closed-form top-L
+        assignment (run_uniform), group/mixed/host-port spans compile to
+        the plan program (run_plan) over the pow2 signature lattice,
+        same-signature group spans ride the merge wave (run_wave), gangs
+        dispatch all-or-nothing (run_gang), and only the fallback matrix
+        (overlays, mesh, short spans, beyond-lattice mixes) keeps the
+        per-pod scan. Each uniform record carries its input carry so
+        commit-time validation (rare failures: BalancedAllocation
+        non-monotonicity, depth-J overflow) can rewind and replay.
+        Returns (chain carry, [_RunRec])."""
         cfg = profile.score_config
-        if gang is not None:
-            # whole-gang drain: ONE all-or-nothing dispatch (ops/gang.py);
-            # `gang` is the remaining quorum (minCount - assigned members)
-            return self._dispatch_spans(cfg, na, batch, table,
-                                        [(0, n, ("gang", int(gang)))], carry)
-        if groups_needed and ovl is None and nom is None:
-            wave = self._classify_wave(batch, n)
-            if wave is not None:
-                # speculative wave placement: the whole drain is one
-                # conflict-checked device dispatch against the resident
-                # carry (host greedy stays as the no-device fallback)
-                return self._dispatch_spans(cfg, na, batch, table,
-                                            [(0, n, wave)], carry)
-        # nom != None → some drain pod needs per-pod self-exclusion, which
-        # the closed-form uniform path cannot express: scan the drain
-        fast_ok = (self.mesh is None and nom is None
-                   and self.feature_gates.enabled("OpportunisticBatching")
-                   and not groups_needed and cfg.strategy == "LeastAllocated"
-                   and not self._cluster_has_prefer_taints())
-        if not fast_ok:
-            spans = [(0, n, ("scan",))]
-        else:
-            spans = [(i, j, ("uniform",) if uniform else ("scan",))
-                     for (i, j, uniform) in self._classify_runs(batch, n)]
-        if not groups_needed and ovl is None and nom is None:
-            # non-interacting signatures in a single wave: an alternating
-            # multi-signature stretch thrashes the scan's one-slot
-            # signature cache (full kernel recompute per step) — the LEAN
-            # wavescan evaluates each signature's surfaces once instead
-            spans = [self._lean_wave_span(batch, s) for s in spans]
-        return self._dispatch_spans(cfg, na, batch, table, spans, carry,
-                                    ovl=ovl, nom=nom)
-
-    def _lean_wave_span(self, batch, span):
-        """Upgrade an eligible scan span of a group-free drain to the lean
-        wavescan; anything ineligible keeps its kind."""
-        i, j, kind = span
-        if (kind[0] != "scan" or not self._wave_enabled()
-                or j - i < self.wave_min_span):
-            return span
-        sig = batch.sig[i:j]
-        if (sig == 0).any() or not batch.valid[i:j].all():
-            return span
-        uniq = list(dict.fromkeys(int(t) for t in batch.tidx[i:j]))
-        if len(uniq) > 16:
-            return span
-        return (i, j, ("wavescan", tuple(uniq)))
+        plan = self.compiler.compile_drain(
+            batch, n, groups_needed=groups_needed, gang_needed=gang,
+            overlay=ovl is not None, nominated=nom is not None,
+            mesh=self.mesh is not None, strategy=cfg.strategy,
+            prefer_taints=self._cluster_has_prefer_taints(),
+            wave_min_span=self.wave_min_span,
+            uniform_min=self.UNIFORM_RUN_MIN)
+        return self._dispatch_spans(cfg, na, batch, table, plan.spans,
+                                    carry, ovl=ovl, nom=nom)
 
     def _uniform_shape(self, na) -> tuple[int, int, int]:
         """(L, K, J) for run_uniform, chosen to be STABLE across drains:

@@ -115,6 +115,14 @@ class ClusterState:
     # scheduler's mesh-sharded copy — can invalidate without sharing the
     # single-device cache's consume-on-read flag
     staging_gen: int = 0
+    # monotonic generation of the STATIC node columns only (valid, name,
+    # labels, taints, images, capacity — everything the carry-independent
+    # signature surfaces read): bumped by full row writes, row
+    # invalidations and shape growth, but NOT by the per-commit aggregate
+    # updates (used/npods/ports) that dominate steady-state drains. The
+    # compiler's per-signature SurfaceCache keys on this, so hoisted
+    # surfaces survive every placement-only generation bump.
+    statics_gen: int = 0
     # name → the Node object whose static fields row `name` reflects
     # (strong refs: identity comparison is only safe while we hold them)
     _row_node: dict = field(default_factory=dict)
@@ -146,6 +154,7 @@ class ClusterState:
         if self.arrays is not None:
             self.arrays = _pad_rows(self.arrays, self.dims.nodes)
             self.staging_gen += 1
+            self.statics_gen += 1   # [N]-shaped surfaces are stale
 
     def node_id(self, name: str) -> int:
         """Interned id used for NodeName filter / matchFields."""
@@ -181,6 +190,7 @@ class ClusterState:
                     self.arrays.valid[idx] = False
                     self.node_names[idx] = ""
                     self._free.append(idx)
+                    self.statics_gen += 1
         # write in snapshot-list order so freshly-assigned row indices track
         # the host iteration order (argmax tie-breaks then usually agree)
         dirty_writes = False
@@ -236,6 +246,9 @@ class ClusterState:
         a = self.arrays
         d = self.dims
         node = ni.node
+        # full row write touches the static columns: hoisted per-signature
+        # surfaces over this node axis must recompute
+        self.statics_gen += 1
         # resources
         cap_row = self.rtable.vector(ni.allocatable)
         used_row = self.rtable.vector(ni.requested)
@@ -317,12 +330,14 @@ class ClusterState:
                                      image_size=pad(a.image_size))
         self._device_dirty = True
         self.staging_gen += 1
+        self.statics_gen += 1
 
     def _grow_resources(self) -> None:
         self.dims.resources = self.rtable.width
         if self.arrays is not None:
             self.arrays = _pad_cols(self.arrays, self.dims)
             self.staging_gen += 1
+            self.statics_gen += 1
 
     def request_vector(self, requests: dict[str, int]):
         """Dense np.int64 request row at the CURRENT staging width, WITHOUT

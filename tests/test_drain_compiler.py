@@ -1,0 +1,439 @@
+"""Drain compiler (kubernetes_tpu/compiler/) — ISSUE 8 standing gates.
+
+The compiler maps ANY pod mix to a static device program; this suite
+holds its exactness and its plumbing:
+
+* seeded fuzz over >4-signature mixed drains — 8/12/16 INTERACTING
+  signatures, group + group-free + host-port rows — with bit parity
+  between the plan program (run_plan) and the oracle-verified scan
+  (run_batch), plus a direct triangle against the host oracle framework;
+* scheduler-level: a 16-signature group-free mixed drain executes as
+  compiled device dispatches with ZERO host-greedy fallbacks; gang +
+  group + plain traffic in one queue drain stays bit-identical to the
+  reference (gates-off) path;
+* the pad-bucket lattice at a pow2 edge (exactly 8 signatures vs 9);
+* SurfaceCache generation-diff retention: steady-state drains no longer
+  clear the per-signature surfaces (the scheduler.py:1661 fix);
+* plan-cache metrics + a transfer-guard gate run (rails on, ambient
+  jax.transfer_guard("disallow"), zero fallbacks).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.analysis.rails import GLOBAL as RAILS
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.compiler import PLAN_MAX_SIGS, DrainCompiler
+from kubernetes_tpu.ops.groups import to_device
+from kubernetes_tpu.ops.hostgreedy import static_norm_ok
+from kubernetes_tpu.ops.program import (ScoreConfig, WaveXs, initial_carry,
+                                        pod_rows_from_batch, run_batch,
+                                        run_plan)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState, pow2_at_least
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def _setup(nodes, existing):
+    cache = Cache()
+    for nd in nodes:
+        cache.add_node(nd)
+    for pod, node_name in existing:
+        pod.spec.node_name = node_name
+        cache.add_pod(pod)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    return state, snap
+
+
+def _nodes(n, zones, cpu=16, pods=40):
+    return [(make_node(f"n{i}")
+             .capacity({"cpu": cpu, "memory": "32Gi", "pods": pods})
+             .zone(f"z{i % zones}")
+             .label(HOSTNAME, f"n{i}").obj()) for i in range(n)]
+
+
+def plan_vs_scan(nodes, existing, pods, cfg=ScoreConfig()):
+    """Assert the plan program reproduces run_batch's assignments exactly
+    for the FULL mixed drain (any signature count, host-port rows
+    included); returns the assignments."""
+    state, snap = _setup(nodes, existing)
+    builder = BatchBuilder(state)
+    batch = builder.build(pods)
+    assert not batch.host_fallback.any(), "fuzz pods must be tensorizable"
+    gd_np, gc_np = builder.groups.build_dev(snap)
+    gd, gc = to_device(gd_np), to_device(gc_np)
+    na = state.device_arrays()
+    xs, table = pod_rows_from_batch(batch)
+    fam = builder.groups.families(snap)
+    n = len(pods)
+
+    _, scan_out = run_batch(cfg, na, initial_carry(na, gc), xs, table,
+                            groups=gd, fam=fam)
+    scan_out = np.asarray(scan_out)[:n]
+
+    uniq = list(dict.fromkeys(int(t) for t in batch.tidx[:n]))
+    has_ports = bool((batch.sig[:n] == 0).any())
+    norm_live = not all(
+        static_norm_ok(state.ensure_arrays(), builder.table.pref_weight[u])
+        for u in uniq)
+    B = pow2_at_least(n)
+    S = pow2_at_least(len(uniq), 2)
+    assert S <= PLAN_MAX_SIGS
+    wt_list = (uniq + [uniq[-1]] * S)[:S]
+    slot = {}
+    for s, u in enumerate(wt_list):
+        slot.setdefault(u, s)
+    widx = np.zeros((B,), np.int32)
+    for k in range(n):
+        widx[k] = slot[int(batch.tidx[k])]
+    widx[n:] = widx[n - 1]
+    valid = np.zeros((B,), bool)
+    valid[:n] = True
+    compiler = DrainCompiler(state=state, builder=builder, gates=_GATES)
+    statics = compiler.surfaces.stacked(na, table, tuple(wt_list))
+    wxs = WaveXs(valid=jnp.asarray(valid), widx=jnp.asarray(widx))
+    _, packed = run_plan(
+        cfg, na, initial_carry(na, gc), wxs, table,
+        jnp.asarray(np.array(wt_list, np.int32)), gd, statics, fam,
+        norm_live, has_groups=True, has_ports=has_ports)
+    plan_out = np.asarray(packed)[:n]
+    assert (plan_out == scan_out).all(), (
+        "run_plan diverged", len(uniq), scan_out.tolist(),
+        plan_out.tolist())
+    return scan_out
+
+
+class _Gates:
+    def enabled(self, name):
+        return name != "SanitizerRails"
+
+
+_GATES = _Gates()
+
+
+def _mixed_pods(rng: random.Random, idx: int, n_sigs: int, n_pods: int,
+                with_ports=False):
+    """`n_sigs` INTERACTING signatures in one drain: a shared spread
+    group over rotating cpu requests, an anti-affinity family, plain
+    rows, optionally a host-port signature."""
+    pods = []
+    kinds = []
+    for s in range(n_sigs):
+        cpu = f"{200 + 75 * s}m"
+        r = s % 3
+        if r == 0:
+            kinds.append(lambda i, s=s, cpu=cpu: (
+                make_pod(f"sp{idx}_{s}_{i}")
+                .req({"cpu": cpu, "memory": "512Mi"})
+                .label("app", "mix")
+                .spread_constraint(rng.choice([2, 5]), ZONE,
+                                   "DoNotSchedule", {"app": "mix"})
+                .obj()))
+        elif r == 1:
+            kinds.append(lambda i, s=s, cpu=cpu: (
+                make_pod(f"an{idx}_{s}_{i}")
+                .req({"cpu": cpu, "memory": "256Mi"})
+                .label("anti", "y")
+                .pod_affinity(ZONE, {"anti": "y"}, anti=True)
+                .obj()))
+        else:
+            kinds.append(lambda i, s=s, cpu=cpu: (
+                make_pod(f"pl{idx}_{s}_{i}")
+                .req({"cpu": cpu, "memory": "128Mi"})
+                .obj()))
+    if with_ports:
+        kinds[-1] = lambda i: (
+            make_pod(f"pt{idx}_{i}")
+            .req({"cpu": "150m", "memory": "128Mi"})
+            .host_port(9000 + idx)
+            .obj())
+    for i in range(n_pods):
+        pods.append(kinds[i % len(kinds)](i))
+    return pods
+
+
+@pytest.mark.parametrize("block", range(4))
+def test_high_signature_fuzz(block):
+    """≥40 seeded scenarios of 8/12/16 interacting signatures (groups +
+    group-free + host-port rows interleaved): run_plan ≡ the
+    oracle-verified scan, bit for bit."""
+    rng = random.Random(7000 + block)
+    for k in range(10):
+        idx = block * 10 + k
+        n_sigs = rng.choice([8, 12, 16])
+        n_pods = rng.randint(max(n_sigs, 16), 40)
+        nodes = _nodes(rng.choice([9, 12, 16]), rng.choice([3, 4]),
+                       cpu=rng.choice([16, 24]))
+        with_ports = rng.random() < 0.3
+        pods = _mixed_pods(rng, idx, n_sigs, n_pods, with_ports=with_ports)
+        plan_vs_scan(nodes, [], pods)
+
+
+def test_pad_bucket_boundary():
+    """Signature count exactly AT a pow2 edge (8 → lattice 8) and one
+    past it (9 → lattice 16): both exact, and the padded lattice width
+    is what the compiler promises."""
+    rng = random.Random(42)
+    nodes = _nodes(12, 4, cpu=32)
+    for n_sigs, expect_s in ((8, 8), (9, 16)):
+        pods = _mixed_pods(rng, 100 + n_sigs, n_sigs, 36)
+        plan_vs_scan(nodes, [], pods)
+        assert pow2_at_least(n_sigs, 2) == expect_s
+
+
+def test_plan_vs_host_oracle_direct():
+    """Close the triangle: an 8-signature mixed drain against the actual
+    host oracle framework (verdicts AND placements), not just the scan."""
+    from kubernetes_tpu.framework.interface import CycleState
+    from kubernetes_tpu.framework.runtime import schedule_pod
+    from kubernetes_tpu.framework.types import FitError
+    from tests.test_groups_parity import full_framework
+
+    rng = random.Random(11)
+    nodes = _nodes(9, 3)
+    pods = _mixed_pods(rng, 0, 8, 24)
+    out = plan_vs_scan(nodes, [], pods)
+
+    cache = Cache()
+    for nd in nodes:
+        cache.add_node(nd)
+    fwk = full_framework()
+    snap = Snapshot()
+    for i, pod in enumerate(pods):
+        cache.update_snapshot(snap)
+        try:
+            result = schedule_pod(fwk, CycleState(), pod,
+                                  snap.node_info_list)
+            chosen = result.suggested_host
+        except FitError:
+            chosen = None
+        if out[i] < 0:
+            assert chosen is None, (i, chosen)
+        else:
+            assert chosen == f"n{out[i]}", (i, chosen, out[i])
+            cache.add_pod(pod.with_node_name(chosen))
+
+
+def _mk_sched(nodes=16, zones=4, cpu=32, **kw):
+    api = APIServer()
+    sched = Scheduler(api, batch_size=64, **kw)
+    sched.wave_min_span = 4
+    for nd in _nodes(nodes, zones, cpu=cpu, pods=80):
+        api.create_node(nd)
+    sched.prime()
+    return api, sched
+
+
+class TestSchedulerPlans:
+    def test_16_sig_group_free_zero_host_greedy(self):
+        """Acceptance: a group-free mixed drain with 16 distinct
+        signatures executes as compiled device dispatches — zero
+        _try_host_greedy fallbacks, zero host-path pods, every span a
+        plan program."""
+        api, sched = _mk_sched()
+        for i in range(48):
+            k = i % 16
+            api.create_pod(make_pod(f"p{i}")
+                           .req({"cpu": f"{100 + 25 * k}m",
+                                 "memory": "128Mi"}).obj())
+        assert sched.schedule_pending() == 48
+        assert sched.host_greedy_runs == 0
+        assert sched.host_scheduled == 0
+        assert sched.device_fallbacks == 0
+        kinds = [tuple(e["kinds"]) for e in sched.flight.dump()]
+        assert any("wavescan" in k for k in kinds), kinds
+        assert not any("scan" in k for k in kinds), kinds
+        assert sched.reconcile() == []
+
+    def test_16_sig_interacting_group_drain_compiles(self):
+        """The >4-signature cliff itself: 16 INTERACTING signatures
+        (shared spread group) run as ONE plan dispatch, not the per-pod
+        scan, with exact cache bookkeeping."""
+        api, sched = _mk_sched()
+        for i in range(48):
+            k = i % 16
+            api.create_pod(make_pod(f"p{i}")
+                           .req({"cpu": f"{100 + 25 * k}m",
+                                 "memory": "128Mi"})
+                           .label("app", "mix")
+                           .spread_constraint(5, ZONE, "DoNotSchedule",
+                                              {"app": "mix"}).obj())
+        assert sched.schedule_pending() == 48
+        assert sched.host_greedy_runs == 0
+        kinds = [tuple(e["kinds"]) for e in sched.flight.dump()]
+        assert any(k == ("wavescan",) for k in kinds), kinds
+        assert sched.reconcile() == []
+        from kubernetes_tpu.perf.ledger import GLOBAL as LEDGER
+        assert "run_plan" in LEDGER.kernels
+
+    def test_gate_parity_high_signature_mixed(self):
+        """Plan execution ≡ the reference path: the same 12-signature
+        group+plain traffic with the wave/batching gates off binds every
+        pod to the identical node."""
+        def run(wave_on):
+            api, sched = _mk_sched()
+            sched.feature_gates.set("SpeculativeWavePlacement", wave_on)
+            rng = random.Random(5)
+            for i, p in enumerate(_mixed_pods(rng, 1, 12, 60)):
+                api.create_pod(p)
+                if i % 30 == 29:
+                    sched.schedule_pending(wait=False)
+            sched.schedule_pending()
+            return {p.metadata.name: p.spec.node_name
+                    for p in api.pods.values()}
+
+        assert run(True) == run(False)
+
+    def test_gang_group_plain_one_drain_parity(self):
+        """Gang + group + plain rows arriving together: the gang extracts
+        into its all-or-nothing dispatch, the rest compiles into plan
+        spans — end state identical to the reference Permit-barrier path
+        (all device tiers off)."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
+
+        def run(device_on):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=128)
+            sched.wave_min_span = 4
+            if not device_on:
+                sched.feature_gates.set("SpeculativeWavePlacement", False)
+                sched.feature_gates.set("GangDevicePlacement", False)
+                sched.gang_device_enabled = False
+            for nd in _nodes(16, 4, cpu=32, pods=80):
+                api.create_node(nd)
+            sched.prime()
+            api.create_workload(Workload(
+                metadata=ObjectMeta(name="gangA"),
+                pod_groups=[PodGroup(name="workers", min_count=8)]))
+            pods = []
+            for i in range(8):
+                pods.append(make_pod(f"g{i}")
+                            .req({"cpu": "500m", "memory": "128Mi"})
+                            .workload("gangA").obj())
+            rng = random.Random(9)
+            pods += _mixed_pods(rng, 3, 8, 24)
+            for p in pods:
+                api.create_pod(p)
+            sched.schedule_pending()
+            return {p.metadata.name: p.spec.node_name
+                    for p in api.pods.values()}
+
+        on = run(True)
+        off = run(False)
+        assert on == off
+        # the gang itself must bind whole (quorum 8/8) on both paths;
+        # anti-affinity rows may legitimately exhaust their 4 domains
+        assert all(on[f"g{i}"] for i in range(8)), on
+
+    def test_plan_cache_hits_and_pad_waste(self):
+        """Identical drain structure → plan cache hit; the pad-waste
+        histogram observes every compile."""
+        api, sched = _mk_sched()
+        m = sched.metrics
+
+        def feed(prefix):
+            for i in range(24):
+                k = i % 8
+                api.create_pod(make_pod(f"{prefix}{i}")
+                               .req({"cpu": f"{100 + 25 * k}m",
+                                     "memory": "128Mi"}).obj())
+        feed("a")
+        assert sched.schedule_pending() == 24
+        misses0 = m.compiler_plan_cache_misses.value()
+        hits0 = m.compiler_plan_cache_hits.value()
+        assert misses0 > 0
+        feed("b")
+        assert sched.schedule_pending() == 24
+        assert m.compiler_plan_cache_hits.value() > hits0
+        assert m.compiler_plan_cache_misses.value() == misses0
+        assert m.compiler_pad_waste.count() > 0
+
+    def test_surface_cache_retained_across_commits(self):
+        """The scheduler.py:1661 fix: committed drains bump the staging
+        generation but NOT the statics generation — the per-signature
+        surfaces survive, so steady-state dispatches recompute none."""
+        api, sched = _mk_sched()
+
+        def feed(prefix):
+            for i in range(24):
+                k = i % 8
+                api.create_pod(make_pod(f"{prefix}{i}")
+                               .req({"cpu": f"{100 + 25 * k}m",
+                                     "memory": "128Mi"})
+                               .label("app", "mix")
+                               .spread_constraint(5, ZONE, "DoNotSchedule",
+                                                  {"app": "mix"}).obj())
+        feed("a")
+        assert sched.schedule_pending() == 24
+        sc = sched.compiler.surfaces
+        misses0 = sc.misses
+        # force a placement-only staging-generation bump (the carry
+        # adoption path) — exactly what cleared the old cache every drain
+        gen0 = sched.state.staging_gen
+        assert sched.reconcile() == []
+        assert sched.state.staging_gen > gen0
+        feed("b")
+        assert sched.schedule_pending() == 24
+        assert sc.misses == misses0                  # surfaces survived
+        assert sc.hits > 0
+        # a STATIC node change (cordon) must invalidate: correctness
+        # before retention
+        cordoned = (make_node("n0")
+                    .capacity({"cpu": 32, "memory": "32Gi", "pods": 80})
+                    .zone("z0").label(HOSTNAME, "n0")
+                    .unschedulable().obj())
+        api.update_node(cordoned)
+        feed("c")
+        sched.schedule_pending()
+        assert sc.misses > misses0
+
+
+class TestTransferGuardPlan:
+    @pytest.fixture()
+    def rails_off_after(self):
+        yield
+        RAILS.enable(False)
+
+    def test_high_sig_drain_under_ambient_disallow(self, rails_off_after):
+        """Transfer-guard gate: a steady >4-signature mixed drain —
+        surfaces hoisted lazily inside the dispatch region — completes
+        under ambient jax.transfer_guard("disallow") with zero
+        fallbacks."""
+        from kubernetes_tpu.config import KubeSchedulerConfiguration
+        cfg = KubeSchedulerConfiguration(
+            feature_gates={"SanitizerRails": True})
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64, config=cfg)
+        sched.wave_min_span = 4
+        for nd in _nodes(8, 2, cpu=32, pods=110):
+            api.create_node(nd)
+
+        def feed(prefix):
+            for i in range(32):
+                k = i % 8
+                api.create_pod(make_pod(f"{prefix}{i}")
+                               .req({"cpu": f"{100 + 25 * k}m",
+                                     "memory": "64Mi"})
+                               .label("app", "mix")
+                               .spread_constraint(5, ZONE,
+                                                  "ScheduleAnyway",
+                                                  {"app": "mix"}).obj())
+        feed("warm")
+        assert sched.schedule_pending() == 32
+        feed("steady")
+        with jax.transfer_guard("disallow"):
+            assert sched.schedule_pending() == 32
+        assert sched.device_fallbacks == 0
+        assert sched.host_scheduled == 0

@@ -53,6 +53,9 @@ NOISE = {
     "SchedulingPodAntiAffinity": 0.30,
     "PreemptionChurn": 0.30,
     "MixedSchedulingBasePod": 0.20,
+    # group-workload jitter applies (spread constraints live on every
+    # measured pod); the case lands in r07+
+    "MixedHighSignature": 0.30,
     "SchedulingNodeAffinity": 0.20,
     # group-workload gates for the gang suite (r06+): gang drains commit
     # in whole-gang lumps, so their per-window rates jitter like the
