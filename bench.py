@@ -239,11 +239,26 @@ def main() -> None:
     # every non-headline workload) had no first-class number
     summary = {}
     for key, entry in results.items():
+        hb = float(entry.get("host_build_s", 0.0))
+        dv = float(entry.get("device_s", 0.0))
+        cm = float(entry.get("commit_s", 0.0))
+        total = hb + dv + cm
         summary[key] = {
             "pods_per_s": entry["value"],
             "p50": entry.get("p50", 0), "p99": entry.get("p99", 0),
             "attempt_p50_ms": entry.get("attempt_p50_ms", 0.0),
             "attempt_p99_ms": entry.get("attempt_p99_ms", 0.0),
+            # host-phase shares of the drain cycle (ISSUE 9): what
+            # fraction of scheduler_drain_phase_seconds Python still owns.
+            # host_share = (host_build + commit) / cycle is the columnar
+            # ingest engine's regression contract — tools/bench_compare.py
+            # gates a >10% relative regression of it per workload.
+            "phase_pct": {
+                "host_build": round(100.0 * hb / total, 1) if total else 0.0,
+                "device": round(100.0 * dv / total, 1) if total else 0.0,
+                "commit": round(100.0 * cm / total, 1) if total else 0.0,
+            },
+            "host_share": round((hb + cm) / total, 4) if total else 0.0,
         }
 
     if not small and not case_filter:

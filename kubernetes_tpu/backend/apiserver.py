@@ -68,6 +68,9 @@ class WatchHandlers:
     on_update: Optional[Callable] = None
     on_delete: Optional[Callable] = None
     on_add_bulk: Optional[Callable] = None
+    # optional batch form consumed by bind_all (the bulk Binding echo) —
+    # semantically equivalent to per-pod on_update calls in order
+    on_update_bulk: Optional[Callable] = None
 
 
 @dataclass
@@ -212,7 +215,7 @@ class APIServer:
         store = self.pods
         nodes = self.nodes
         for pod, original in pairs:
-            uid = pod.uid
+            uid = pod.metadata.uid
             current = store.get(uid)
             node_name = pod.spec.node_name
             if current is None:
@@ -232,6 +235,10 @@ class APIServer:
             updates.append((current, new))
         self.binding_count += len(updates)
         for h in self.pod_handlers:
+            bulk = getattr(h, "on_update_bulk", None)
+            if bulk is not None:
+                bulk(updates)
+                continue
             cb = h.on_update
             if cb:
                 for old, new in updates:

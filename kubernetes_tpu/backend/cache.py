@@ -179,6 +179,42 @@ class Cache:
         self._add_pod_to_node(pod)
         self.pod_states[uid] = _PodState(pod=pod)
 
+    def confirm_bound(self, pods: list) -> None:
+        """Bulk bind-echo confirm (the columnar commit engine's informer
+        path): each pod was assumed on the node it just bound to, so the
+        add_pod() assumed-branch reduces to flipping the existing
+        _PodState in place — no relocation, no fresh state object. Pods
+        that do not match the fast shape (not assumed, or bound
+        elsewhere) take the full add_pod path."""
+        states = self.pod_states
+        assumed = self.assumed_pods
+        for pod in pods:
+            uid = pod.metadata.uid
+            ps = states.get(uid)
+            if (ps is None or not ps.assumed
+                    or ps.pod.spec.node_name != pod.spec.node_name):
+                self.add_pod(pod)
+                continue
+            assumed.discard(uid)
+            ps.pod = pod
+            ps.assumed = False
+            ps.binding_finished = False
+            ps.deadline = None
+
+    def add_pods(self, pods: list) -> None:
+        """Bulk informer add of assigned pods (the resync/relist path):
+        per-pod `add_pod` semantics with the state probes hoisted."""
+        states = self.pod_states
+        for pod in pods:
+            uid = pod.metadata.uid
+            ps = states.get(uid)
+            if ps is not None:
+                if ps.assumed:
+                    self.add_pod(pod)   # assumed-confirm/relocate path
+                continue
+            self._add_pod_to_node(pod)
+            states[uid] = _PodState(pod=pod)
+
     def update_pod(self, old: Pod, new: Pod) -> None:
         ps = self.pod_states.get(old.uid)
         if ps is None or ps.assumed:

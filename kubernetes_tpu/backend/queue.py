@@ -60,6 +60,13 @@ class _Heap:
         self._items: dict[str, object] = {}
         self._versions: dict[str, int] = {}  # stale-entry detection
         self._heap: list = []
+        # adds land here first (key, version, item) and only reach the
+        # real heap when an ordered read (peek/pop) needs them: the TPU
+        # drain path consumes the whole queue via pop_sorted, which never
+        # orders through the heap — deferring the heappush turns the
+        # ingest hot path's per-pod O(log n) wrapper push into a list
+        # append that is usually thrown away wholesale
+        self._staged: list = []
         self._counter = itertools.count()
 
     def __len__(self) -> int:
@@ -72,8 +79,20 @@ class _Heap:
         version = self._versions.get(key, 0) + 1
         self._versions[key] = version
         self._items[key] = item
-        heapq.heappush(self._heap,
-                       (_Less(item, self.less), next(self._counter), key, version))
+        self._staged.append((key, version, item))
+
+    def _flush_staged(self) -> None:
+        """Move staged adds into the real heap (ordered-read barrier).
+        Flush order preserves insertion order, so the tie-break counter
+        assigns the same relative order an eager push would have."""
+        heap = self._heap
+        versions = self._versions
+        items = self._items
+        for key, version, item in self._staged:
+            if versions.get(key) == version and items.get(key) is item:
+                heapq.heappush(heap, (_Less(item, self.less),
+                                      next(self._counter), key, version))
+        self._staged.clear()
 
     def add(self, key: str, item) -> None:
         self._push(key, item)
@@ -92,11 +111,14 @@ class _Heap:
         if not self._items:
             self._heap.clear()
             self._versions.clear()
+            self._staged.clear()
 
     def get(self, key: str):
         return self._items.get(key)
 
     def peek(self):
+        if self._staged:
+            self._flush_staged()
         while self._heap:
             wrapped, _, key, version = self._heap[0]
             if key not in self._items or self._versions.get(key) != version:
@@ -106,6 +128,8 @@ class _Heap:
         return None
 
     def pop(self):
+        if self._staged:
+            self._flush_staged()
         while self._heap:
             wrapped, _, key, version = heapq.heappop(self._heap)
             if key not in self._items or self._versions.get(key) != version:
@@ -115,6 +139,7 @@ class _Heap:
             if not self._items:
                 self._heap.clear()
                 self._versions.clear()
+                self._staged.clear()
             return item
         return None
 
@@ -136,6 +161,7 @@ class _Heap:
             self._items.clear()
             self._versions.clear()
             self._heap.clear()
+            self._staged.clear()
         return [it for _, it in take]
 
     def items(self):
@@ -312,7 +338,7 @@ class SchedulingQueue:
                     self._index_gated(pod)
                     gated += 1
                     continue
-            active_add(pod.uid, qpi)
+            active_add(pod.metadata.uid, qpi)
             if pod.status.nominated_node_name:
                 nominator_add(qpi)
         return gated
@@ -338,6 +364,7 @@ class SchedulingQueue:
             existing = heap_.get(uid)
             if existing is not None:
                 existing.pod_info = PodInfo.of(new)
+                existing.pod = new
                 heap_.update(uid, existing)
                 return
         existing = self.unschedulable_pods.get(uid)
@@ -346,6 +373,7 @@ class SchedulingQueue:
             if was_gated:
                 self._unindex_gated(existing.pod)
             existing.pod_info = PodInfo.of(new)
+            existing.pod = new
             # updated pods get re-evaluated (scheduling_queue.go Update:
             # spec change may make it schedulable)
             del self.unschedulable_pods[uid]
@@ -480,7 +508,8 @@ class SchedulingQueue:
         unconditionally."""
         if event.resource == EventResource.WILDCARD:
             return not qpi.gated
-        rejectors = qpi.unschedulable_plugins | qpi.pending_plugins
+        up, pp = qpi.unschedulable_plugins, qpi.pending_plugins
+        rejectors = (up | pp) if (up and pp) else (up or pp)
         if not rejectors:
             return True
         for plugin in rejectors:

@@ -79,6 +79,11 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # backends, NaN/inf score probes. For tests, soaks and staging —
     # not the production hot path.
     "SanitizerRails": FeatureSpec(False, ALPHA),
+    # columnar ingest & commit engine (kubernetes_tpu/ingest/): the
+    # batched assume/bind path (CommitEngine) + the bulk bind-echo
+    # confirm. Off = the serial per-pod _fast_commit / per-pod informer
+    # fan-out — the parity oracle tests/test_ingest.py compares against.
+    "ColumnarIngest": FeatureSpec(True, BETA),
 }
 
 

@@ -1,0 +1,34 @@
+"""Columnar ingest & commit engine (ISSUE 9 / ROADMAP item 1).
+
+The device path compiles any pod mix into one static program (PR 8), so
+Python owns the SchedulingBasic cycle: ~60% of it was pod ingest + commit
+— per-pod object walks on both edges of a drain. This package replaces
+those edges with columnar, vectorized host pipelines:
+
+- `columns.py` — vectorized signature tensorize: `fill_rows` turns
+  `BatchBuilder._fill_row`'s per-pod field walks into numpy batch ops
+  over pre-extracted column lists (one write per PodTable column per
+  chunk, bit-for-bit equal to the serial filler), plus the per-row
+  `CommitFacts` column the commit engine consumes (requests / nonzero /
+  port / affinity facts hoisted per signature instead of re-derived per
+  pod at commit).
+- `noderows.py` — columnar node-row tensorize: `write_rows` batches
+  `ClusterState._write_row`'s ~20 scalar array stores per node into one
+  scatter per NodeArrays field (prime/resync/mass-update path).
+- `commit.py` — the batched assume/bind path: one pass over a resolved
+  drain doing the columnar cache assume (inlined NodeInfo bookkeeping
+  driven by CommitFacts), one bulk dispatcher enqueue, and the bulk
+  bind-echo confirm (`Scheduler._on_pod_update_bulk`) that collapses the
+  per-pod informer fan-out after a bulk bind.
+- `groupcols.py` — per-statics-generation columnar node label store
+  (interned topology-value / domain-id vectors) and the vectorized
+  id→count gather that rebuilt `GroupManager.build_dev` seeding without
+  its O(nodes)-per-signature Python walks.
+
+The snapshot edge (generation-diff device scatter: upload only dirty
+node rows via the `scatter_rows` JIT entry) lives in
+`state/tensorize.py` + `ops/program.py`; this package holds the host
+columnar machinery.
+"""
+
+from .columns import CommitFacts, commit_facts_for_row, fill_rows  # noqa: F401

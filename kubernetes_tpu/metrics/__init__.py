@@ -377,6 +377,18 @@ class SchedulerMetrics:
             "plan's device programs (pow2 pod buckets x pow2 signature "
             "lattice): 1 - real/padded.",
             buckets=[0.0, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0]))
+        # columnar ingest & commit engine (kubernetes_tpu/ingest/):
+        # generation-diff snapshot upload traffic — scattered rows vs
+        # full matrix re-uploads (the 50k/s-vs-upload-bound split)
+        self.ingest_rows_scattered = r.register(Counter(
+            n + "ingest_rows_scattered_total",
+            "Dirty node rows shipped to the device via the generation-"
+            "diff scatter_rows entry instead of a full NodeArrays "
+            "re-upload."))
+        self.ingest_full_uploads = r.register(Counter(
+            n + "ingest_full_uploads_total",
+            "Full NodeArrays device uploads (first build, shape growth, "
+            "or a dirty-row set too large for the incremental scatter)."))
         self.drain_phase = r.register(Histogram(
             n + "drain_phase_seconds",
             "Per-drain wall time by phase: host_build (snapshot + batch "
@@ -434,6 +446,8 @@ class SchedulerMetrics:
         self.gang_quorum_wait.seed()
         self.compiler_plan_cache_hits.inc(by=0)
         self.compiler_plan_cache_misses.inc(by=0)
+        self.ingest_rows_scattered.inc(by=0)
+        self.ingest_full_uploads.inc(by=0)
         self.compiler_pad_waste.seed()
         self.wave_placement_waves.inc(by=0)
         self.wave_conflict_ratio.seed()

@@ -605,6 +605,7 @@ class GroupManager:
         from ..plugins.interpodaffinity import InterPodAffinity
         from ..plugins.podtopologyspread import PodTopologySpread
 
+        from ..ingest.groupcols import NodeLabelColumns
         self.state = state
         self.pts = spread_plugin or PodTopologySpread()
         self.ipa = ipa_plugin or InterPodAffinity()
@@ -612,6 +613,10 @@ class GroupManager:
         self.rows: list[Optional[GroupRowInfo]] = []
         self._alloc(table_rows)
         self.group_row_count = 0   # rows with any group constraints
+        # per-statics-generation columnar label views shared by node_data
+        # and seed_counts (ingest/groupcols.py): the per-call O(N) tv /
+        # dom / presence walks now run once per node-state change
+        self.cols = NodeLabelColumns(state)
 
     # -- storage --------------------------------------------------------------
 
@@ -827,60 +832,18 @@ class GroupManager:
         )
         if nis is None:
             nis = self._node_rows(snapshot)
-        order_idx = np.array([idx for idx, _ in nis], np.int64)
-
-        # per-CALL memos shared across every row and constraint: a topology
-        # key's interned tv vector is a property of the node set, not of
-        # the row, so the O(N) label walk runs once per distinct key
-        # instead of once per (row × constraint × term) — the reseed-cliff
-        # fix for many rows sharing zone/hostname keys.
-        tv_cache: dict[str, np.ndarray] = {}
-
-        def tv_vec(key: str) -> np.ndarray:
-            v = tv_cache.get(key)
-            if v is None:
-                v = np.zeros((N,), np.int32)
-                kid: dict[str, int] = {}
-                for idx, ni in nis:
-                    val = ni.node.metadata.labels.get(key)
-                    if val is not None:
-                        t = kid.get(val)
-                        if t is None:
-                            t = kid[val] = st.interner.label_kv(key, val)
-                        v[idx] = t
-                tv_cache[key] = v
-            return v
+        # persistent per-statics-generation columns (ingest/groupcols.py):
+        # a topology key's interned tv vector is a property of the node
+        # set, not of the row OR the call — the O(N) label walk now runs
+        # once per node-state change instead of once per build_dev call
+        # (the scheduler.py reseed/host-greedy/diagnosis sites all land
+        # here), and once it did run, every row/constraint/term shares it.
+        cols = self.cols.sync(nis)
+        tv_vec = cols.tv
+        dom_of_key = cols.dom
 
         def keys_ok_vec(keys: list[str]) -> np.ndarray:
-            ok = np.zeros((N,), bool)
-            ok[order_idx] = True
-            for k in keys:
-                ok &= tv_vec(k) != 0        # interned ids start at 1
-            return ok
-
-        dom_cache: dict[str, np.ndarray] = {}
-
-        def dom_vec(tvv: np.ndarray) -> np.ndarray:
-            """Dense domain id = row index of the FIRST node (in snapshot
-            order) sharing the tv — vectorized equivalent of the previous
-            per-node setdefault walk."""
-            dom = np.zeros((N,), np.int32)
-            if len(order_idx) == 0:
-                return dom
-            sub = tvv[order_idx]
-            uniq, first_pos = np.unique(sub, return_index=True)
-            first_row = order_idx[first_pos]
-            dom[order_idx] = first_row[np.searchsorted(uniq, sub)]
-            return dom
-
-        def dom_of_key(key: str) -> np.ndarray:
-            """Memoized dom_vec per topology key: the wave fold shares a
-            placement's count along its topology domain via these ids, so
-            every tv-valued tensor ships a dom companion."""
-            v = dom_cache.get(key)
-            if v is None:
-                v = dom_cache[key] = dom_vec(tv_vec(key))
-            return v
+            return cols.keys_ok(tuple(keys))
 
         def elig_vec(c, pod, keys: list[str]) -> np.ndarray:
             """Count-eligibility per node (common.go:43-57). The common
@@ -895,6 +858,7 @@ class GroupManager:
                              and pod.spec.affinity.node_affinity.required)))
             if trivial_affinity and c.node_taints_policy != HONOR:
                 return ok
+            ok = ok.copy()   # keys_ok vectors are cached: never mutate
             for idx, ni in nis:
                 if not ok[idx]:
                     continue
@@ -973,6 +937,14 @@ class GroupManager:
         node_list = snapshot.node_info_list
         if nis is None:
             nis = self._node_rows(snapshot)
+        # the count surfaces are still computed by the host plugins' own
+        # PreFilter/PreScore (shared-code parity contract, class doc) —
+        # but the per-NODE scatter of every count map now rides the
+        # columnar label store: one sorted-search gather over interned
+        # topology-value ids per (row, constraint/term) instead of an
+        # O(nodes) Python dict-probe walk per signature
+        from ..ingest.groupcols import gather_ids
+        cols = self.cols.sync(nis)
 
         for r, u in enumerate(rows):
             info = self.rows[u] if u < len(self.rows) else None
@@ -990,10 +962,9 @@ class GroupManager:
                         out["spr_f_min_zero"][r, j] = len(cnts) < c.min_domains
                         if not any(cnts.values()):
                             continue    # all-zero seed: the array is zeros
-                        for idx, ni in nis:
-                            v = ni.node.metadata.labels.get(c.topology_key)
-                            if v is not None:
-                                out["spr_f_cnt"][r, j, idx] = cnts.get(v, 0)
+                        out["spr_f_cnt"][r, j] = gather_ids(
+                            cols.tv(c.topology_key),
+                            cols.value_ids(c.topology_key, cnts), np.int32)
             # spread ScheduleAnyway counts: hostname keys per node, others
             # accumulated per topology value over count-eligible nodes
             for j, c in enumerate(info.s_constraints):
@@ -1017,55 +988,61 @@ class GroupManager:
                             ni.pods, c.selector, pod.namespace)
                 if not any(by_tv.values()):
                     continue
-                for idx, ni in nis:
-                    v = ni.node.metadata.labels.get(c.topology_key)
-                    if v is not None:
-                        out["spr_s_cnt"][r, j, idx] = by_tv.get(v, 0)
+                out["spr_s_cnt"][r, j] = gather_ids(
+                    cols.tv(c.topology_key),
+                    cols.value_ids(c.topology_key, by_tv), np.int32)
             # inter-pod affinity maps via the plugin's PreFilter. Empty
             # count maps (the common fresh-workload case) skip their
-            # per-node gather loops outright — the arrays are zeros.
+            # gathers outright — the arrays are zeros.
             cs = CycleState()
             self.ipa.pre_filter(cs, pod, node_list)
             s = cs.read_or_none(ipa_mod._PRE_FILTER_KEY)
             if s is not None:
                 out["ipa_a_total"][r] = sum(s.affinity_counts.values())
                 if s.existing_anti_affinity_counts:
-                    for idx, ni in nis:
-                        veto = 0
-                        for kv in ni.node.metadata.labels.items():
-                            veto += s.existing_anti_affinity_counts.get(kv, 0)
-                        out["ipa_veto"][r, idx] = veto
+                    # counts keyed (label key, value): a node contributes
+                    # each (k, v) it carries — per distinct k, one gather
+                    by_key: dict = {}
+                    for (lk, lv), c0 in \
+                            s.existing_anti_affinity_counts.items():
+                        by_key.setdefault(lk, {})[lv] = c0
+                    veto = out["ipa_veto"][r]
+                    for lk, vals in by_key.items():
+                        veto += gather_ids(cols.tv(lk),
+                                           cols.value_ids(lk, vals),
+                                           np.int32)
                 if s.affinity_counts:
-                    for idx, ni in nis:
-                        labels = ni.node.metadata.labels
-                        for t, term in enumerate(info.req_a):
-                            v = labels.get(term.topology_key)
-                            if v is not None:
-                                out["ipa_a_cnt"][r, t, idx] = \
-                                    s.affinity_counts.get(
-                                        (term.topology_key, v), 0)
+                    by_key = {}
+                    for (tk, tv), c0 in s.affinity_counts.items():
+                        by_key.setdefault(tk, {})[tv] = c0
+                    for t, term in enumerate(info.req_a):
+                        vals = by_key.get(term.topology_key)
+                        if vals:
+                            out["ipa_a_cnt"][r, t] = gather_ids(
+                                cols.tv(term.topology_key),
+                                cols.value_ids(term.topology_key, vals),
+                                np.int32)
                 if s.anti_affinity_counts:
-                    for idx, ni in nis:
-                        labels = ni.node.metadata.labels
-                        for t, term in enumerate(info.req_aa):
-                            v = labels.get(term.topology_key)
-                            if v is not None:
-                                out["ipa_aa_cnt"][r, t, idx] = \
-                                    s.anti_affinity_counts.get(
-                                        (term.topology_key, v), 0)
+                    by_key = {}
+                    for (tk, tv), c0 in s.anti_affinity_counts.items():
+                        by_key.setdefault(tk, {})[tv] = c0
+                    for t, term in enumerate(info.req_aa):
+                        vals = by_key.get(term.topology_key)
+                        if vals:
+                            out["ipa_aa_cnt"][r, t] = gather_ids(
+                                cols.tv(term.topology_key),
+                                cols.value_ids(term.topology_key, vals),
+                                np.int32)
             # symmetric score surface via the plugin's PreScore
             cs = CycleState()
             self.ipa.pre_score(cs, pod, node_list, all_nodes=node_list)
             ps = cs.read_or_none(ipa_mod._PRE_SCORE_KEY)
             if ps is not None and ps.topology_score:
-                for idx, ni in nis:
-                    labels = ni.node.metadata.labels
-                    total = 0
-                    for tk, tv_scores in ps.topology_score.items():
-                        v = labels.get(tk)
-                        if v is not None:
-                            total += tv_scores.get(v, 0)
-                    out["ipa_score"][r, idx] = total
+                score = out["ipa_score"][r]
+                for tk, tv_scores in ps.topology_score.items():
+                    score += gather_ids(cols.tv(tk),
+                                        cols.value_ids(tk, tv_scores),
+                                        np.int64)
         return out
 
     # -- assembly -------------------------------------------------------------

@@ -641,6 +641,27 @@ def diagnose_row(na: NodeArrays, table: PodTableDev, tidx: int,
                                 jnp.int32(tidx))
 
 
+@jax.jit
+def _scatter_rows_jit(dev: NodeArrays, idx, rows: NodeArrays) -> NodeArrays:
+    return NodeArrays(*(d.at[idx].set(r) for d, r in zip(dev, rows)))
+
+
+def scatter_rows(dev: NodeArrays, idx, rows: NodeArrays) -> NodeArrays:
+    """Generation-diff snapshot upload (ISSUE 9): scatter `rows` (one
+    gathered staging row per dirty node, [D, ...] with D a pow2 bucket;
+    duplicate indices carry identical rows) into the device-resident
+    NodeArrays at `idx` (i32 [D]). The H2D transfer is the rows — O(dirty
+    × row width) instead of the O(N × row width) full re-upload.
+
+    Deliberately NON-donating: the previous device copy was handed to
+    callers (in-flight drains hold it as `pd.na`; tests hold it across
+    mutations), so the entry must materialize fresh output buffers — the
+    on-device copy is cheap next to the tunnel transfer it saves."""
+    dev, idx, rows = RAILS.stage((dev, idx, rows))
+    return LEDGER.measured_call("scatter_rows", _scatter_rows_jit, dev,
+                                idx, rows)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _score_probe_jit(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
                      table: PodTableDev, tidx):

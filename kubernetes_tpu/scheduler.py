@@ -236,6 +236,10 @@ class _PendingDrain:
     # whole-gang drain (ops/gang.py): (workload ref, remaining quorum,
     # minCount) when this drain is one gang solved all-or-nothing
     gang: object = None
+    # the builder's per-row CommitFacts list at dispatch time (the list
+    # object is REPLACED on table reset, so this reference stays aligned
+    # with batch.tidx even when later drains reset the table)
+    facts: object = None
     gang_accepted: bool = False
     gang_raw: object = None      # raw per-member assignments (pre-unwind)
     gang_placed: int = 0
@@ -308,6 +312,9 @@ class Scheduler:
         from .config.features import default_gate
         self.feature_gates = default_gate(
             config.feature_gates if config is not None else None)
+        # columnar ingest & commit engine gate (kubernetes_tpu/ingest/):
+        # consulted by the event-handler wiring below and the commit path
+        self.columnar_ingest = self.feature_gates.enabled("ColumnarIngest")
         if config is not None:
             config.validate()
             from .config import build_profiles
@@ -395,6 +402,9 @@ class Scheduler:
             queue_depths=self._queue_depths,
             inflight=self._inflight_depths)
         self.dispatcher.metrics = self.metrics
+        # generation-diff upload counters (state/tensorize.py): the state
+        # layer counts, the registry exposes
+        self.state.metrics = self.metrics
         for prof in self.profiles.values():
             prof.framework.metrics = self.metrics
         from .backend.debugger import CacheDebugger
@@ -589,6 +599,12 @@ class Scheduler:
                                       builder=self.builder,
                                       gates=self.feature_gates,
                                       metrics=self.metrics)
+        # columnar ingest & commit engine (kubernetes_tpu/ingest/): the
+        # batched assume/bind path + the bulk bind-echo confirm; off
+        # restores the serial per-pod paths (parity-test oracle)
+        from .ingest.commit import CommitEngine
+        self.commit_engine = (CommitEngine(self)
+                              if self.columnar_ingest else None)
         # below this span length the per-pod scan beats a wave dispatch
         self.wave_min_span = 24
 
@@ -715,7 +731,9 @@ class Scheduler:
         self.client.watch_pods(WatchHandlers(
             on_add=self._on_pod_add, on_update=self._on_pod_update,
             on_delete=self._on_pod_delete,
-            on_add_bulk=self._on_pod_add_bulk))
+            on_add_bulk=self._on_pod_add_bulk,
+            on_update_bulk=(self._on_pod_update_bulk
+                            if self.columnar_ingest else None)))
         if hasattr(self.client, "watch_workloads"):
             self.client.watch_workloads(WatchHandlers(
                 on_add=self._on_workload_add))
@@ -850,6 +868,43 @@ class Scheduler:
                 # already re-ran PreEnqueue for the gated entry
                 self.queue.move_all_to_active_or_backoff_queue(
                     ClusterEvent(EventResource.POD, flags), old, new)
+
+    def _on_pod_update_bulk(self, pairs: list) -> None:
+        """Bulk Binding echo (apiserver.bind_all fan-out): the common
+        shape — our own bulk bind confirming pods we assumed — collapses
+        to one pass over the batch instead of the per-pod informer dance
+        (workload bookkeeping, a fresh _PodState, four queue dict probes
+        and a move_all sweep per pod). Anything off-shape, or any queue
+        state the per-pod path would consult (unschedulable pods whose
+        queueing hints need the individual pod, in-flight event logging,
+        pending bind errors), falls back to `_on_pod_update` per pod —
+        semantics stay identical by construction."""
+        q = self.queue
+        if (q.unschedulable_pods or q.in_flight_pods
+                or self._bind_errors or self._waiting_pods):
+            for old, new in pairs:
+                self._on_pod_update(old, new)
+            return
+        assumed = self.cache.assumed_pods
+        wm_update = self.workload_manager.update_pod
+        active = q.active_q
+        backoff = q.backoff_q
+        confirm: list = []
+        for old, new in pairs:
+            uid = new.metadata.uid
+            if (not new.spec.node_name or old.spec.node_name
+                    or uid not in assumed):
+                self._on_pod_update(old, new)
+                continue
+            wm_update(old, new)
+            confirm.append(new)
+            if uid in active or uid in backoff:
+                q.delete(new)
+        if confirm:
+            self.cache.confirm_bound(confirm)
+            # EVENT_ASSIGNED_POD_ADD move sweep: with no unschedulable
+            # pods and no in-flight event log (checked above) the per-pod
+            # move_all calls are no-ops — elided wholesale
 
     def _on_pod_delete(self, pod: Pod) -> None:
         self.workload_manager.delete_pod(pod)
@@ -1419,7 +1474,7 @@ class Scheduler:
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
             dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did,
-            gang=gang))
+            gang=gang, facts=self.builder.row_facts))
         return 0
 
     @contextmanager
@@ -1557,7 +1612,8 @@ class Scheduler:
         pd = _PendingDrain(qpis=qpis, profile=profile, batch=batch,
                            table=None, na=None, n=n, groups_needed=True,
                            records=[], dispatched_at=t0,
-                           drain_id=self._drain_seq)
+                           drain_id=self._drain_seq,
+                           facts=self.builder.row_facts)
         return self._commit_assignments(pd, out)
 
     def _node_arrays(self):
@@ -1945,15 +2001,30 @@ class Scheduler:
                         p.device_ctx.snapshot = self.snapshot
         self._bind_errors.clear()
         # LIST order matters: nodes before pods so bound pods land on real
-        # cache entries instead of imputed placeholders
+        # cache entries instead of imputed placeholders. The pod re-ingest
+        # rides the columnar bulk paths (cache.add_pods + queue.add_bulk —
+        # the same pipeline the ingest hot path uses), so watch-loss
+        # recovery scales with the columnar engine instead of paying the
+        # per-pod object walk O(all pods) the serial loop did.
         for node in list(self.client.nodes.values()):
             self.cache.add_node(node)
-        for pod in list(self.client.pods.values()):
-            self.workload_manager.add_pod(pod)
+        bound_pods: list[Pod] = []
+        unbound_pods: list[Pod] = []
+        wm_add = self.workload_manager.add_pod
+        for pod in self.client.pods.values():
+            wm_add(pod)
             if pod.spec.node_name:
-                self.cache.add_pod(pod)
+                bound_pods.append(pod)
             elif self._responsible(pod):
-                self.queue.add(pod)
+                unbound_pods.append(pod)
+        self.cache.add_pods(bound_pods)
+        if unbound_pods:
+            n_gated = self.queue.add_bulk(unbound_pods)
+            self.metrics.queue_incoming_pods.inc(
+                "active", "PodAdd", by=len(unbound_pods) - n_gated)
+            if n_gated:
+                self.metrics.queue_incoming_pods.inc("gated", "PodAdd",
+                                                     by=n_gated)
         self._invalidate_device_state()
         self.cache.update_snapshot(self.snapshot)
         # full=True: the fresh cache restarts its generation counters, so
@@ -2158,27 +2229,34 @@ class Scheduler:
             self.metrics.attempt_duration.observe(per_pod, UNSCHEDULABLE,
                                                   profile.name)
         names = self.state.node_names
-        fast: list[tuple[QueuedPodInfo, str]] = []
-        bound = 0
         diag_cache: dict = {}
-        failures: list[QueuedPodInfo] = []
         # an accepted gang commits atomically through the fast path: the
         # quorum the Permit barrier would enforce per pod was already
         # proven by the device verdict, so the Reserve/Permit chain is
         # vacuous (members with volumes/claims never reach a gang drain)
         gang_fast = pd.gang is not None and pd.gang_accepted
-        for i in range(n):
-            a = out[i]
-            qpi = qpis[i]
-            if a < 0:
-                failures.append(qpi)
-                continue
-            if not gang_fast and _needs_per_pod_hooks(profile, qpi.pod.spec):
-                self._assume_and_bind(qpi, names[int(a)])
-                bound += 1
-            else:
-                fast.append((qpi, names[int(a)]))
-        bound += self._fast_commit(fast, profile)
+        if self.commit_engine is not None:
+            # columnar commit engine (ingest/commit.py): one pass, the
+            # cache assume driven by the per-signature commit facts
+            bound, failures = self.commit_engine.commit(pd, out, names,
+                                                        gang_fast)
+        else:
+            fast: list[tuple[QueuedPodInfo, str]] = []
+            bound = 0
+            failures = []
+            for i in range(n):
+                a = out[i]
+                qpi = qpis[i]
+                if a < 0:
+                    failures.append(qpi)
+                    continue
+                if not gang_fast and _needs_per_pod_hooks(profile,
+                                                          qpi.pod.spec):
+                    self._assume_and_bind(qpi, names[int(a)])
+                    bound += 1
+                else:
+                    fast.append((qpi, names[int(a)]))
+            bound += self._fast_commit(fast, profile)
         # every device batch evaluates every kernel-modeled filter/score
         # plugin for every pod (PluginEvaluationTotal,
         # instrumented_plugins.go:83 — batch-granular here)
@@ -2230,7 +2308,8 @@ class Scheduler:
         if klog.v(5).enabled and failures:
             for qpi in failures:
                 klog.v(5).info("unschedulable", pod=qpi.pod.uid,
-                               plugins=sorted(qpi.unschedulable_plugins))
+                               plugins=sorted(qpi.unschedulable_plugins
+                                              or ()))
         return bound
 
     def _fail_rejected_gang(self, pd: _PendingDrain, qpis: list,

@@ -126,6 +126,23 @@ class ClusterState:
     # name → the Node object whose static fields row `name` reflects
     # (strong refs: identity comparison is only safe while we hold them)
     _row_node: dict = field(default_factory=dict)
+    # generation-diff device upload (ISSUE 9): row indices written since
+    # the device copy was last refreshed. When the set is small and no
+    # shape moved, device_arrays() scatters ONLY these rows through the
+    # scatter_rows JIT entry instead of re-uploading the full matrices;
+    # None = tracking lost (fall back to a full upload).
+    _dirty_rows: Optional[set] = field(default_factory=set)
+    # counters mirrored into scheduler metrics by the owner (the state
+    # layer must not import the metrics registry)
+    rows_scattered_total: int = 0
+    full_uploads_total: int = 0
+    # scatter only when dirty rows ≤ max(N >> scatter_shift, 32): beyond
+    # that the full upload's one big copy beats many-row gathers
+    scatter_shift: int = 3
+    # optional SchedulerMetrics, wired by the owning Scheduler (the
+    # state layer never imports the registry): ingest_rows_scattered /
+    # ingest_full_uploads mirror the two counters above
+    metrics: object = None
     # (id(snapshot), generation, tree_generation) of the last fully
     # consumed apply_snapshot: an unchanged snapshot skips the O(N) walk
     # entirely (the preemption path applies per failed pod)
@@ -155,6 +172,7 @@ class ClusterState:
             self.arrays = _pad_rows(self.arrays, self.dims.nodes)
             self.staging_gen += 1
             self.statics_gen += 1   # [N]-shaped surfaces are stale
+            self._dirty_rows = None  # shape moved: full upload
 
     def node_id(self, name: str) -> int:
         """Interned id used for NodeName filter / matchFields."""
@@ -191,9 +209,17 @@ class ClusterState:
                     self.node_names[idx] = ""
                     self._free.append(idx)
                     self.statics_gen += 1
+                    # the cleared valid bit must reach the device even
+                    # when no other row was written this apply
+                    self._device_dirty = True
+                    self.staging_gen += 1
+                    if self._dirty_rows is not None:
+                        self._dirty_rows.add(idx)
         # write in snapshot-list order so freshly-assigned row indices track
         # the host iteration order (argmax tie-breaks then usually agree)
         dirty_writes = False
+        full_items: list = []
+        agg_items: list = []
         for ni in snapshot.node_info_list:
             prev_gen = self.row_gen.get(ni.name)
             if not full and prev_gen == ni.generation:
@@ -208,12 +234,26 @@ class ClusterState:
             # aggregate update.
             if (not full and prev_gen is not None
                     and self._row_node.get(ni.name) is ni.node):
-                self._write_row_aggregates(idx, ni)
+                agg_items.append((idx, ni))
             else:
-                self._write_row(idx, ni)
+                full_items.append((idx, ni))
                 self._row_node[ni.name] = ni.node
             self.row_gen[ni.name] = ni.generation
             dirty_writes = True
+        # columnar batch writers (ingest/noderows.py) take mass updates
+        # (prime/resync/churn); small dirty sets and capacity edges keep
+        # the per-row writers, which own growth and CapacityError
+        if full_items:
+            from ..ingest.noderows import write_rows
+            if len(full_items) < 16 or not write_rows(self, full_items):
+                for idx, ni in full_items:
+                    self._write_row(idx, ni)
+        if agg_items:
+            from ..ingest.noderows import write_aggregate_rows
+            if len(agg_items) < 16 or not write_aggregate_rows(
+                    self, agg_items):
+                for idx, ni in agg_items:
+                    self._write_row_aggregates(idx, ni)
         if dirty_writes or full:
             self._device_dirty = True
             self.staging_gen += 1
@@ -223,6 +263,8 @@ class ClusterState:
         """Pod-aggregate-only row refresh (used/nonzero/npods/ports) —
         valid only when the Node object itself is unchanged."""
         a = self.arrays
+        if self._dirty_rows is not None:
+            self._dirty_rows.add(idx)
         used_row = self.rtable.vector(ni.requested)
         if len(used_row) > a.used.shape[1]:
             self._write_row(idx, ni)   # resource table grew: full path
@@ -249,6 +291,8 @@ class ClusterState:
         # full row write touches the static columns: hoisted per-signature
         # surfaces over this node axis must recompute
         self.statics_gen += 1
+        if self._dirty_rows is not None:
+            self._dirty_rows.add(idx)
         # resources
         cap_row = self.rtable.vector(ni.allocatable)
         used_row = self.rtable.vector(ni.requested)
@@ -331,6 +375,7 @@ class ClusterState:
         self._device_dirty = True
         self.staging_gen += 1
         self.statics_gen += 1
+        self._dirty_rows = None
 
     def _grow_resources(self) -> None:
         self.dims.resources = self.rtable.width
@@ -338,6 +383,7 @@ class ClusterState:
             self.arrays = _pad_cols(self.arrays, self.dims)
             self.staging_gen += 1
             self.statics_gen += 1
+            self._dirty_rows = None
 
     def request_vector(self, requests: dict[str, int]):
         """Dense np.int64 request row at the CURRENT staging width, WITHOUT
@@ -360,14 +406,50 @@ class ClusterState:
     # -- device transfer ------------------------------------------------------
 
     def device_arrays(self) -> NodeArrays:
-        """jnp copies (cached until the staging arrays change)."""
+        """jnp copies (cached until the staging arrays change).
+
+        Generation-diff upload: when only a small set of rows moved since
+        the last refresh (tracked in `_dirty_rows` by the row writers),
+        ship just those rows through the `scatter_rows` JIT entry
+        (ops/program.py) — H2D pays O(dirty × row width), not O(N × row
+        width). The scatter does NOT donate the previous device copy:
+        in-flight drains and resident carries may still reference it (it
+        was handed out by an earlier call), so the entry materializes
+        fresh buffers and only the transfer is diffed."""
         import jax.numpy as jnp
         if self._device is None or self._device_dirty:
             a = self.ensure_arrays()
-            self._device = NodeArrays(*(jnp.asarray(x) for x in a))
-            self._device_dirty = False
             from ..perf.ledger import GLOBAL as _ledger
-            _ledger.note_h2d_tree("host_snapshot", a)
+            dirty = self._dirty_rows
+            N = a.used.shape[0]
+            if (self._device is not None and dirty
+                    and self._device.used.shape == a.used.shape
+                    and self._device.label_key.shape == a.label_key.shape
+                    and self._device.image_id.shape == a.image_id.shape
+                    and len(dirty) <= max(N >> self.scatter_shift, 32)):
+                idx = np.fromiter(dirty, np.int64, len(dirty))
+                idx.sort()
+                # pow2 index bucket (repeat the first row) so the entry
+                # compiles once per bucket, not once per dirty count
+                D = pow2_at_least(len(idx))
+                pidx = np.full((D,), idx[0], np.int64)
+                pidx[:len(idx)] = idx
+                rows = NodeArrays(*(x[pidx] for x in a))
+                from ..ops.program import scatter_rows
+                self._device = scatter_rows(self._device,
+                                            pidx.astype(np.int32), rows)
+                _ledger.note_h2d_tree("host_snapshot", rows)
+                self.rows_scattered_total += len(idx)
+                if self.metrics is not None:
+                    self.metrics.ingest_rows_scattered.inc(by=len(idx))
+            else:
+                self._device = NodeArrays(*(jnp.asarray(x) for x in a))
+                _ledger.note_h2d_tree("host_snapshot", a)
+                self.full_uploads_total += 1
+                if self.metrics is not None:
+                    self.metrics.ingest_full_uploads.inc()
+            self._device_dirty = False
+            self._dirty_rows = set()
         return self._device
 
     def adopt_carry(self, used, nonzero_used, npods, ports,

@@ -93,6 +93,29 @@ class TestCompare:
             {"A_x": {"pods_per_s": 1.0}}, {"B_x": {"pods_per_s": 1.0}})
         assert any("no shared workloads" in f for f in failures)
 
+    def test_host_share_regression_fails(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "host_share": 0.40}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "host_share": 0.47}}   # +17.5% rel
+        failures, _ = bench_compare.compare(base, new)
+        assert any("HOST PHASE SHARE" in f for f in failures)
+
+    def test_host_share_within_gate_passes(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "host_share": 0.40}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "host_share": 0.43}}   # +7.5% rel
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_host_share_skipped_when_baseline_predates_field(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "host_share": 0.99}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
     def test_sharded_probe_excluded(self):
         base = {"Sharded_8dev": {"pods_per_s": 100.0},
                 "A_x": {"pods_per_s": 100.0}}

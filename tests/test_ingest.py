@@ -1,0 +1,609 @@
+"""Columnar ingest & commit engine parity suites (ISSUE 9).
+
+Three contracts, each fuzzed against the serial reference paths that
+stay in the tree as oracles:
+
+1. columnar tensorize — `BatchBuilder.build` (chunked interning +
+   ingest/columns.py fill_rows) vs a per-pod `_lookup`/`_fill_row` build:
+   bit-for-bit PodTable equality (affinity term tables included), plus
+   identical sig/tidx/valid/fallback vectors and commit-facts columns.
+2. generation-diff snapshot upload — `ClusterState.device_arrays`'s
+   scatter_rows path vs a full re-tensorize, across seeded assume /
+   forget / node-flap / cordon sequences.
+3. batched commit — the CommitEngine + bulk bind-echo (`ColumnarIngest`
+   on) vs the serial `_fast_commit` / per-pod informer path (gate off):
+   identical assignments, cache content, dispatcher traffic and events.
+
+Plus the columnar node-row writers (ingest/noderows.py) and the
+vectorized group seeding (ingest/groupcols.py) against brute-force
+per-node references.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state.batch import BatchBuilder, PodBatch, PodTable
+from kubernetes_tpu.state.tensorize import ClusterState, pow2_at_least
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+# ---------------------------------------------------------------------------
+# fuzz pod generator
+
+
+def _fuzz_pod(rng: random.Random, i: int):
+    w = make_pod(f"pod-{i}").req(
+        {"cpu": f"{rng.choice([100, 250, 500, 900])}m",
+         "memory": f"{rng.choice([256, 512, 1024])}Mi"})
+    if rng.random() < 0.3:
+        w = w.label("app", rng.choice(["web", "db", "cache"]))
+    if rng.random() < 0.25:
+        w = w.node_selector({ZONE: f"zone-{rng.randrange(4)}"})
+    if rng.random() < 0.2:
+        w = w.toleration(key="dedicated", operator="Equal",
+                         value=rng.choice(["gpu", "infra"]),
+                         effect="NoSchedule")
+    if rng.random() < 0.2:
+        w = w.node_affinity_in(ZONE,
+                               [f"zone-{z}" for z in range(rng.randrange(1, 4))])
+    if rng.random() < 0.15:
+        w = w.preferred_node_affinity_in(ZONE, ["zone-0", "zone-1"],
+                                         weight=rng.randrange(1, 50))
+    if rng.random() < 0.15:
+        w = w.spread_constraint(rng.randrange(1, 3), ZONE, "DoNotSchedule",
+                                {"app": "web"})
+    if rng.random() < 0.1:
+        w = w.pod_affinity(ZONE, {"app": "db"}, anti=True)
+    if rng.random() < 0.1:
+        w = w.host_port(8000 + rng.randrange(16))
+    if rng.random() < 0.1:
+        w = w.container({"cpu": "50m"}, image=f"img-{rng.randrange(4)}:v1")
+    if rng.random() < 0.08:
+        w = w.pvc(f"claim-{i}")        # host-fallback path
+    if rng.random() < 0.06:
+        # overflow a padded dim (tolerations) → capacity fallback
+        for t in range(9):
+            w = w.toleration(key=f"k{t}", operator="Exists")
+    return w.obj()
+
+
+def _serial_build(builder: BatchBuilder, pods, pad_to: int = 0) -> PodBatch:
+    """The pre-columnar per-pod build loop, verbatim — the oracle."""
+    B = pow2_at_least(max(len(pods), pad_to))
+    if builder.table_used >= builder.dims.max_table_rows:
+        builder._reset_table()
+    if builder.table.req.shape[1] != builder.state.dims.resources:
+        builder._reset_table()
+    valid = np.zeros((B,), bool)
+    fallback = np.zeros((B,), bool)
+    sig = np.zeros((B,), np.int32)
+    tidx = np.zeros((B,), np.int32)
+    last = -1
+    for i, pod in enumerate(pods):
+        ent = builder._lookup(pod)
+        if ent[0] == "fallback":
+            fallback[i] = True
+        else:
+            valid[i] = True
+            sig[i] = ent[1]
+            tidx[i] = ent[2]
+            last = i
+    if last >= 0 and len(pods) < B:
+        sig[len(pods):] = sig[last]
+        tidx[len(pods):] = tidx[last]
+    return PodBatch(valid=valid, host_fallback=fallback, sig=sig,
+                    tidx=tidx, table=builder.table,
+                    table_version=builder.table_version)
+
+
+class TestColumnarTensorizeParity:
+    def test_fuzz_bit_for_bit_table_parity(self):
+        for seed in range(20):
+            rng_a = random.Random(seed)
+            rng_b = random.Random(seed)
+            state_a, state_b = ClusterState(), ClusterState()
+            ba = BatchBuilder(state_a)
+            bb = BatchBuilder(state_b)
+            # several chunks against the same builders: exercises the
+            # ident/sig caches, growth and cross-chunk interning
+            off = 0
+            for chunk in range(3):
+                n = rng_a.randrange(1, 40)
+                rng_b.randrange(1, 40)
+                pods_a = [_fuzz_pod(rng_a, off + i) for i in range(n)]
+                pods_b = [_fuzz_pod(rng_b, off + i) for i in range(n)]
+                off += n
+                got = ba.build(pods_a, pad_to=16)
+                want = _serial_build(bb, pods_b, pad_to=16)
+                np.testing.assert_array_equal(got.valid, want.valid)
+                np.testing.assert_array_equal(got.host_fallback,
+                                              want.host_fallback)
+                np.testing.assert_array_equal(got.sig, want.sig)
+                np.testing.assert_array_equal(got.tidx, want.tidx)
+                assert ba.table_used == bb.table_used
+                for name in PodTable._fields:
+                    np.testing.assert_array_equal(
+                        getattr(ba.table, name), getattr(bb.table, name),
+                        err_msg=f"PodTable.{name} diverged (seed {seed}, "
+                                f"chunk {chunk})")
+                # the commit-facts column is aligned and identical
+                assert len(ba.row_facts) == ba.table_used
+                assert ba.row_facts == bb.row_facts
+
+    def test_single_signature_chunk_fast_path(self):
+        state = ClusterState()
+        b = BatchBuilder(state)
+        proto = make_pod("p0").req({"cpu": "500m"}).obj()
+        pods = [proto] + [_clone_shared(proto, f"p{i}") for i in range(1, 64)]
+        batch = b.build(pods)
+        assert batch.valid[:64].all()
+        assert (batch.sig[:64] == batch.sig[0]).all()
+        assert b.table_used == 1
+        assert len(b.row_facts) == 1
+
+    def test_facts_match_commit_predicates(self):
+        """CommitFacts flags mirror NodeInfo.add_pod's membership
+        predicates for every fuzzed signature row."""
+        from kubernetes_tpu.framework.types import NodeInfo, PodInfo
+        rng = random.Random(7)
+        state = ClusterState()
+        b = BatchBuilder(state)
+        pods = [_fuzz_pod(rng, i) for i in range(60)]
+        batch = b.build(pods)
+        node = make_node("n0").capacity({"cpu": 64, "memory": "64Gi",
+                                         "pods": 110}).obj()
+        for i, pod in enumerate(pods):
+            if not batch.valid[i]:
+                continue
+            f = b.row_facts[int(batch.tidx[i])]
+            pi = PodInfo.of(pod.with_node_name("n0"))
+            info = NodeInfo(node=node)
+            info.add_pod(pi)
+            assert f.has_affinity == bool(info.pods_with_affinity)
+            assert f.has_anti_affinity == bool(
+                info.pods_with_required_anti_affinity)
+            assert dict(f.req_items) == pi.requests
+            assert (f.cpu_nz, f.mem_nz) == (pi.cpu_nonzero, pi.mem_nonzero)
+            assert f.has_ports == bool(info.used_ports.ports)
+
+
+def _clone_shared(proto, name):
+    """Stamp a pod sharing spec/labels objects (the PodFactory shape)."""
+    from kubernetes_tpu.api.types import PodStatus, _shallow
+    from kubernetes_tpu.testing.wrappers import _counter
+    p = _shallow(proto)
+    m = _shallow(proto.metadata)
+    m.name = name
+    m.uid = f"{m.namespace}/{name}"
+    m.creation_index = next(_counter)
+    p.metadata = m
+    p.status = PodStatus()
+    return p
+
+
+# ---------------------------------------------------------------------------
+# generation-diff device scatter
+
+
+def _fresh_device(state: ClusterState):
+    import jax.numpy as jnp
+    return [np.asarray(jnp.asarray(x)) for x in state.arrays]
+
+
+class TestGenerationDiffScatter:
+    def _cluster(self, n_nodes=24, seed=0):
+        rng = random.Random(seed)
+        cache = Cache()
+        snapshot = Snapshot()
+        nodes = []
+        for i in range(n_nodes):
+            w = make_node(f"node-{i}").capacity(
+                {"cpu": 16, "memory": "32Gi", "pods": 110}).zone(
+                f"z{i % 4}").label(HOSTNAME, f"node-{i}")
+            if rng.random() < 0.2:
+                w = w.taint("dedicated", "infra", "NoSchedule")
+            nodes.append(w.obj())
+            cache.add_node(nodes[-1])
+        state = ClusterState()
+        cache.update_snapshot(snapshot)
+        state.apply_snapshot(snapshot)
+        return rng, cache, snapshot, state, nodes
+
+    def test_scatter_equals_full_upload_across_mutations(self):
+        rng, cache, snapshot, state, nodes = self._cluster()
+        base = state.device_arrays()      # full upload (first build)
+        assert state.full_uploads_total == 1
+        pods = []
+        for step in range(30):
+            op = rng.random()
+            if op < 0.5 or not pods:
+                pod = make_pod(f"p{len(pods)}").req(
+                    {"cpu": "250m", "memory": "256Mi"}).obj()
+                pod = pod.with_node_name(
+                    f"node-{rng.randrange(len(nodes))}")
+                try:
+                    cache.assume_pod(pod)
+                    pods.append(pod)
+                except KeyError:
+                    pass
+            elif op < 0.75:
+                pod = pods.pop(rng.randrange(len(pods)))
+                try:
+                    cache.forget_pod(pod)
+                except (KeyError, ValueError):
+                    pass
+            elif op < 0.9:
+                # node flap: remove + re-add (fresh generation)
+                i = rng.randrange(len(nodes))
+                cache.remove_node(nodes[i])
+                cache.add_node(nodes[i])
+            else:
+                # cordon/uncordon (spec change → full row rewrite)
+                i = rng.randrange(len(nodes))
+                import dataclasses
+                old = nodes[i]
+                new_spec = dataclasses.replace(
+                    old.spec, unschedulable=not old.spec.unschedulable)
+                new = dataclasses.replace(old, spec=new_spec)
+                cache.update_node(old, new)
+                nodes[i] = new
+            cache.update_snapshot(snapshot)
+            state.apply_snapshot(snapshot)
+            dev = state.device_arrays()   # scatter or full, its call
+            full = _fresh_device(state)
+            for got, want, name in zip(dev, full, type(dev)._fields):
+                np.testing.assert_array_equal(
+                    np.asarray(got), want,
+                    err_msg=f"device field {name} diverged at step {step}")
+        assert state.rows_scattered_total > 0, \
+            "the sequence never exercised the scatter path"
+
+    def test_node_removal_reaches_device(self):
+        """A node removal with no other writes must clear the device
+        row's valid bit (the stale-valid fix)."""
+        _rng, cache, snapshot, state, nodes = self._cluster(n_nodes=8)
+        state.device_arrays()
+        idx = state.node_index[nodes[3].name]
+        cache.remove_node(nodes[3])
+        cache.update_snapshot(snapshot)
+        state.apply_snapshot(snapshot)
+        dev = state.device_arrays()
+        assert not bool(np.asarray(dev.valid)[idx])
+
+    def test_large_dirty_set_takes_full_upload(self):
+        # 40 dirty rows > max(N >> 3, 32) at a 64-row bucket → full path
+        _rng, cache, snapshot, state, nodes = self._cluster(n_nodes=40)
+        state.device_arrays()
+        before = state.full_uploads_total
+        for i, node in enumerate(nodes):
+            pod = make_pod(f"bulk-{i}").req({"cpu": "100m"}).obj()
+            cache.assume_pod(pod.with_node_name(node.name))
+        cache.update_snapshot(snapshot)
+        state.apply_snapshot(snapshot)
+        state.device_arrays()
+        assert state.full_uploads_total == before + 1
+
+    def test_scatter_rows_entry_pads_and_duplicates(self):
+        from kubernetes_tpu.ops.program import scatter_rows
+        from kubernetes_tpu.state.tensorize import NodeArrays, _zero_arrays
+        state = ClusterState()
+        state.ensure_arrays()
+        import jax.numpy as jnp
+        dev = NodeArrays(*(jnp.asarray(x) for x in state.arrays))
+        a = _zero_arrays(state.dims)
+        a.cap[2, 0] = 99
+        idx = np.array([2, 2, 2, 2], np.int32)   # duplicates, identical rows
+        rows = NodeArrays(*(x[idx] for x in a))
+        out = scatter_rows(dev, idx, rows)
+        assert int(np.asarray(out.cap)[2, 0]) == 99
+
+
+# ---------------------------------------------------------------------------
+# columnar node-row writers
+
+
+class TestNodeRowWriters:
+    def test_write_rows_bit_for_bit(self):
+        from kubernetes_tpu.ingest.noderows import write_rows
+        for seed in range(6):
+            rng = random.Random(seed)
+            cache = Cache()
+            for i in range(40):
+                w = make_node(f"n-{i}").capacity(
+                    {"cpu": 8 + rng.randrange(8), "memory": "16Gi",
+                     "pods": 110}).zone(f"z{i % 3}").label(
+                    HOSTNAME, f"n-{i}").label("idx", str(i))
+                if rng.random() < 0.3:
+                    w = w.taint("t", f"v{rng.randrange(3)}",
+                                rng.choice(["NoSchedule",
+                                            "PreferNoSchedule"]))
+                if rng.random() < 0.3:
+                    w = w.unschedulable()
+                cache.add_node(w.obj())
+            snapshot = Snapshot()
+            cache.update_snapshot(snapshot)
+            # serial reference
+            ref = ClusterState()
+            ref.ensure_arrays()
+            ref_items = []
+            for ni in snapshot.node_info_list:
+                ref_items.append((ref._slot(ni.name), ni))
+            # pre-size: both states go through _slot the same way
+            col = ClusterState()
+            col.ensure_arrays()
+            col_items = [(col._slot(ni.name), ni)
+                         for ni in snapshot.node_info_list]
+            for idx, ni in ref_items:
+                ref._write_row(idx, ni)
+            assert write_rows(col, col_items)
+            for name in type(ref.arrays)._fields:
+                np.testing.assert_array_equal(
+                    getattr(ref.arrays, name), getattr(col.arrays, name),
+                    err_msg=f"NodeArrays.{name} diverged (seed {seed})")
+
+    def test_aggregate_rows_bit_for_bit(self):
+        from kubernetes_tpu.ingest.noderows import write_aggregate_rows
+        cache = Cache()
+        nodes = [make_node(f"m-{i}").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110}).obj()
+            for i in range(12)]
+        for node in nodes:
+            cache.add_node(node)
+        snapshot = Snapshot()
+        cache.update_snapshot(snapshot)
+        ref, col = ClusterState(), ClusterState()
+        ref.apply_snapshot(snapshot)
+        col.apply_snapshot(snapshot)
+        for i, node in enumerate(nodes):
+            cache.assume_pod(make_pod(f"q{i}").req(
+                {"cpu": "300m", "memory": "1Gi"}).obj()
+                .with_node_name(node.name))
+        cache.update_snapshot(snapshot)
+        items_ref = [(ref.node_index[ni.name], ni)
+                     for ni in snapshot.node_info_list]
+        items_col = [(col.node_index[ni.name], ni)
+                     for ni in snapshot.node_info_list]
+        for idx, ni in items_ref:
+            ref._write_row_aggregates(idx, ni)
+        assert write_aggregate_rows(col, items_col)
+        for name in ("used", "nonzero_used", "npods", "ports"):
+            np.testing.assert_array_equal(
+                getattr(ref.arrays, name), getattr(col.arrays, name))
+
+
+# ---------------------------------------------------------------------------
+# vectorized group seeding
+
+
+class TestGroupSeedParity:
+    def test_gather_ids_matches_dict_probe(self):
+        from kubernetes_tpu.ingest.groupcols import gather_ids
+        rng = random.Random(3)
+        for _ in range(50):
+            n = rng.randrange(1, 200)
+            tv = np.array([rng.randrange(0, 12) for _ in range(n)],
+                          np.int32)
+            table = {k: rng.randrange(1, 100)
+                     for k in rng.sample(range(1, 12),
+                                         rng.randrange(0, 8))}
+            want = np.array([table.get(int(t), 0) for t in tv], np.int64)
+            np.testing.assert_array_equal(gather_ids(tv, table), want)
+
+    def test_seed_counts_against_brute_force(self):
+        """Vectorized seed_counts vs a per-node dict-probe reference over
+        a live cluster with spread + inter-pod affinity load."""
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        for i in range(24):
+            api.create_node(make_node(f"node-{i}").capacity(
+                {"cpu": 16, "memory": "32Gi", "pods": 110}).zone(
+                f"zone-{i % 4}").label(HOSTNAME, f"node-{i}").obj())
+        # existing pods feeding the symmetric counts
+        for i in range(12):
+            api.create_pod(make_pod(f"old-{i}").req({"cpu": "100m"})
+                           .label("app", "web" if i % 2 else "db")
+                           .node(f"node-{i % 24}").obj())
+        sched.prime()
+        pods = [
+            make_pod("s0").req({"cpu": "200m"}).label("app", "web")
+            .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "web"})
+            .obj(),
+            make_pod("s1").req({"cpu": "200m"}).label("app", "db")
+            .spread_constraint(2, ZONE, "ScheduleAnyway", {"app": "db"})
+            .obj(),
+            make_pod("s2").req({"cpu": "200m"}).label("app", "web")
+            .pod_affinity(ZONE, {"app": "db"}).obj(),
+            make_pod("s3").req({"cpu": "200m"}).label("app", "db")
+            .pod_affinity(ZONE, {"app": "web"}, anti=True).obj(),
+        ]
+        sched.builder.build(pods)
+        g = sched.builder.groups
+        rows = range(len(g.rows))
+        nis = g._node_rows(sched.snapshot)
+        out = g.seed_counts(sched.snapshot, rows, nis=nis)
+        # brute force: per-node label dict probes (the pre-columnar walk)
+        from kubernetes_tpu.framework.interface import CycleState
+        from kubernetes_tpu.plugins import interpodaffinity as ipa_mod
+        from kubernetes_tpu.plugins import podtopologyspread as pts_mod
+        node_list = sched.snapshot.node_info_list
+        for r, u in enumerate(rows):
+            info = g.rows[u]
+            if info is None:
+                continue
+            pod = info.pod
+            if info.f_constraints:
+                cs = CycleState()
+                g.pts.pre_filter(cs, pod, node_list)
+                s = cs.read_or_none(pts_mod._PRE_FILTER_KEY)
+                for j, c in enumerate(s.constraints):
+                    cnts = s.tp_value_to_match_num[j]
+                    for idx, ni in nis:
+                        v = ni.node.metadata.labels.get(c.topology_key)
+                        want = cnts.get(v, 0) if v is not None else 0
+                        assert out["spr_f_cnt"][r, j, idx] == want
+            cs = CycleState()
+            g.ipa.pre_filter(cs, pod, node_list)
+            s = cs.read_or_none(ipa_mod._PRE_FILTER_KEY)
+            if s is not None and s.existing_anti_affinity_counts:
+                for idx, ni in nis:
+                    want = sum(
+                        s.existing_anti_affinity_counts.get(kv, 0)
+                        for kv in ni.node.metadata.labels.items())
+                    assert out["ipa_veto"][r, idx] == want
+            cs = CycleState()
+            g.ipa.pre_score(cs, pod, node_list, all_nodes=node_list)
+            ps = cs.read_or_none(ipa_mod._PRE_SCORE_KEY)
+            if ps is not None and ps.topology_score:
+                for idx, ni in nis:
+                    labels = ni.node.metadata.labels
+                    want = sum(tv_scores.get(labels.get(tk), 0)
+                               for tk, tv_scores
+                               in ps.topology_score.items()
+                               if labels.get(tk) is not None)
+                    assert out["ipa_score"][r, idx] == want
+
+    def test_label_columns_invalidate_on_statics_gen(self):
+        from kubernetes_tpu.ingest.groupcols import NodeLabelColumns
+        cache = Cache()
+        node = make_node("n0").capacity({"cpu": 8, "memory": "16Gi",
+                                         "pods": 110}).zone("za").obj()
+        cache.add_node(node)
+        snapshot = Snapshot()
+        cache.update_snapshot(snapshot)
+        state = ClusterState()
+        state.apply_snapshot(snapshot)
+        cols = NodeLabelColumns(state)
+        nis = [(state.node_index[ni.name], ni)
+               for ni in snapshot.node_info_list]
+        cols.sync(nis)
+        tv1 = cols.tv(ZONE)
+        assert tv1[0] != 0
+        # relabel the node → full row rewrite → statics bump → fresh cols
+        import dataclasses
+        meta = dataclasses.replace(
+            node.metadata, labels={**node.metadata.labels, ZONE: "zb"})
+        new = dataclasses.replace(node, metadata=meta)
+        cache.update_node(node, new)
+        cache.update_snapshot(snapshot)
+        state.apply_snapshot(snapshot)
+        nis = [(state.node_index[ni.name], ni)
+               for ni in snapshot.node_info_list]
+        cols.sync(nis)
+        tv2 = cols.tv(ZONE)
+        assert tv2[0] != tv1[0]
+
+
+# ---------------------------------------------------------------------------
+# batched commit vs serial end-state parity
+
+
+def _run_workload(columnar: bool, seed: int, chaos_fail: bool = False):
+    api = APIServer()
+    sched = Scheduler(api, batch_size=256)
+    sched.feature_gates.set("ColumnarIngest", columnar)
+    # re-wire the gate-dependent plumbing the ctor derived
+    sched.columnar_ingest = columnar
+    if not columnar:
+        sched.commit_engine = None
+        # rebuild handlers without the bulk echo
+        api.pod_handlers.clear()
+        api.node_handlers.clear()
+        for attr in ("pvc_handlers", "pv_handlers", "pdb_handlers",
+                     "workload_handlers"):
+            if hasattr(api, attr):
+                getattr(api, attr).clear()
+        sched._register_event_handlers()
+    rng = random.Random(seed)
+    for i in range(24):
+        api.create_node(make_node(f"node-{i}").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 110}).zone(
+            f"zone-{i % 4}").label(HOSTNAME, f"node-{i}").obj())
+    sched.prime()
+    pods = []
+    for i in range(120):
+        w = make_pod(f"pod-{i}")
+        if chaos_fail and i % 9 == 0:
+            w = w.req({"cpu": "100"})      # infeasible: failure path
+        else:
+            w = w.req({"cpu": f"{rng.choice([250, 500])}m",
+                       "memory": "512Mi"})
+        if i % 7 == 0:
+            w = w.label("app", "web").spread_constraint(
+                5, ZONE, "ScheduleAnyway", {"app": "web"})
+        pods.append(w.obj())
+    for start in range(0, len(pods), 40):
+        api.create_pods(pods[start:start + 40])
+        sched.schedule_pending(wait=False)
+    sched.schedule_pending()
+    assignments = {uid: p.spec.node_name for uid, p in api.pods.items()}
+    cache_dump = sched.cache.dump()
+    return {
+        "assignments": assignments,
+        "scheduled": sched.scheduled_count,
+        "unschedulable": sched.unschedulable_count,
+        # NodeInfo generations are a process-global monotonic counter —
+        # normalize them out before comparing two in-process runs
+        "cache_nodes": {n: {k: v for k, v in d.items()
+                            if k != "generation"}
+                        for n, d in cache_dump["nodes"].items()},
+        "assumed": cache_dump["assumed_pods"],
+        "pod_count": cache_dump["pod_count"],
+        "dispatcher_executed": sched.dispatcher.executed,
+        "dispatcher_errors": sched.dispatcher.errors,
+        "events": dict(sched.events.counts),
+        "queue_len": len(sched.queue),
+    }
+
+
+class TestBatchedCommitParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_end_state_parity(self, seed):
+        a = _run_workload(columnar=True, seed=seed)
+        b = _run_workload(columnar=False, seed=seed)
+        assert a == b
+
+    def test_end_state_parity_with_failures(self):
+        a = _run_workload(columnar=True, seed=5, chaos_fail=True)
+        b = _run_workload(columnar=False, seed=5, chaos_fail=True)
+        assert a == b
+
+    def test_resync_parity(self):
+        """resync()'s columnar re-ingest reaches the same cache/queue
+        state under both gates."""
+        outs = []
+        for columnar in (True, False):
+            api = APIServer()
+            sched = Scheduler(api, batch_size=64)
+            if not columnar:
+                sched.columnar_ingest = False
+                sched.commit_engine = None
+            for i in range(12):
+                api.create_node(make_node(f"n-{i}").capacity(
+                    {"cpu": 8, "memory": "16Gi", "pods": 110}).obj())
+            sched.prime()
+            api.create_pods([make_pod(f"p-{i}").req(
+                {"cpu": "500m"}).obj() for i in range(40)])
+            sched.schedule_pending()
+            # some pending pods that never scheduled (queue re-ingest)
+            api.create_pods([make_pod(f"late-{i}").req(
+                {"cpu": "100"}).obj() for i in range(5)])
+            sched.resync()
+            dump = sched.cache.dump()
+            outs.append({
+                "cache_nodes": {n: {k: v for k, v in d.items()
+                                    if k != "generation"}
+                                for n, d in dump["nodes"].items()},
+                "assumed": dump["assumed_pods"],
+                "pod_count": dump["pod_count"],
+                "queue": len(sched.queue),
+                "active": len(sched.queue.active_q),
+            })
+        assert outs[0] == outs[1]

@@ -43,6 +43,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # default gates
 MAX_THROUGHPUT_DROP = 0.10     # fraction of baseline pods/s
 MAX_P99_GROWTH = 0.25          # fraction of baseline attempt_p99_ms
+# host-phase-share gate (ISSUE 9): host_share = (host_build + commit) /
+# drain cycle, recorded in the summary block since r08. A relative
+# regression beyond this fraction means Python is clawing back the cycle
+# the columnar ingest engine vacated. Skipped when either side predates
+# the field.
+MAX_HOST_SHARE_GROWTH = 0.10
 
 # per-workload noise thresholds (throughput drop), keyed by case-name
 # prefix: the group/preemption workloads' measured passes jitter ±20%
@@ -150,6 +156,15 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
                     f"({growth:+.1%}, gate +{MAX_P99_GROWTH:.0%})")
             if growth > MAX_P99_GROWTH:
                 failures.append(f"P99 LATENCY REGRESSION {line}")
+            report.append(line)
+        b_hs = float(b.get("host_share") or 0.0)
+        n_hs = float(n.get("host_share") or 0.0)
+        if b_hs > 0 and n_hs > 0:
+            growth = n_hs / b_hs - 1.0
+            line = (f"{w}: host phase share {b_hs:.3f} -> {n_hs:.3f} "
+                    f"({growth:+.1%}, gate +{MAX_HOST_SHARE_GROWTH:.0%})")
+            if growth > MAX_HOST_SHARE_GROWTH:
+                failures.append(f"HOST PHASE SHARE REGRESSION {line}")
             report.append(line)
     for w in sorted(set(base) - set(new)):
         report.append(f"{w}: only in baseline (skipped)")
