@@ -81,7 +81,7 @@ CASES = [
 PREEMPTION_WAVE_OP = "createPods[2]"
 
 
-_SHARDED_PROBE = r'''
+_SHARDED_CASE = r'''
 import json, sys, time
 sys.path.insert(0, REPO)
 # accelerator site hooks may re-pin jax_platforms at interpreter start;
@@ -98,42 +98,66 @@ mesh = make_mesh(8)
 def run():
     api = APIServer()
     sched = Scheduler(api, batch_size=2048, mesh=mesh)
-    for i in range(512):
+    for i in range(NODES):
         api.create_node(make_node(f"n{i}").capacity(
             {"cpu": 32, "memory": "64Gi", "pods": 110})
             .zone(f"z{i % 16}").obj())
     sched.prime()
-    t0 = time.perf_counter()
+    samples = [(time.perf_counter(), 0)]
     created = 0
-    while created < 2048:
+    while created < PODS:
         for i in range(256):
             api.create_pod(make_pod(f"pod-{created + i}").req(
                 {"cpu": "900m", "memory": "1Gi"}).obj())
         created += 256
         sched.schedule_pending(wait=False)
+        samples.append((time.perf_counter(), sched.scheduled_count))
     sched.schedule_pending()
-    assert sched.scheduled_count == 2048, sched.scheduled_count
-    return time.perf_counter() - t0
+    samples.append((time.perf_counter(), sched.scheduled_count))
+    assert sched.scheduled_count == PODS, sched.scheduled_count
+    dt = samples[-1][0] - samples[0][0]
+    rates = []
+    t0, c0 = samples[0]
+    for t1, c1 in samples[1:]:
+        if c1 > c0 and t1 > t0:
+            rates.append((c1 - c0) / (t1 - t0))
+            t0, c0 = t1, c1
+    rates.sort()
+    perc = lambda p: rates[min(len(rates) - 1, int(p * len(rates)))] \
+        if rates else 0.0
+    m = sched.metrics
+    if sched.audit is not None:
+        sched.audit.flush()
+    return {
+        "pods_per_s": round(PODS / dt, 1), "seconds": round(dt, 3),
+        "p50": round(perc(0.50)), "p99": round(perc(0.99)),
+        "attempt_p50_ms": round(m.attempt_duration.quantile(0.50) * 1e3, 3),
+        "attempt_p99_ms": round(m.attempt_duration.quantile(0.99) * 1e3, 3),
+        "slo": sched.slo.snapshot(compact=True),
+    }
 
 run()           # warm pass: compiles the node-axis-sharded program
-dt = run()
-print(json.dumps({"pods": 2048, "seconds": round(dt, 3),
-                  "pods_per_s": round(2048 / dt, 1)}))
+passes = [run() for _ in range(RUNS)]
+passes.sort(key=lambda d: d["pods_per_s"])
+out = passes[len(passes) // 2]
+out["passes"] = [d["pods_per_s"] for d in passes]
+print(json.dumps(out))
 '''
 
 
-def sharded_probe() -> dict:
-    """Run a SchedulingBasic-shaped workload on an 8-virtual-device CPU
-    mesh in a subprocess (the real chip is single-device; the driver's
-    MULTICHIP dryrun validates compilation the same way). Returns the
-    extras entry — evidence that the sharded path carries real
-    throughput, not a headline number (CPU shards are slow)."""
+def sharded_case(nodes: int, pods: int, runs: int) -> dict:
+    """Run the ShardedBasic workload on the 8-virtual-device CPU mesh in
+    a subprocess (the real chip is single-device; the driver's MULTICHIP
+    dryrun validates compilation the same way). Returns a full summary
+    entry — ROADMAP item 2's starting point, recorded in the BENCH trail
+    and gated by tools/bench_compare.py instead of folklore."""
     import subprocess
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    code = "REPO = %r\n" % os.path.dirname(os.path.abspath(__file__)) \
-        + _SHARDED_PROBE
+    code = ("REPO = %r\nNODES = %d\nPODS = %d\nRUNS = %d\n"
+            % (os.path.dirname(os.path.abspath(__file__)), nodes, pods,
+               runs)) + _SHARDED_CASE
     try:
         out = subprocess.run([sys.executable, "-c", code], env=env,
                              capture_output=True, text=True, timeout=900)
@@ -144,6 +168,8 @@ def sharded_probe() -> dict:
         data = json.loads(line)
         data["devices"] = 8
         data["backend"] = "cpu-virtual-mesh"
+        data["value"] = data["pods_per_s"]
+        data["pods"] = pods
         return data
     except Exception as e:  # probe failure must not sink the headline
         return {"error": str(e)[:200]}
@@ -229,6 +255,18 @@ def main() -> None:
                   f"(warm pass {warm_s:.1f}s, measured {measured_s:.1f}s)",
                   file=sys.stderr)
 
+    if not case_filter or "ShardedBasic" in case_filter:
+        # ShardedBasic (ISSUE 10 satellite / ROADMAP item 2): the
+        # node-axis-sharded program's throughput as a first-class,
+        # sentinel-gated workload — 8-virtual-device CPU mesh in a
+        # subprocess (XLA's device-count flag must precede jax import)
+        nodes, pods, runs = (500, 1024, 1) if small else (5000, 4096, 3)
+        entry = sharded_case(nodes, pods, runs)
+        if "error" not in entry:
+            results[f"ShardedBasic_{nodes}Nodes"] = entry
+        else:
+            results[f"ShardedBasic_{nodes}Nodes_FAILED"] = entry
+
     if not results:
         raise SystemExit(f"--cases {args.cases!r} matched no case")
 
@@ -239,6 +277,8 @@ def main() -> None:
     # every non-headline workload) had no first-class number
     summary = {}
     for key, entry in results.items():
+        if "error" in entry:
+            continue
         hb = float(entry.get("host_build_s", 0.0))
         dv = float(entry.get("device_s", 0.0))
         cm = float(entry.get("commit_s", 0.0))
@@ -259,12 +299,11 @@ def main() -> None:
                 "commit": round(100.0 * cm / total, 1) if total else 0.0,
             },
             "host_share": round((hb + cm) / total, 4) if total else 0.0,
+            # SLO engine verdict at bench end (obs/slo.py): burn-rate
+            # breaches + audit divergence count — what bench_compare's
+            # --slo gate reads (fail on breach or nonzero divergence)
+            "slo": entry.get("slo", {}),
         }
-
-    if not small and not case_filter:
-        # the CPU-mesh probe would dominate the quick variant; excluded
-        # from `summary` (compile evidence, not a throughput contract)
-        results["Sharded_8dev_512Nodes_2048Pods"] = sharded_probe()
 
     head_key = next(iter(results))
     head = results[head_key]

@@ -82,6 +82,25 @@ class KubeSchedulerConfiguration:
     # only when the ContinuousHostProfiling gate is on; 0 disables the
     # sampler even with the gate on
     host_profiler_hz: float = 200.0
+    # shadow-oracle audit (obs/audit.py, `ShadowOracleAudit` gate):
+    # fraction of drains sampled into the hash-chained replay ledger and
+    # re-executed through the host oracle on the background worker.
+    # 1.0 = every drain (chaos soaks); the default keeps the audit's
+    # host-oracle replay cost off the steady-state throughput envelope.
+    shadow_audit_sample_rate: float = 1.0 / 64.0
+    # cap on serially re-executed pods per sampled drain: the host
+    # oracle replays the drain PREFIX up to this length (the serial
+    # greedy's first K decisions depend only on prior state), bounding
+    # the background Python cost per sample; 0 = no cap. Reason-histogram
+    # diffs only run on fully-replayed (untruncated) drains.
+    shadow_audit_max_replay_pods: int = 64
+    # directory for standalone replay records (one pickle per audited
+    # drain, re-runnable via tools/audit_replay.py); "" = in-memory only
+    shadow_audit_dir: str = ""
+    # SLO burn-rate objectives (obs/slo.py): sli name → {"objective":
+    # fraction, "thresholdSeconds": latency bound, "maxBurn": {window:
+    # rate}} overriding the defaults; unknown sli names are rejected
+    slo_objectives: dict = field(default_factory=dict)
     # names of out-of-tree plugins registered in the caller's Registry
     # (accepted by validation; resolved by build_profiles' registry)
     extra_plugins: tuple = ()
@@ -111,6 +130,12 @@ class KubeSchedulerConfiguration:
             raise ValueError("apiRetryBaseSeconds must be > 0")
         if self.host_profiler_hz < 0 or self.host_profiler_hz > 10000:
             raise ValueError("hostProfilerHz must be in [0, 10000]")
+        if not 0.0 <= self.shadow_audit_sample_rate <= 1.0:
+            raise ValueError("shadowAuditSampleRate must be in [0, 1]")
+        if self.shadow_audit_max_replay_pods < 0:
+            raise ValueError("shadowAuditMaxReplayPods must be >= 0")
+        from ..obs.slo import validate_objectives
+        validate_objectives(self.slo_objectives)  # raises on unknown sli
         known = set(_default_plugin_names()) | set(self.extra_plugins)
         for p in self.profiles:
             for n in p.plugins.enabled + p.plugins.disabled:
@@ -155,6 +180,10 @@ class KubeSchedulerConfiguration:
             "compilationCacheDir": self.compilation_cache_dir,
             "profilerTraceDir": self.profiler_trace_dir,
             "hostProfilerHz": self.host_profiler_hz,
+            "shadowAuditSampleRate": self.shadow_audit_sample_rate,
+            "shadowAuditMaxReplayPods": self.shadow_audit_max_replay_pods,
+            "shadowAuditDir": self.shadow_audit_dir,
+            "sloObjectives": dict(self.slo_objectives),
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
         }
@@ -200,6 +229,12 @@ class KubeSchedulerConfiguration:
                                         "~/.cache/ktpu-xla"),
             profiler_trace_dir=d.get("profilerTraceDir", ""),
             host_profiler_hz=d.get("hostProfilerHz", 200.0),
+            shadow_audit_sample_rate=d.get("shadowAuditSampleRate",
+                                           1.0 / 64.0),
+            shadow_audit_max_replay_pods=d.get("shadowAuditMaxReplayPods",
+                                               64),
+            shadow_audit_dir=d.get("shadowAuditDir", ""),
+            slo_objectives=dict(d.get("sloObjectives", {})),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))
 

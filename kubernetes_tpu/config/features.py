@@ -84,6 +84,15 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # confirm. Off = the serial per-pod _fast_commit / per-pod informer
     # fan-out — the parity oracle tests/test_ingest.py compares against.
     "ColumnarIngest": FeatureSpec(True, BETA),
+    # shadow-oracle audit (kubernetes_tpu/obs/audit.py): a background
+    # sampler captures a deterministic replay record per sampled drain
+    # into a hash-chained ledger, re-executes it through the host oracle
+    # off the hot path, and diffs assignments + FailedScheduling reason
+    # histograms (oracle_divergence_total). The production-time half of
+    # the bind-parity contract the fuzz suites verify offline — the
+    # precondition for learned score columns (ROADMAP item 5) whose
+    # correctness cannot be fuzzed ahead of time.
+    "ShadowOracleAudit": FeatureSpec(True, BETA),
 }
 
 

@@ -213,6 +213,10 @@ class FlightRecord:
     # hottest host frames over the drain's wall window, attached only to
     # SLOW drains by the continuous profiler ("frame self/total" strings)
     hot_frames: tuple = ()
+    # shadow-oracle audit verdict + full diffs (obs/audit.py), attached
+    # by the audit worker AFTER the replay lands ({} = unsampled).
+    # Single reference assignment by the worker; readers snapshot it.
+    audit: dict = field(default_factory=dict)
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -228,7 +232,8 @@ class FlightRecord:
                 "consecutiveFaults": self.consecutive_faults,
                 "fallback": self.fallback, "events": self.events,
                 "drainId": self.drain_id,
-                "hotFrames": list(self.hot_frames)}
+                "hotFrames": list(self.hot_frames),
+                "audit": dict(self.audit)}
 
 
 class FlightRecorder:

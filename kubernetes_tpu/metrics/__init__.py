@@ -426,6 +426,35 @@ class SchedulerMetrics:
             "them (node-array/group/table uploads; device_readback is "
             "the d2h direction).",
             ("phase",)))
+        # shadow-oracle audit + decision provenance + SLO engine
+        # (kubernetes_tpu/obs/, ISSUE 10)
+        self.oracle_divergence = r.register(Counter(
+            n + "oracle_divergence_total",
+            "Shadow-oracle audit divergences between committed device "
+            "decisions and the host-oracle replay, by kind: assignment "
+            "(both bound, different node), reason (same verdict, "
+            "different FailedScheduling histogram), verdict (bound vs "
+            "unschedulable).",
+            ("kind",)))
+        self.shadow_audit_drains = r.register(Counter(
+            n + "shadow_audit_drains_total",
+            "Drains sampled by the shadow-oracle audit, by outcome "
+            "(clean/divergent/skipped/error).",
+            ("outcome",)))
+        self.audit_replay_duration = r.register(Histogram(
+            n + "audit_replay_seconds",
+            "Wall time of one shadow-audit host-oracle replay "
+            "(background worker, off the hot path)."))
+        self.explain_duration = r.register(Histogram(
+            n + "explain_seconds",
+            "Wall time of one /debug/explain decision decomposition "
+            "(prefix replay + explain_row kernel)."))
+        self.slo_burn_rate = r.register(Gauge(
+            n + "slo_burn_rate",
+            "Error-budget burn rate per SLI and look-back window "
+            "(obs/slo.py): error_rate / (1 - objective); 1.0 = consuming "
+            "exactly the budget.",
+            ("sli", "window")))
         self.dispatcher_inflight = r.register(Gauge(
             n + "dispatcher_inflight",
             "In-flight work of the async commit pipeline at scrape time: "
@@ -502,6 +531,16 @@ class SchedulerMetrics:
         # scheduler) takes precedence at scrape time
         for kind in ("api_calls", "drains"):
             self.dispatcher_inflight.set(0.0, kind)
+        for kind in ("assignment", "reason", "verdict"):
+            self.oracle_divergence.inc(kind, by=0)
+        for outcome in ("clean", "divergent", "skipped", "error"):
+            self.shadow_audit_drains.inc(outcome, by=0)
+        self.audit_replay_duration.seed()
+        self.explain_duration.seed()
+        from ..obs.slo import DEFAULT_OBJECTIVES, WINDOWS
+        for sli in DEFAULT_OBJECTIVES:
+            for _secs, window in WINDOWS:
+                self.slo_burn_rate.set(0.0, sli, window)
 
     def sync_compile_ledger(self) -> None:
         """Mirror the process-global compile ledger (perf/ledger.py) into

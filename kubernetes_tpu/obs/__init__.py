@@ -1,0 +1,18 @@
+"""Always-on verification & explainability layer (ISSUE 10).
+
+Three parts, all served by the SchedulerServer's /debug endpoints:
+
+- `audit.py` — shadow-oracle audit: a sampler captures deterministic
+  replay records per drain into a hash-chained ledger, re-executes them
+  through the host oracle on a background worker, and diffs assignments
+  + FailedScheduling reason histograms (`oracle_divergence_total`).
+- `explain.py` — decision provenance: per-bind plugin-level score
+  decomposition (winner + top-k runners-up) via the `explain_row`
+  device kernel, exact when the drain is in the audit ledger.
+- `slo.py` — SLI streams through multi-window (5m/1h/6h) burn-rate
+  tracking with configurable objectives (`scheduler_slo_burn_rate`),
+  evaluated at bench end so `tools/bench_compare.py --slo` gates on
+  breaches, not just throughput medians.
+"""
+
+from .slo import SLOEngine, validate_objectives  # noqa: F401

@@ -1,0 +1,583 @@
+"""Shadow-oracle audit: continuous production-time bind-parity verification.
+
+The paper's headline contract — device bind decisions identical to the
+default host plugins — is verified offline by the parity fuzz suites;
+nothing watched it in live operation. This module is the always-on half
+(`ShadowOracleAudit` gate): a sampler captures a deterministic replay
+record per sampled drain, appends it to a hash-chained drain ledger, and
+a background worker re-executes the record through the HOST ORACLE
+(framework.runtime.schedule_pod — the real plugin implementations, not
+the kernels) and diffs:
+
+  - per-pod assignments           → oracle_divergence_total{kind=assignment}
+  - scheduled/unschedulable       → oracle_divergence_total{kind=verdict}
+  - FailedScheduling reason
+    histograms (reference format) → oracle_divergence_total{kind=reason}
+
+Capture runs at a QUIESCED pipeline point (the scheduler drains pending
+commits and refreshes the snapshot before cloning), so the cloned
+NodeInfos are exactly the state the device carry encodes — a divergence
+is a real decision difference, never capture skew. The replay itself is
+bounded (`shadow_audit_max_replay_pods` prefix — the serial greedy's
+first K decisions depend only on prior state) and runs off the hot path
+on a daemon worker; reason diffs only run on fully-replayed drains and
+only when no external cluster event landed between dispatch and commit
+(the device diagnoses against the commit-time snapshot).
+
+The ledger is a hash chain: each record's sha256 covers the previous
+hash plus the input fingerprints (pod-table rows, node statics gen, plan
+key, gate/strategy fingerprint, carry hash), so any retroactive edit of
+an audited drain breaks `verify()`. With `shadow_audit_dir` set, every
+audited drain also writes a standalone pickle that
+`tools/audit_replay.py` re-runs without a live scheduler.
+
+Full diffs attach to the drain's FlightRecorder entry; /debug/audit
+serves recent audits + divergence detail.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue as _queue
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..framework.interface import CycleState
+from ..framework.types import Diagnosis, FitError, PodInfo
+
+GENESIS = "0" * 64
+
+# submit-queue depth beyond which new samples are dropped (outcome
+# "skipped") instead of growing without bound — the audit must never
+# become a memory leak when the worker falls behind a 100%-sampled soak
+MAX_QUEUE = 64
+
+
+def _sha(*parts) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p if isinstance(p, bytes) else str(p).encode())
+    return h.hexdigest()
+
+
+def gates_fingerprint(gates) -> str:
+    """Stable fingerprint of the feature-gate configuration."""
+    known = gates.known()
+    return _sha(json.dumps(sorted((n, gates.enabled(n)) for n in known)))
+
+
+@dataclass
+class AuditRecord:
+    """One sampled drain: replay inputs + device decisions + verdict."""
+
+    drain_id: int
+    profile_name: str
+    strategy: str
+    weights: dict                  # plugin weights (CLI framework rebuild)
+    pods: list                     # [(uid, Pod, PodInfo)] in queue order
+    nodes: list                    # PRIVATE NodeInfo clones at capture
+    framework: object = None       # live replay framework (not pickled)
+    fingerprints: dict = field(default_factory=dict)
+    ext_gen: int = 0               # scheduler external-mutation counter
+    captured_at: float = 0.0
+    prev_hash: str = GENESIS
+    hash: str = ""
+    # filled at commit time (scheduling thread)
+    device: dict = field(default_factory=dict)      # uid → node | None
+    reasons_dev: dict = field(default_factory=dict)  # uid → message
+    reasons_ok: bool = True        # False: skip the reason diff
+    # filled by the worker
+    outcome: str = "pending"       # clean|divergent|skipped|error|pending
+    skip_reason: str = ""
+    oracle: dict = field(default_factory=dict)
+    reasons_oracle: dict = field(default_factory=dict)
+    diffs: dict = field(default_factory=dict)
+    truncated: bool = False
+    replay_s: float = 0.0
+    # device-side replay context for exact /debug/explain (never pickled)
+    explain_ctx: object = None
+    # the drain's FlightRecord (diff attachment target; never pickled)
+    _flight: object = None
+
+    def chain_bytes(self) -> bytes:
+        return json.dumps({"drain": self.drain_id,
+                           "profile": self.profile_name,
+                           "fingerprints": self.fingerprints},
+                          sort_keys=True).encode()
+
+    def divergence_count(self) -> int:
+        return sum(len(v) for v in self.diffs.values())
+
+    def to_dict(self, details: bool = False) -> dict:
+        d = {"drainId": self.drain_id, "profile": self.profile_name,
+             "pods": len(self.pods), "outcome": self.outcome,
+             "truncated": self.truncated,
+             "divergences": self.divergence_count(),
+             "replaySeconds": round(self.replay_s, 4),
+             "capturedAt": round(self.captured_at, 3),
+             "fingerprints": dict(self.fingerprints),
+             "prevHash": self.prev_hash, "hash": self.hash}
+        if self.skip_reason:
+            d["skipReason"] = self.skip_reason
+        if details or self.diffs:
+            d["diffs"] = self.diffs
+        return d
+
+    def to_payload(self) -> dict:
+        """Standalone-replayable pickle payload (tools/audit_replay.py):
+        everything but the live framework and device arrays."""
+        return {
+            "drainId": self.drain_id, "profile": self.profile_name,
+            "strategy": self.strategy, "weights": dict(self.weights),
+            "pods": [(uid, pod, pi) for uid, pod, pi in self.pods],
+            "nodes": self.nodes,
+            "fingerprints": dict(self.fingerprints),
+            "prevHash": self.prev_hash, "hash": self.hash,
+            "device": dict(self.device),
+            "reasonsDevice": dict(self.reasons_dev),
+            "reasonsOk": self.reasons_ok,
+        }
+
+
+@dataclass
+class ExplainCtx:
+    """Device-side inputs for exact after-the-fact explain: re-running
+    the drain PREFIX through run_batch from the captured carry
+    reconstructs the per-step state any pod's decision was made against
+    (parity between run_batch and the dispatched program is the fuzzed
+    system invariant — and exactly what the audit itself watches)."""
+
+    cfg: object
+    na: object
+    carry0: object        # device copy of the pre-drain carry
+    table: object
+    gd: object
+    fam: object
+    sig: object           # numpy [n]
+    tidx: object          # numpy [n]
+    uids: tuple = ()
+    names: tuple = ()     # node_names at capture (row → name decode)
+    assignments: object = None   # numpy [n], filled at commit
+
+
+# ---------------------------------------------------------------------------
+# host-oracle replay (shared by the worker and tools/audit_replay.py)
+
+
+def replay_decisions(framework, nodes: list, pods: list,
+                     device: Optional[dict] = None, cap: int = 0):
+    """Serial host-oracle replay over PRIVATE NodeInfo clones (mutated in
+    place). Returns (oracle {uid: verdict dict | None}, reasons
+    {uid: message}, truncated).
+
+    The verdict dict carries `host` (the oracle's own tie-break pick),
+    `argmax` (EVERY node tied at max score — the reference breaks ties
+    with a seeded RNG, so any member is a correct decision:
+    runtime.ScheduleResult.argmax_set is the system's documented parity
+    contract) and `scores`. When `device` decisions are given, the
+    replay FOLLOWS the device's placements for pods the device bound, so
+    each step is judged against the actual committed state and one wrong
+    decision counts once instead of cascading.
+
+    Reasons are computed against the POST-REPLAY state — mirroring the
+    device path, whose mask diagnosis runs against the post-commit
+    snapshot (scheduler._device_fit_error)."""
+    from ..framework.runtime import schedule_pod
+    limit = len(pods) if cap <= 0 else min(cap, len(pods))
+    truncated = limit < len(pods)
+    by_name = {ni.name: ni for ni in nodes}
+    oracle: dict = {}
+    failed: list = []
+    for uid, pod, pi in pods[:limit]:
+        state = CycleState()
+        try:
+            result = schedule_pod(framework, state, pod, nodes)
+            oracle[uid] = {"host": result.suggested_host,
+                           "argmax": set(result.argmax_set),
+                           "scores": dict(result.scores)}
+        except FitError:
+            oracle[uid] = None
+            failed.append((uid, pod))
+        # apply the COMMITTED placement (fall back to the oracle's own
+        # pick when no device decision is recorded for this pod)
+        placed = None
+        if device is not None:
+            placed = device.get(uid)
+        elif oracle[uid] is not None:
+            placed = oracle[uid]["host"]
+        if placed is not None and placed in by_name:
+            assumed = pod.with_node_name(placed)
+            by_name[placed].add_pod(
+                PodInfo(pod=assumed, requests=pi.requests,
+                        cpu_nonzero=pi.cpu_nonzero,
+                        mem_nonzero=pi.mem_nonzero))
+    reasons: dict = {}
+    for uid, pod in failed:
+        state = CycleState()
+        diagnosis = Diagnosis()
+        pre_result, status = framework.run_pre_filter_plugins(state, pod,
+                                                              nodes)
+        if not status.is_success():
+            diagnosis.pre_filter_msg = "; ".join(status.reasons)
+            if status.plugin:
+                diagnosis.unschedulable_plugins.add(status.plugin)
+        else:
+            framework.find_nodes_that_pass_filters(state, pod, nodes,
+                                                   pre_result, diagnosis)
+        reasons[uid] = str(FitError(pod, len(nodes), diagnosis))
+    return oracle, reasons, truncated
+
+
+def diff_decisions(rec_device: dict, rec_reasons: dict, oracle: dict,
+                   oracle_reasons: dict, reasons_ok: bool = True) -> dict:
+    """Assignment/verdict/reason diffs over the replayed pod set. An
+    assignment diverges when the device's choice lands OUTSIDE the
+    oracle's argmax set — any tied node is a correct decision (the
+    reference's randomized tie-break), so tie-order differences (e.g.
+    node churn reordering the zone round-robin list against the device
+    row order) are not divergences."""
+    diffs: dict = {"assignment": [], "verdict": [], "reason": []}
+    for uid, verdict in oracle.items():
+        d_node = rec_device.get(uid)
+        if (d_node is None) != (verdict is None):
+            diffs["verdict"].append(
+                {"pod": uid, "device": d_node,
+                 "oracle": verdict["host"] if verdict else None})
+        elif verdict is not None and d_node not in verdict["argmax"]:
+            diffs["assignment"].append(
+                {"pod": uid, "device": d_node, "oracle": verdict["host"],
+                 "deviceScore": verdict["scores"].get(d_node),
+                 "oracleScore": verdict["scores"].get(verdict["host"])})
+        elif d_node is None and reasons_ok:
+            d_msg = rec_reasons.get(uid, "")
+            o_msg = oracle_reasons.get(uid, "")
+            if d_msg != o_msg:
+                diffs["reason"].append(
+                    {"pod": uid, "device": d_msg, "oracle": o_msg})
+    return {k: v for k, v in diffs.items() if v}
+
+
+# ---------------------------------------------------------------------------
+# hash-chained drain ledger
+
+
+class DrainLedger:
+    """Fixed-capacity ring of AuditRecords forming a hash chain.
+
+    Appended by the scheduling thread at capture time (chain order ==
+    dispatch order), outcome fields updated in place by the worker, read
+    by the debug HTTP thread."""
+
+    def __init__(self, capacity: int = 128):
+        self._lock = threading.Lock()
+        self.ring: list = []        # guarded_by: _lock
+        self.capacity = capacity
+        self.head = GENESIS         # guarded_by: _lock
+        self.appended = 0           # guarded_by: _lock
+        # prev_hash of the oldest retained record: verify() anchors here
+        self._window_anchor = GENESIS  # guarded_by: _lock
+
+    def append(self, rec: AuditRecord) -> AuditRecord:
+        with self._lock:
+            rec.prev_hash = self.head
+            rec.hash = _sha(self.head, rec.chain_bytes())
+            self.head = rec.hash
+            self.ring.append(rec)
+            self.appended += 1
+            if len(self.ring) > self.capacity:
+                dropped = self.ring.pop(0)
+                self._window_anchor = dropped.hash
+        return rec
+
+    def verify(self) -> bool:
+        """Recompute the retained window's chain; False = a record was
+        edited after the fact (or the chain was spliced)."""
+        with self._lock:
+            records = list(self.ring)
+            anchor = self._window_anchor
+            head = self.head
+        prev = anchor
+        for rec in records:
+            if rec.prev_hash != prev:
+                return False
+            if _sha(prev, rec.chain_bytes()) != rec.hash:
+                return False
+            prev = rec.hash
+        return prev == head
+
+    def find(self, drain_id: int) -> Optional[AuditRecord]:
+        with self._lock:
+            for rec in reversed(self.ring):
+                if rec.drain_id == drain_id:
+                    return rec
+        return None
+
+    def find_pod(self, uid: str) -> Optional[AuditRecord]:
+        """Newest record whose drain contains the pod (explain lookup)."""
+        with self._lock:
+            for rec in reversed(self.ring):
+                ctx = rec.explain_ctx
+                if ctx is not None and uid in ctx.uids:
+                    return rec
+        return None
+
+    def records(self, limit: int = 0) -> list:
+        with self._lock:
+            out = list(self.ring)
+        if limit and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def dump(self, limit: int = 0, details: bool = False) -> dict:
+        with self._lock:
+            head, appended = self.head, self.appended
+        return {"head": head, "appended": appended,
+                "chainValid": self.verify(),
+                "records": [r.to_dict(details=details)
+                            for r in self.records(limit)]}
+
+
+# ---------------------------------------------------------------------------
+# the audit sampler + background worker
+
+
+class ShadowOracleAudit:
+    """See module docstring. Owned by one Scheduler; the worker thread is
+    lazy (first sampled drain) and a daemon."""
+
+    def __init__(self, sample_rate: float = 1.0 / 64.0,
+                 max_replay_pods: int = 64, dirpath: str = "",
+                 metrics=None, slo=None, gates=None, capacity: int = 32,
+                 synchronous: bool = False):
+        self.sample_rate = float(sample_rate)
+        self.max_replay_pods = int(max_replay_pods)
+        self.dirpath = dirpath
+        self.metrics = metrics
+        self.slo = slo
+        self.ledger = DrainLedger(capacity=capacity)
+        self.synchronous = synchronous
+        self.gates_fp = gates_fingerprint(gates) if gates is not None else ""
+        self._accum = 0.0
+        self._queue: _queue.Queue = _queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+
+    # -- sampling -------------------------------------------------------------
+
+    def want(self) -> bool:
+        """Deterministic rate-accumulator sampling (no RNG: replays must
+        be reproducible run to run)."""
+        if self.sample_rate <= 0.0:
+            return False
+        self._accum += self.sample_rate
+        if self._accum < 1.0:
+            return False
+        self._accum -= 1.0
+        if self._queue.qsize() >= MAX_QUEUE:
+            self._count("skipped")
+            return False
+        return True
+
+    # -- capture (scheduling thread, quiesced pipeline) -----------------------
+
+    def capture(self, drain_id: int, profile, qpis: list, snapshot,
+                batch, n: int, state, builder, ext_gen: int
+                ) -> AuditRecord:
+        """Clone the quiesced snapshot + fingerprint the drain inputs and
+        append to the hash chain. `batch` is the built PodBatch; `state`
+        the ClusterState; `builder` the BatchBuilder (table identity)."""
+        nodes = [ni.snapshot_clone() for ni in snapshot.node_info_list]
+        # carry hash: per-node aggregate state the device carry encodes —
+        # under the quiesce this IS the decision input
+        ch = hashlib.sha256()
+        for ni in nodes:
+            ch.update(ni.name.encode())
+            ch.update(str(sorted(ni.requested.items())).encode())
+            ch.update(str(len(ni.pods)).encode())
+        sig = batch.sig[:n]
+        tidx = batch.tidx[:n]
+        rows = sorted(set(int(t) for t in tidx))
+        table = builder.table
+        row_hash = hashlib.sha256(sig.tobytes())
+        row_hash.update(tidx.tobytes())
+        for u in rows:
+            row_hash.update(table.req[u].tobytes())
+        fingerprints = {
+            "podTableRows": row_hash.hexdigest(),
+            "staticsGen": int(state.statics_gen),
+            "planKey": _sha(builder.reset_count, builder.table_used,
+                            sig.tobytes(), tidx.tobytes(),
+                            profile.score_config.strategy, self.gates_fp),
+            "gates": self.gates_fp,
+            "strategy": profile.score_config.strategy,
+            "carry": ch.hexdigest(),
+            "pods": int(n),
+        }
+        rec = AuditRecord(
+            drain_id=drain_id, profile_name=profile.name,
+            strategy=profile.score_config.strategy,
+            weights=dict(profile.framework.weights),
+            pods=[(q.pod.uid, q.pod, q.pod_info) for q in qpis],
+            nodes=nodes, framework=profile.framework,
+            fingerprints=fingerprints, ext_gen=ext_gen,
+            captured_at=_time.time())
+        return self.ledger.append(rec)
+
+    def attach_device(self, rec: AuditRecord, cfg, na, carry, table,
+                      batch, n: int, gd, fam, names=()) -> None:
+        """Keep the device-side replay inputs for exact explain. The
+        carry is COPIED on device (the dispatch chain donates/consumes
+        the original)."""
+        import jax
+        import numpy as np
+        carry0 = jax.tree_util.tree_map(lambda x: x.copy()
+                                        if hasattr(x, "copy") else x,
+                                        carry)
+        rec.explain_ctx = ExplainCtx(
+            cfg=cfg, na=na, carry0=carry0, table=table, gd=gd, fam=fam,
+            sig=np.array(batch.sig[:n]), tidx=np.array(batch.tidx[:n]),
+            uids=tuple(uid for uid, _p, _pi in rec.pods),
+            names=tuple(names))
+
+    def abandon(self, rec: AuditRecord, reason: str) -> None:
+        """The drain degraded off the audited dispatch path before its
+        results existed (host fallback, overlay, device fault)."""
+        rec.outcome = "skipped"
+        rec.skip_reason = reason
+        self._count("skipped")
+
+    # -- submit (scheduling thread, commit time) ------------------------------
+
+    def submit(self, rec: AuditRecord, out, names: list, fail_msgs: dict,
+               flight_rec=None, ext_gen: int = 0) -> None:
+        """Record the committed device decisions and hand the record to
+        the worker (or process inline in synchronous mode)."""
+        import numpy as np
+        device: dict = {}
+        for i, (uid, _pod, _pi) in enumerate(rec.pods):
+            a = int(out[i]) if i < len(out) else -1
+            device[uid] = names[a] if a >= 0 else None
+        rec.device = device
+        rec.reasons_dev = dict(fail_msgs)
+        # an external cluster event between dispatch and commit moves the
+        # snapshot the device diagnosis reads — assignments stay exact
+        # (computed from the captured carry), reasons are not comparable
+        rec.reasons_ok = ext_gen == rec.ext_gen
+        if rec.explain_ctx is not None:
+            rec.explain_ctx.assignments = np.array(out[:len(rec.pods)])
+        rec._flight = flight_rec
+        if self.synchronous:
+            self._process(rec)
+            return
+        self._ensure_worker()
+        self._queue.put(rec)
+
+    # -- worker ---------------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        with self._worker_lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker = threading.Thread(target=self._run, daemon=True,
+                                            name="shadow-oracle-audit")
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            rec = self._queue.get()
+            try:
+                self._process(rec)
+            except Exception:       # the audit must never kill the worker
+                rec.outcome = "error"
+                self._count("error")
+            finally:
+                self._queue.task_done()
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Wait for every submitted record to finish replaying (tests,
+        bench end)."""
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if self._queue.unfinished_tasks == 0:
+                return
+            _time.sleep(0.01)
+
+    def _process(self, rec: AuditRecord) -> None:
+        t0 = _time.perf_counter()
+        try:
+            # replay over fresh clones: rec.nodes is the LEDGERED capture
+            # state — the CLI pickle and /debug re-read it pristine
+            nodes = [ni.snapshot_clone() for ni in rec.nodes]
+            oracle, oracle_reasons, truncated = replay_decisions(
+                rec.framework, nodes, rec.pods, device=rec.device,
+                cap=self.max_replay_pods)
+        except Exception as e:
+            rec.outcome = "error"
+            rec.skip_reason = f"replay: {e}"
+            rec.replay_s = _time.perf_counter() - t0
+            self._count("error")
+            return
+        rec.replay_s = _time.perf_counter() - t0
+        rec.oracle = oracle
+        rec.reasons_oracle = oracle_reasons
+        rec.truncated = truncated
+        rec.diffs = diff_decisions(
+            rec.device, rec.reasons_dev, oracle, oracle_reasons,
+            reasons_ok=rec.reasons_ok and not truncated)
+        divergent = bool(rec.diffs)
+        rec.outcome = "divergent" if divergent else "clean"
+        if self.metrics is not None:
+            for kind, items in rec.diffs.items():
+                self.metrics.oracle_divergence.inc(kind, by=len(items))
+            self.metrics.audit_replay_duration.observe(rec.replay_s)
+        self._count(rec.outcome)
+        if self.slo is not None:
+            self.slo.observe("divergence", good=0 if divergent else 1,
+                             bad=1 if divergent else 0)
+        flight = getattr(rec, "_flight", None)
+        if flight is not None:
+            flight.audit = {"outcome": rec.outcome,
+                            "divergences": rec.divergence_count(),
+                            "diffs": rec.diffs,
+                            "hash": rec.hash}
+        if self.dirpath:
+            self._persist(rec)
+        if not divergent:
+            # memory bound: a clean record's replay payload (O(nodes)
+            # NodeInfo clones) is no longer needed — the hash chain,
+            # fingerprints and explain context stay; divergent records
+            # keep everything for the post-mortem (and the pickle, when
+            # persistence is on, already captured the full payload)
+            rec.nodes = []
+            rec.oracle = {}
+            rec.reasons_oracle = {}
+
+    def _count(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.shadow_audit_drains.inc(outcome)
+
+    def _persist(self, rec: AuditRecord) -> None:
+        try:
+            os.makedirs(self.dirpath, exist_ok=True)
+            path = os.path.join(self.dirpath,
+                                f"drain_{rec.drain_id:08d}.pkl")
+            with open(path, "wb") as f:
+                pickle.dump(rec.to_payload(), f)
+        except Exception:           # persistence is best-effort
+            pass
+
+    # -- serving --------------------------------------------------------------
+
+    def dump(self, limit: int = 32, details: bool = False) -> dict:
+        d = self.ledger.dump(limit=limit, details=details)
+        d["sampleRate"] = self.sample_rate
+        d["maxReplayPods"] = self.max_replay_pods
+        d["queued"] = self._queue.qsize()
+        return d

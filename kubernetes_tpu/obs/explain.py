@@ -1,0 +1,225 @@
+"""Decision provenance: per-bind plugin-level score decomposition.
+
+`diagnose_row` (PR 4) answers "why was this pod rejected everywhere";
+this module answers the complement for PLACED pods — "why did pod X land
+on node Y instead of Z" — via the `explain_row` kernel
+(ops/program.py): the winning node and the top-k runners-up with each
+plugin's weighted score contribution and the win margin.
+
+Two modes, served as /debug/explain?pod=<uid>:
+
+- **exact** — the pod's drain is in the shadow-audit ledger
+  (obs/audit.py): the drain PREFIX up to the pod replays through
+  `run_batch` from the captured pre-drain carry, reconstructing the
+  exact per-step state its decision was made against; the reported
+  winner is bit-identical to the committed bind (run_batch ≡ the
+  dispatched program is the fuzzed system invariant, and exactly what
+  the audit watches). This is what makes every SAMPLED bind attributable
+  to a plugin-level score delta at any time after the fact.
+- **current_state** — the drain has left the ledger (or was never
+  sampled): the decomposition evaluates against the live post-commit
+  state with the pod's own RESOURCES removed from its bound node (group
+  counters and port bookkeeping are not unwound — flagged in the
+  output), the same trade `kubectl describe`-style tooling makes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops.program import (EXPLAIN_COLUMNS, PodXs, explain_row,
+                           initial_carry, run_batch)
+
+# short column headers for the rendered table, EXPLAIN_COLUMNS order
+_HEADERS = ("Fit", "Balanced", "Taint", "NodeAffinity", "Image", "Groups")
+
+
+def _copy_carry(carry):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: x.copy() if hasattr(x, "copy") else x, carry)
+
+
+def _assemble(uid: str, mode: str, names, idx, totals, cols, n_feasible,
+              bound: Optional[str], extra: dict, k: int) -> dict:
+    idx = np.asarray(idx)
+    totals = np.asarray(totals)
+    cols = np.asarray(cols)
+    ranked = []
+    for r in range(min(k, len(idx))):
+        if totals[r] < 0:
+            break
+        node_i = int(idx[r])
+        ranked.append({
+            "node": names[node_i] if node_i < len(names) else f"#{node_i}",
+            "total": int(totals[r]),
+            "columns": {name: int(cols[r, c])
+                        for c, name in enumerate(EXPLAIN_COLUMNS)},
+        })
+    margin = (int(totals[0] - totals[1])
+              if len(ranked) >= 2 else None)
+    out = {
+        "pod": uid, "mode": mode, "boundNode": bound,
+        "feasibleNodes": int(n_feasible),
+        "winner": ranked[0] if ranked else None,
+        "margin": margin,
+        "runnersUp": ranked[1:],
+        **extra,
+    }
+    out["rendered"] = _render(out)
+    return out
+
+
+def _render(d: dict) -> str:
+    """Reference-format text table (the /debug/explain human form)."""
+    lines = [f"pod {d['pod']}: "
+             + (f"bound to {d['boundNode']}" if d["boundNode"]
+                else "not bound")
+             + f" [{d['mode']}]"]
+    winner = d.get("winner")
+    if winner is None:
+        lines.append(f"  no feasible node "
+                     f"({d['feasibleNodes']} feasible)")
+        return "\n".join(lines)
+    margin = d.get("margin")
+    lines.append(
+        f"  top {1 + len(d['runnersUp'])} of {d['feasibleNodes']} "
+        "feasible nodes"
+        + (f", win margin +{margin}" if margin is not None else ""))
+    width = max(len(winner["node"]),
+                *(len(r["node"]) for r in d["runnersUp"])) \
+        if d["runnersUp"] else len(winner["node"])
+    width = max(width, 4)
+    header = ("  #  " + "node".ljust(width) + "  total  "
+              + "  ".join(h.rjust(len(h)) for h in _HEADERS))
+    lines.append(header)
+    for rank, row in enumerate([winner] + d["runnersUp"], start=1):
+        cells = "  ".join(
+            str(row["columns"][name]).rjust(len(h))
+            for name, h in zip(EXPLAIN_COLUMNS, _HEADERS))
+        lines.append(f"  {rank}  " + row["node"].ljust(width)
+                     + f"  {str(row['total']).rjust(5)}  " + cells)
+    return "\n".join(lines)
+
+
+def _prefix_carry(ctx, i: int, carry):
+    """Carry after the drain's first `i` pods: one run_batch dispatch
+    over the prefix (the donated input is the caller's throwaway copy)."""
+    from ..state.tensorize import pow2_at_least
+    bucket = pow2_at_least(i)
+    valid = np.zeros((bucket,), bool)
+    valid[:i] = True
+    sig = np.full((bucket,), ctx.sig[i - 1], np.int32)
+    sig[:i] = ctx.sig[:i]
+    tidx = np.full((bucket,), ctx.tidx[i - 1], np.int32)
+    tidx[:i] = ctx.tidx[:i]
+    xs = PodXs(valid=valid, sig=sig, tidx=tidx)
+    return run_batch(ctx.cfg, ctx.na, carry, xs, ctx.table,
+                     groups=ctx.gd, fam=ctx.fam)[0]
+
+
+def _explain_exact(rec, uid: str, k: int) -> dict:
+    """Replay the audited drain's prefix and decompose the pod's step."""
+    ctx = rec.explain_ctx
+    i = ctx.uids.index(uid)
+    carry = _copy_carry(ctx.carry0)
+    if i > 0:
+        carry = _prefix_carry(ctx, i, carry)
+    idx, totals, cols, n_feas = explain_row(
+        ctx.cfg, ctx.na, carry, ctx.table, int(ctx.tidx[i]), k=k,
+        gd=ctx.gd, fam=ctx.fam)
+    actual = int(ctx.assignments[i]) if ctx.assignments is not None else -1
+    bound = ctx.names[actual] if 0 <= actual < len(ctx.names) else None
+    winner_i = int(np.asarray(idx)[0])
+    matches = (actual >= 0 and winner_i == actual
+               and int(np.asarray(totals)[0]) >= 0) \
+        or (actual < 0 and int(np.asarray(totals)[0]) < 0)
+    return _assemble(uid, "exact", ctx.names, idx, totals, cols, n_feas,
+                     bound,
+                     {"drainId": rec.drain_id, "drainIndex": i,
+                      "matchesBind": bool(matches),
+                      "ledgerHash": rec.hash}, k)
+
+
+def _explain_current(scheduler, pod, uid: str, k: int) -> dict:
+    """Decompose against the live post-commit state, the pod's own
+    resources removed from its bound node."""
+    import jax.numpy as jnp
+    from ..framework.types import PodInfo
+    from ..ops.groups import to_device
+    from ..ops.program import PodTableDev
+    scheduler._drain_pending()
+    scheduler.cache.update_snapshot(scheduler.snapshot)
+    scheduler.state.apply_snapshot(scheduler.snapshot)
+    scheduler.state.ensure_arrays()
+    ent = scheduler.builder._lookup(pod)
+    if ent[0] != "row":
+        return {"pod": uid, "error": "pod signature has no tensor form "
+                                     "(host-fallback pod); explain "
+                                     "unavailable"}
+    tidx = ent[2]
+    builder = scheduler.builder
+    na = scheduler.state.device_arrays()
+    table = PodTableDev(*(jnp.asarray(getattr(builder.table, f))
+                          for f in PodTableDev._fields))
+    gd = fam = gcarry = None
+    groups_needed = (
+        builder.groups.any_groups()
+        or bool(scheduler.snapshot.have_pods_with_affinity_list)
+        or bool(scheduler.snapshot
+                .have_pods_with_required_anti_affinity_list))
+    if groups_needed:
+        gd_np, gc_np = builder.groups.build_dev(scheduler.snapshot)
+        gd, gcarry = to_device(gd_np), to_device(gc_np)
+        fam = builder.groups.families(scheduler.snapshot)
+    carry = initial_carry(na, gcarry)
+    bound = pod.spec.node_name or None
+    self_excluded = False
+    if bound:
+        b = scheduler.state.node_index.get(bound)
+        if b is not None:
+            pi = PodInfo.of(pod)
+            req = scheduler.state.rtable.vector(pi.requests)
+            vec = np.zeros((int(carry.used.shape[1]),), np.int64)
+            vec[:len(req)] = req
+            carry = carry._replace(
+                used=carry.used.at[b].add(-jnp.asarray(vec)),
+                nonzero_used=carry.nonzero_used.at[b].add(
+                    -jnp.asarray([pi.cpu_nonzero, pi.mem_nonzero],
+                                 dtype=carry.nonzero_used.dtype)),
+                npods=carry.npods.at[b].add(-1))
+            self_excluded = True
+    cfg = scheduler.profiles[pod.spec.scheduler_name].score_config \
+        if pod.spec.scheduler_name in scheduler.profiles \
+        else next(iter(scheduler.profiles.values())).score_config
+    idx, totals, cols, n_feas = explain_row(cfg, na, carry, table, tidx,
+                                            k=k, gd=gd, fam=fam)
+    names = scheduler.state.node_names
+    return _assemble(uid, "current_state", names, idx, totals, cols,
+                     n_feas, bound,
+                     {"selfExcluded": {"resources": self_excluded,
+                                       "groups": False, "ports": False}},
+                     k)
+
+
+def explain_pod(scheduler, uid: str, k: int = 5) -> dict:
+    """The /debug/explain entry: exact replay when the pod's drain is in
+    the audit ledger, current-state decomposition otherwise."""
+    k = max(1, min(int(k), 16))
+    pod = None
+    ps = scheduler.cache.pod_states.get(uid)
+    if ps is not None:
+        pod = ps.pod
+    if pod is None:
+        pod = getattr(scheduler.client, "pods", {}).get(uid)
+    if pod is None:
+        return {"pod": uid, "error": "pod not found"}
+    audit = getattr(scheduler, "audit", None)
+    if audit is not None:
+        rec = audit.ledger.find_pod(uid)
+        if (rec is not None and rec.explain_ctx is not None
+                and rec.explain_ctx.assignments is not None):
+            return _explain_exact(rec, uid, k)
+    return _explain_current(scheduler, pod, uid, k)

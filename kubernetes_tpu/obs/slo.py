@@ -1,0 +1,218 @@
+"""SLO engine: multi-window burn-rate tracking over the scheduler's SLIs.
+
+Classic SRE burn-rate alerting (error budget consumption rate over
+several look-back windows) applied to the drain pipeline. Each SLI is an
+error RATIO stream — good/bad event counts fed from the scheduler's
+existing observation sites:
+
+  attempt_latency   drain attempts slower than the latency objective
+  e2e_latency       queue→bind SLI durations beyond the e2e objective
+  device_fallback   drains degraded off the device tier (faults, breaker)
+  divergence        shadow-oracle audits that found ANY divergence
+  gang_quorum_wait  gang quorum waits beyond the wait objective
+
+Events land in fixed-resolution time buckets (one shared ring per SLI);
+each window's error rate is the bucket sum over its look-back, and
+
+  burn_rate(sli, window) = error_rate / (1 - objective)
+
+i.e. 1.0 = consuming exactly the error budget, >1 = burning it down.
+Breach thresholds follow the standard multi-window ladder (fast burn on
+the short window, slow burn on the long one); `breaches()` is what
+`tools/bench_compare.py --slo` gates on at bench end.
+
+Written by the scheduling thread and the audit worker, read by the
+metrics scrape (`scheduler_slo_burn_rate{sli,window}` callback gauge)
+and /debug/slo — one lock covers the rings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# (seconds, label) — the reference multi-window ladder
+WINDOWS = ((300, "5m"), (3600, "1h"), (21600, "6h"))
+
+# default breach thresholds per window (Google SRE workbook fast/slow
+# burn ladder: 14.4x on the short window pages, 1x on the long window
+# means the budget is exactly exhausted at period end)
+DEFAULT_MAX_BURN = {"5m": 14.4, "1h": 6.0, "6h": 1.0}
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One SLI's objective: target good-fraction + latency bound."""
+
+    objective: float                 # e.g. 0.99 → 1% error budget
+    threshold_s: float = 0.0         # latency SLIs: bad when > threshold
+    max_burn: dict = field(default_factory=lambda: dict(DEFAULT_MAX_BURN))
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.objective, 1e-9)
+
+
+DEFAULT_OBJECTIVES = {
+    "attempt_latency": Objective(0.99, threshold_s=1.0),
+    "e2e_latency": Objective(0.99, threshold_s=5.0),
+    "device_fallback": Objective(0.999),
+    "divergence": Objective(0.9999),
+    "gang_quorum_wait": Objective(0.99, threshold_s=30.0),
+}
+
+
+def parse_objectives(overrides: Optional[dict]) -> dict:
+    """Config `sloObjectives` overrides → {sli: Objective}; unknown sli
+    names and out-of-range objectives are rejected (config validation)."""
+    out = dict(DEFAULT_OBJECTIVES)
+    for sli, spec in (overrides or {}).items():
+        base = out.get(sli)
+        if base is None:
+            raise ValueError(
+                f"unknown SLI {sli!r} in sloObjectives (known: "
+                f"{sorted(out)})")
+        obj = float(spec.get("objective", base.objective))
+        if not 0.0 < obj < 1.0:
+            raise ValueError(f"sloObjectives[{sli!r}].objective must be "
+                             "in (0, 1)")
+        burn = dict(base.max_burn)
+        for w, v in (spec.get("maxBurn") or {}).items():
+            if w not in burn:
+                raise ValueError(f"unknown burn window {w!r} (known: "
+                                 f"{sorted(burn)})")
+            burn[w] = float(v)
+        out[sli] = Objective(
+            objective=obj,
+            threshold_s=float(spec.get("thresholdSeconds",
+                                       base.threshold_s)),
+            max_burn=burn)
+    return out
+
+
+def validate_objectives(overrides: Optional[dict]) -> None:
+    parse_objectives(overrides)
+
+
+class SLOEngine:
+    """Per-SLI good/bad bucket rings + burn-rate evaluation."""
+
+    BUCKET_S = 10.0
+
+    def __init__(self, clock: Callable[[], float] = _time.monotonic,
+                 objectives: Optional[dict] = None):
+        self.clock = clock
+        self.objectives = parse_objectives(objectives)
+        self._lock = threading.Lock()
+        # sli → list of [bucket_epoch, good, bad], oldest first, pruned
+        # to the longest window on write
+        self._buckets: dict[str, list] = {}   # guarded_by: _lock
+        self._totals: dict[str, list] = {     # guarded_by: _lock
+            sli: [0, 0] for sli in self.objectives}
+
+    def threshold(self, sli: str) -> float:
+        return self.objectives[sli].threshold_s
+
+    # -- recording ------------------------------------------------------------
+
+    def observe(self, sli: str, good: int = 0, bad: int = 0) -> None:
+        if not good and not bad:
+            return
+        epoch = int(self.clock() / self.BUCKET_S)
+        horizon = epoch - int(WINDOWS[-1][0] / self.BUCKET_S) - 1
+        with self._lock:
+            ring = self._buckets.setdefault(sli, [])
+            if ring and ring[-1][0] == epoch:
+                ring[-1][1] += good
+                ring[-1][2] += bad
+            else:
+                ring.append([epoch, good, bad])
+                while ring and ring[0][0] < horizon:
+                    ring.pop(0)
+            tot = self._totals.setdefault(sli, [0, 0])
+            tot[0] += good
+            tot[1] += bad
+
+    # -- evaluation -----------------------------------------------------------
+
+    def _rates(self) -> dict:
+        """sli → {window: (good, bad)} over each look-back window."""
+        now_epoch = int(self.clock() / self.BUCKET_S)
+        with self._lock:
+            rings = {sli: [tuple(b) for b in ring]
+                     for sli, ring in self._buckets.items()}
+        out: dict = {}
+        for sli in self.objectives:
+            ring = rings.get(sli, [])
+            per = {}
+            for secs, label in WINDOWS:
+                lo = now_epoch - int(secs / self.BUCKET_S)
+                good = bad = 0
+                for epoch, g, b in ring:
+                    if epoch > lo:
+                        good += g
+                        bad += b
+                per[label] = (good, bad)
+            out[sli] = per
+        return out
+
+    def burn_rates(self) -> dict:
+        """sli → {window: burn rate} (0.0 with no traffic)."""
+        out: dict = {}
+        for sli, per in self._rates().items():
+            budget = self.objectives[sli].budget
+            out[sli] = {}
+            for label, (good, bad) in per.items():
+                total = good + bad
+                rate = (bad / total) if total else 0.0
+                out[sli][label] = rate / budget
+        return out
+
+    def breaches(self) -> list:
+        """Every (sli, window) whose burn rate exceeds its configured
+        threshold — the bench/alerting gate."""
+        out = []
+        for sli, per in self.burn_rates().items():
+            burn_cfg = self.objectives[sli].max_burn
+            for label, burn in per.items():
+                if burn > burn_cfg.get(label, float("inf")):
+                    out.append({"sli": sli, "window": label,
+                                "burn": round(burn, 3),
+                                "threshold": burn_cfg[label]})
+        return out
+
+    def gauge_callback(self) -> dict:
+        """scheduler_slo_burn_rate{sli,window} values at scrape time."""
+        return {(sli, label): burn
+                for sli, per in self.burn_rates().items()
+                for label, burn in per.items()}
+
+    def snapshot(self, compact: bool = False) -> dict:
+        """/debug/slo payload; `compact` is the bench-extras form."""
+        with self._lock:
+            totals = {sli: {"good": t[0], "bad": t[1]}
+                      for sli, t in self._totals.items()}
+        burns = self.burn_rates()
+        breaches = self.breaches()
+        if compact:
+            return {
+                "breaches": breaches,
+                "divergence_bad": totals.get("divergence",
+                                             {"bad": 0})["bad"],
+                "max_burn": round(max((b for per in burns.values()
+                                       for b in per.values()),
+                                      default=0.0), 3),
+            }
+        return {
+            "objectives": {
+                sli: {"objective": o.objective,
+                      "thresholdSeconds": o.threshold_s,
+                      "maxBurn": dict(o.max_burn)}
+                for sli, o in self.objectives.items()},
+            "totals": totals,
+            "burnRates": {sli: {w: round(b, 4) for w, b in per.items()}
+                          for sli, per in burns.items()},
+            "breaches": breaches,
+        }

@@ -641,6 +641,83 @@ def diagnose_row(na: NodeArrays, table: PodTableDev, tidx: int,
                                 jnp.int32(tidx))
 
 
+# ---------------------------------------------------------------------------
+# decision provenance: per-plugin score decomposition (ISSUE 10)
+#
+# diagnose_row answers "why did every node REJECT this pod"; explain_row
+# answers the complement — "why did the winning node WIN": the per-plugin
+# score columns of the top-k feasible nodes, evaluated through the exact
+# scan-step formula (_eval_pod), so the reported winner and margin are
+# bit-identical to the argmax the dispatched program took at the same
+# carry state.
+
+# explain column order (host rendering maps these to plugin names):
+# weighted Fit, BalancedAllocation, TaintToleration, NodeAffinity,
+# ImageLocality, and the combined group contribution
+# (PodTopologySpread + InterPodAffinity — group_scores returns their sum)
+EXPLAIN_COLUMNS = ("NodeResourcesFit", "NodeResourcesBalancedAllocation",
+                   "TaintToleration", "NodeAffinity", "ImageLocality",
+                   "PodTopologySpread+InterPodAffinity")
+
+
+def _explain_masks(cfg: ScoreConfig, na: NodeArrays, carry: Carry, tidx,
+                   k: int, table: PodTableDev, groups, fam):
+    pod = _gather_row(table, PodXs(valid=jnp.bool_(True),
+                                   sig=jnp.int32(0), tidx=tidx))
+    feasible, total, parts = _eval_pod(cfg, na, carry, pod, groups=groups,
+                                       tidx=tidx, fam=fam)
+    masked = jnp.where(feasible, total, jnp.int64(-1))
+    s_taint = default_normalize(parts.taint_raw, feasible, reverse=True)
+    s_na = default_normalize(parts.na_raw, feasible, reverse=False)
+    base = (cfg.w_fit * parts.s_fit + cfg.w_balanced * parts.s_bal
+            + cfg.w_taint * s_taint + cfg.w_node_affinity * s_na
+            + cfg.w_image * parts.s_img)
+    cols = jnp.stack([cfg.w_fit * parts.s_fit,
+                      cfg.w_balanced * parts.s_bal,
+                      cfg.w_taint * s_taint,
+                      cfg.w_node_affinity * s_na,
+                      cfg.w_image * parts.s_img,
+                      total - base], axis=1)            # [N, 6]
+    # scores bounded by 100·Σweights: the int32 top_k (ties → lowest
+    # index) reproduces the scan's first-max argmax tie-break exactly
+    _, idx = lax.top_k(masked.astype(jnp.int32), k)
+    idx = idx.astype(jnp.int32)
+    return idx, masked[idx], cols[idx], jnp.sum(feasible).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "fam"))
+def _explain_groups(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                    table: PodTableDev, tidx, k: int, gd, fam):
+    return _explain_masks(cfg, na, carry, tidx, k, table, gd, fam)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k"))
+def _explain_lean(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                  table: PodTableDev, tidx, k: int):
+    return _explain_masks(cfg, na, carry, tidx, k, table, None, None)
+
+
+def explain_row(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
+                table: PodTableDev, tidx: int, k: int = 8, gd=None,
+                fam=None):
+    """Score decomposition of signature row `tidx` against `carry`:
+    (topk_idx i32 [k], topk_total i64 [k] (-1 = infeasible slot),
+    topk_cols i64 [k, 6] per-plugin weighted contributions in
+    EXPLAIN_COLUMNS order, feasible_count i32). topk_idx[0] is
+    bit-identical to the argmax the scan/plan program takes for this row
+    at this carry (same _eval_pod formula, same tie-break); the win
+    margin is topk_total[0] - topk_total[1]."""
+    if gd is not None:
+        na, carry, table = RAILS.stage((na, carry, table))
+        gd = RAILS.stage(gd)
+        return LEDGER.measured_call("explain_row", _explain_groups, cfg,
+                                    na, carry, table, jnp.int32(tidx), k,
+                                    gd, fam)
+    na, carry, table = RAILS.stage((na, carry, table))
+    return LEDGER.measured_call("explain_row", _explain_lean, cfg, na,
+                                carry, table, jnp.int32(tidx), k)
+
+
 @jax.jit
 def _scatter_rows_jit(dev: NodeArrays, idx, rows: NodeArrays) -> NodeArrays:
     return NodeArrays(*(d.at[idx].set(r) for d, r in zip(dev, rows)))

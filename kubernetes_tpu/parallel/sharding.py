@@ -192,17 +192,33 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                       groups: GroupsDev | None = None, fam=None):
     """Ledger-instrumented entry for `_run_batch_sharded_jit` (compile
     ledger: perf/ledger.py — the sharded program's compiles are the
-    expensive ones, one executable per mesh shape)."""
+    expensive ones, one executable per mesh shape). Host-side per-pod
+    inputs are explicitly staged like every single-device entry, so the
+    mesh path runs under the sanitizer rails' ambient transfer guard
+    too (ISSUE 10 satellite: run_batch_sharded was the only JIT entry
+    outside the rails/ledger coverage)."""
+    from ..analysis.rails import GLOBAL as RAILS
     from ..perf.ledger import GLOBAL as LEDGER
+    pods, table = RAILS.stage((pods, table))
     return LEDGER.measured_call("run_batch_sharded", _run_batch_sharded_jit,
                                 cfg, mesh, na, carry, pods, table, groups,
                                 fam)
 
 
+def _note_shard_upload(phase: str, tree) -> None:
+    """Attribute a mesh placement's H2D bytes to its drain phase — the
+    same `scheduler_h2d_bytes_total{phase}` surface the single-device
+    uploads report through (perf/ledger.py)."""
+    from ..perf.ledger import GLOBAL as LEDGER
+    LEDGER.note_h2d_tree(phase, tree)
+
+
 def shard_node_arrays(mesh: Mesh, na: NodeArrays) -> NodeArrays:
     """Place the staging arrays onto the mesh, node axis split."""
     spec = NamedSharding(mesh, P(NODE_AXIS))
-    return NodeArrays(*(jax.device_put(jnp.asarray(x), spec) for x in na))
+    out = NodeArrays(*(jax.device_put(jnp.asarray(x), spec) for x in na))
+    _note_shard_upload("host_snapshot", out)
+    return out
 
 
 def shard_groups(mesh: Mesh, gd: GroupsDev) -> GroupsDev:
@@ -215,7 +231,9 @@ def shard_groups(mesh: Mesh, gd: GroupsDev) -> GroupsDev:
         else:
             spec = NamedSharding(mesh, P())
         out[name] = jax.device_put(arr, spec)
-    return GroupsDev(**out)
+    gd = GroupsDev(**out)
+    _note_shard_upload("host_group_seed", gd)
+    return gd
 
 
 def shard_group_carry(mesh: Mesh, gc: GroupCarry) -> GroupCarry:
@@ -227,4 +245,6 @@ def shard_group_carry(mesh: Mesh, gc: GroupCarry) -> GroupCarry:
         else:
             spec = NamedSharding(mesh, P())
         out[name] = jax.device_put(arr, spec)
-    return GroupCarry(**out)
+    gc = GroupCarry(**out)
+    _note_shard_upload("host_group_seed", gc)
+    return gc

@@ -288,8 +288,17 @@ class WorkloadRunner:
         self.create_batch = create_batch
         self.trace = trace
         self.last_tracer = None
-        self.factory = scheduler_factory or (
-            lambda api: Scheduler(api, batch_size=batch_size))
+        self.factory = scheduler_factory or self._default_factory
+
+    def _default_factory(self, api: APIServer) -> Scheduler:
+        sched = Scheduler(api, batch_size=self.batch_size)
+        # KTPU_AUDIT_SAMPLE=1.0 forces the shadow audit onto every drain
+        # (the acceptance sweep: a full bench at 100% sampling must
+        # record zero divergences); unset = the config default rate
+        rate = os.environ.get("KTPU_AUDIT_SAMPLE")
+        if rate and sched.audit is not None:
+            sched.audit.sample_rate = float(rate)
+        return sched
 
     def run(self, tc: TestCase, wl: Workload, verbose: bool = False) -> list[DataItem]:
         api = APIServer()
@@ -470,6 +479,22 @@ class WorkloadRunner:
             # hottest host frames of the run (continuous profiler): the
             # function-level answer behind the host_*_s phase sums
             extras["host_top_frames"] = prof.top_frames(5)
+        # SLO verdict at bench end (obs/slo.py): burn-rate breaches +
+        # shadow-audit divergence — the bench_compare --slo gate input.
+        # The audit worker must land its in-flight replays first.
+        audit = getattr(sched, "audit", None)
+        if audit is not None:
+            audit.flush(timeout=120.0)
+        slo_engine = getattr(sched, "slo", None)
+        if slo_engine is not None:
+            slo = slo_engine.snapshot(compact=True)
+            slo["audited"] = int(
+                m.shadow_audit_drains.value("clean")
+                + m.shadow_audit_drains.value("divergent"))
+            slo["divergence_total"] = int(
+                sum(m.oracle_divergence.value(kind)
+                    for kind in ("assignment", "reason", "verdict")))
+            extras["slo"] = slo
         for item in items:
             item.op_seconds = list(op_times)
             item.extras = dict(extras)

@@ -243,6 +243,9 @@ class _PendingDrain:
     gang_accepted: bool = False
     gang_raw: object = None      # raw per-member assignments (pre-unwind)
     gang_placed: int = 0
+    # shadow-oracle audit record captured for this drain (obs/audit.py);
+    # None = unsampled. Submitted with the committed decisions.
+    audit: object = None
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -418,6 +421,39 @@ class Scheduler:
         from .events import EventRecorder, FlightRecorder
         self.events = EventRecorder(clock=clock, metrics=self.metrics)
         self.flight = FlightRecorder()
+        # SLO burn-rate engine (obs/slo.py): SLI good/bad streams through
+        # multi-window (5m/1h/6h) burn tracking; the burn-rate gauge is a
+        # scrape-time callback and /debug/slo serves the full snapshot
+        from .obs.slo import SLOEngine
+        self.slo = SLOEngine(
+            clock=clock,
+            objectives=(config.slo_objectives if config is not None
+                        else None))
+        self.metrics.slo_burn_rate.callback = self.slo.gauge_callback
+        # external-mutation counter: bumped with every device-state
+        # invalidation; the shadow audit compares it across a drain's
+        # dispatch→commit window (reason diffs are only valid when the
+        # snapshot the device diagnosis read didn't move underneath)
+        self._ext_mutations = 0
+        # shadow-oracle audit (obs/audit.py, `ShadowOracleAudit` gate):
+        # sampled drains are captured into the hash-chained ledger and
+        # re-executed through the host oracle on a background worker
+        self.audit = None
+        if self.feature_gates.enabled("ShadowOracleAudit"):
+            from .obs.audit import ShadowOracleAudit
+            self.audit = ShadowOracleAudit(
+                sample_rate=(config.shadow_audit_sample_rate
+                             if config is not None else 1.0 / 64.0),
+                max_replay_pods=(config.shadow_audit_max_replay_pods
+                                 if config is not None else 64),
+                dirpath=(config.shadow_audit_dir
+                         if config is not None else ""),
+                metrics=self.metrics, slo=self.slo,
+                gates=self.feature_gates)
+        # test-only decision-perturbation hook (tests/test_chaos.py):
+        # a callable(pd, out) mutating resolved assignments in place —
+        # proof that the shadow audit can actually fail
+        self._test_assignment_perturb = None
         # jax.profiler session directory (config profilerTraceDir; "" = off)
         self.profiler_trace_dir = (
             config.profiler_trace_dir if config is not None else "")
@@ -767,6 +803,7 @@ class Scheduler:
 
     def _invalidate_device_state(self) -> None:
         self._device_carry = None
+        self._ext_mutations += 1
 
     def _on_pod_add(self, pod: Pod) -> None:
         self.workload_manager.add_pod(pod)
@@ -985,9 +1022,12 @@ class Scheduler:
         live = self.queue.gated_refs()
         for ref in list(self._gang_gated_since):
             if ref not in live:
-                self.metrics.gang_quorum_wait.observe(
-                    max(self.clock() - self._gang_gated_since.pop(ref),
-                        0.0))
+                wait = max(self.clock() - self._gang_gated_since.pop(ref),
+                           0.0)
+                self.metrics.gang_quorum_wait.observe(wait)
+                bad = wait > self.slo.threshold("gang_quorum_wait")
+                self.slo.observe("gang_quorum_wait",
+                                 good=0 if bad else 1, bad=1 if bad else 0)
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -1248,6 +1288,17 @@ class Scheduler:
         from .ops.groups import scatter_new_rows, to_device
 
         ph: dict[str, float] = {}
+        # shadow-audit sampling decision: a sampled drain quiesces the
+        # commit pipeline FIRST so the snapshot clone captured below is
+        # exactly the state the device carry encodes (obs/audit.py) —
+        # divergence then means a decision difference, never capture skew
+        audit_want = (self.audit is not None and gang is None
+                      and self.audit.want())
+        audit_rec = None
+        if audit_want:
+            with self.tracer.span("audit_quiesce", drain=did):
+                self._drain_pending()
+                self.cache.update_snapshot(self.snapshot)
         with self.tracer.span("host_build", pods=len(qpis), drain=did), \
                 self.phase_track.scope("host_build"):
             carry = self._device_carry
@@ -1291,7 +1342,26 @@ class Scheduler:
                 self._drain_pending()
                 return sum(1 if self._schedule_one_host(q) else 0
                            for q in qpis)
-            na = self._node_arrays()
+            if audit_want:
+                # clone + fingerprint + hash-chain append: the snapshot
+                # was refreshed at the quiesce above and nothing between
+                # there and here mutates the cache
+                with self.tracer.span("audit_capture", drain=did):
+                    audit_rec = self.audit.capture(
+                        did, profile, qpis, self.snapshot, segment_batch,
+                        len(qpis), self.state, self.builder,
+                        self._ext_mutations)
+            if (self.mesh is not None
+                    and (self._na_sharded is None
+                         or self._na_sharded_gen != self.state.staging_gen)):
+                # the mesh-placed node upload is a real drain phase:
+                # cover it with the same span/ledger surface as the
+                # single-device snapshot uploads (run_batch_sharded
+                # previously had no drain_phase/h2d attribution)
+                with self._phase("host_snapshot", ph):
+                    na = self._node_arrays()
+            else:
+                na = self._node_arrays()
             # group kernels are needed when any signature row carries spread
             # or inter-pod affinity constraints, or when existing cluster
             # pods do (affinity is symmetric: they veto/score ANY incoming
@@ -1314,8 +1384,10 @@ class Scheduler:
                     segment_batch, len(qpis), profile).scan_only:
                 # host greedy is the FALLBACK tier for group drains no
                 # compiled program covers (gate off, short spans, mixes
-                # beyond the plan lattice)
-                bound = self._try_host_greedy(qpis, profile, segment_batch)
+                # beyond the plan lattice). A sampled drain stays audited
+                # — the greedy's decisions face the same oracle replay.
+                bound = self._try_host_greedy(qpis, profile, segment_batch,
+                                              audit=audit_rec)
                 if bound is not None:
                     return bound
             table_reset = self.builder.reset_count != self._builder_reset_seen
@@ -1369,6 +1441,8 @@ class Scheduler:
                         # a bind error during the drain invalidated the
                         # carry: restart this dispatch against the reseeded
                         # state
+                        if audit_rec is not None:
+                            self.audit.abandon(audit_rec, "restarted")
                         return self._dispatch_device_drain(qpis, profile,
                                                            prebuilt)
                     if (self.builder.groups.device_rows(),
@@ -1379,6 +1453,8 @@ class Scheduler:
                         # resident group tensors are too small to scatter
                         # into — reseed instead
                         self._invalidate_device_state()
+                        if audit_rec is not None:
+                            self.audit.abandon(audit_rec, "restarted")
                         return self._dispatch_device_drain(qpis, profile,
                                                            prebuilt)
                     self.cache.update_snapshot(self.snapshot)
@@ -1407,11 +1483,19 @@ class Scheduler:
                     if groups_needed or not self._overlay_eligible(qpis):
                         # groups: nominated pods' labels feed group counts,
                         # which the resource-only overlay cannot represent
+                        if audit_rec is not None:
+                            self.audit.abandon(audit_rec, "host_path")
                         self._drain_pending()
                         return sum(1 if self._schedule_one_host(q) else 0
                                    for q in qpis)
                     ovl = self._build_overlay(na)
                     nom = self._nominated_rows(qpis)
+                    if audit_rec is not None:
+                        # the nominated-pod overlay is outside the audit's
+                        # replay model (the oracle would need the
+                        # nominator state frozen at dispatch)
+                        self.audit.abandon(audit_rec, "overlay")
+                        audit_rec = None
                     if gang is not None:
                         # the overlay two-pass is outside the gang program
                         self.metrics.gang_dispatch.inc("fallback")
@@ -1427,6 +1511,13 @@ class Scheduler:
             # bucketed by the profiler) — host cost per cardinality regime
             self._sig_bucket_cell[0] = int(
                 np.unique(segment_batch.tidx[:n]).size)
+        if audit_rec is not None:
+            # keep the PRE-dispatch device inputs (carry copied on
+            # device) so /debug/explain can replay any pod's exact step
+            self.audit.attach_device(
+                audit_rec, profile.score_config, na, carry, table,
+                segment_batch, n, self._gd_dev, self._gd_fam,
+                names=self.state.node_names)
         try:
             with self.tracer.span("device_dispatch", pods=n,
                                   groups=groups_needed, drain=did,
@@ -1460,6 +1551,8 @@ class Scheduler:
             # fault and commit normally; THIS drain degrades to the host
             # oracle and the resident carry reseeds on the next dispatch
             self._record_device_fault("dispatch", e)
+            if audit_rec is not None:
+                self.audit.abandon(audit_rec, "device_fault")
             if gang is not None:
                 self.metrics.gang_dispatch.inc("fallback")
             self._drain_pending()
@@ -1474,7 +1567,7 @@ class Scheduler:
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
             dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did,
-            gang=gang, facts=self.builder.row_facts))
+            gang=gang, facts=self.builder.row_facts, audit=audit_rec))
         return 0
 
     @contextmanager
@@ -1557,7 +1650,7 @@ class Scheduler:
         return (jnp.asarray(ovl_used), jnp.asarray(ovl_npods))
 
     def _try_host_greedy(self, qpis: list[QueuedPodInfo], profile: Profile,
-                         batch) -> Optional[int]:
+                         batch, audit=None) -> Optional[int]:
         """Host-side vectorized greedy for a SAME-SIGNATURE drain with
         group constraints (ops/hostgreedy.py) — the group analog of the
         closed-form uniform path. The device scan pays ~0.4ms of tunneled
@@ -1613,7 +1706,7 @@ class Scheduler:
                            table=None, na=None, n=n, groups_needed=True,
                            records=[], dispatched_at=t0,
                            drain_id=self._drain_seq,
-                           facts=self.builder.row_facts)
+                           facts=self.builder.row_facts, audit=audit)
         return self._commit_assignments(pd, out)
 
     def _node_arrays(self):
@@ -1927,6 +2020,7 @@ class Scheduler:
         self._device_faults += 1
         self.device_fallbacks += 1
         self.metrics.device_fallbacks.inc(reason)
+        self.slo.observe("device_fallback", bad=1)
         self._invalidate_device_state()
         self.flight.record(
             profile="", pods=0, bound=0, failed=0, signatures=0, kinds=(),
@@ -1964,6 +2058,8 @@ class Scheduler:
         victims = [pd, *self._pending]
         self._pending.clear()
         for d in victims:
+            if d.audit is not None:
+                self.audit.abandon(d.audit, "device_fault")
             if d.gang is not None:
                 # the gang degrades to the serial Permit-barrier path
                 self.metrics.gang_dispatch.inc("fallback")
@@ -2068,6 +2164,10 @@ class Scheduler:
             self._device_fault_abort(pd, "invalid_assignment", ValueError(
                 f"device assignments out of range: {out.tolist()}"))
             return
+        if self._test_assignment_perturb is not None:
+            # test-only hook: inject a wrong-but-valid decision AFTER
+            # resolution, BEFORE commit — the shadow audit must catch it
+            self._test_assignment_perturb(pd, out)
         if pd.records:
             self._record_device_success()
             # readback wait (zero when the async copy already landed)
@@ -2269,6 +2369,7 @@ class Scheduler:
         if pd.gang is not None:
             self.metrics.gang_dispatch.inc(
                 "placed" if pd.gang_accepted else "rejected")
+        fail_msgs: dict = {}
         if failures:
             # diagnosis reads the live snapshot (assumes included)
             self.cache.update_snapshot(self.snapshot)
@@ -2277,10 +2378,28 @@ class Scheduler:
             else:
                 for qpi in failures:
                     err = self._device_fit_error(qpi, profile, diag_cache)
+                    if pd.audit is not None:
+                        # the reference-format message the audit diffs
+                        # against the oracle replay's
+                        fail_msgs[qpi.pod.uid] = str(err)
                     self._handle_failure(qpi, err)
         commit_s = max(_time.perf_counter() - t_commit, 0.0)
         self.metrics.drain_phase.observe(commit_s, "commit")
         pd.phases["commit"] = pd.phases.get("commit", 0.0) + commit_s
+        # SLO engine feeds (obs/slo.py): attempt latency, queue→bind e2e
+        # and the device-tier health, one observation batch per drain
+        slo = self.slo
+        bad_a = n if per_pod > slo.threshold("attempt_latency") else 0
+        slo.observe("attempt_latency", good=n - bad_a, bad=bad_a)
+        thr_e = slo.threshold("e2e_latency")
+        now = self.clock()
+        bad_e = 0
+        for qpi in qpis:
+            if now - (qpi.initial_attempt_timestamp
+                      or qpi.timestamp) > thr_e:
+                bad_e += 1
+        slo.observe("e2e_latency", good=n - bad_e, bad=bad_e)
+        slo.observe("device_fallback", good=1)
         hot: tuple = ()
         if self.profiler is not None:
             total_s = sum(pd.phases.values())
@@ -2289,7 +2408,7 @@ class Scheduler:
                 # the flight entry — "slow drain 17" answers itself
                 hot = tuple(self.profiler.top_frames(
                     5, seconds=max(total_s, 1.0) + 1.0))
-        self.flight.record(
+        frec = self.flight.record(
             profile=profile.name, pods=n, bound=bound,
             failed=len(failures),
             signatures=(int(np.unique(pd.batch.tidx[:n]).size)
@@ -2302,6 +2421,13 @@ class Scheduler:
             events={"Scheduled": bound,
                     "FailedScheduling": len(failures)},
             drain_id=pd.drain_id, hot_frames=hot)
+        if pd.audit is not None:
+            # hand the committed decisions to the shadow-audit worker;
+            # the replay + diff run off the hot path
+            self.audit.submit(pd.audit, out=out,
+                              names=self.state.node_names,
+                              fail_msgs=fail_msgs, flight_rec=frec,
+                              ext_gen=self._ext_mutations)
         klog.v(2).info("batch committed", profile=profile.name, pods=n,
                        bound=bound, unschedulable=len(failures),
                        latency_ms=round(per_pod * n * 1e3, 1))

@@ -17,8 +17,13 @@ in-process model:
   full divergence sweep), /debug/hostprofile?seconds=N&format=collapsed|
   speedscope (the continuous host profiler's phase-attributed stacks —
   pipe the collapsed form into flamegraph.pl or drop either form onto
-  speedscope.app) and /debug/compileledger (per-kernel XLA compile
-  seconds, retraces, donation misses, h2d bytes).
+  speedscope.app), /debug/compileledger (per-kernel XLA compile
+  seconds, retraces, donation misses, h2d bytes),
+  /debug/audit?limit=N&details=1 (the shadow-oracle audit's hash-chained
+  drain ledger: recent audits, divergence diffs, chain validity),
+  /debug/explain?pod=<ns/name>&k=N (per-bind plugin-level score
+  decomposition — exact replay when the drain is in the audit ledger)
+  and /debug/slo (per-SLI multi-window burn rates + breaches).
 - `LeaderElector` drives a Lease object stored in the APIServer
   (coordination.k8s.io/Lease semantics: acquire when unheld or expired,
   renew while holding, release on stop). Multiple scheduler instances
@@ -202,6 +207,38 @@ class SchedulerServer:
                     from .perf.ledger import GLOBAL as ledger
                     self._send(200, json.dumps(ledger.snapshot(), indent=2),
                                "application/json")
+                elif self.path.startswith("/debug/audit"):
+                    audit = getattr(outer.scheduler, "audit", None)
+                    if audit is None:
+                        self._send(404, "shadow audit off "
+                                        "(ShadowOracleAudit gate)")
+                        return
+                    q = self._query()
+                    self._send(200, json.dumps(audit.dump(
+                        limit=int(q.get("limit", "32")),
+                        details=q.get("details") == "1"),
+                        indent=2, default=str), "application/json")
+                elif self.path.startswith("/debug/explain"):
+                    q = self._query()
+                    uid = q.get("pod", "")
+                    if not uid:
+                        self._send(400, "missing ?pod=<namespace/name>")
+                        return
+                    import time as _t
+                    from .obs.explain import explain_pod
+                    t0 = _t.perf_counter()
+                    out = explain_pod(outer.scheduler, uid,
+                                      k=int(q.get("k", "5")))
+                    outer.scheduler.metrics.explain_duration.observe(
+                        _t.perf_counter() - t0)
+                    code = 404 if "error" in out else 200
+                    self._send(code, json.dumps(out, indent=2,
+                                                default=str),
+                               "application/json")
+                elif self.path.startswith("/debug/slo"):
+                    self._send(200, json.dumps(
+                        outer.scheduler.slo.snapshot(), indent=2),
+                        "application/json")
                 elif self.path.startswith("/debug/events"):
                     q = self._query()
                     self._send(200, json.dumps(

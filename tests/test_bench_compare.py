@@ -165,6 +165,57 @@ class TestRealTrail:
         assert "SENTINEL: OK" in out.stdout
 
 
+class TestSLOGate:
+    """--slo (ISSUE 10): burn-rate breaches and shadow-oracle divergence
+    recorded in a bench summary fail the sentinel."""
+
+    def _summary(self, slo):
+        return {"SchedulingBasic_X": {
+            "pods_per_s": 1000.0, "p50": 900, "p99": 1100,
+            "attempt_p50_ms": 1.0, "attempt_p99_ms": 2.0, "slo": slo}}
+
+    def test_clean_slo_passes(self):
+        assert bench_compare.slo_failures(self._summary(
+            {"breaches": [], "divergence_total": 0})) == []
+
+    def test_synthetic_breach_fails(self):
+        fails = bench_compare.slo_failures(self._summary(
+            {"breaches": [{"sli": "attempt_latency", "window": "5m",
+                           "burn": 20.0, "threshold": 14.4}],
+             "divergence_total": 0}))
+        assert fails and "SLO BREACH" in fails[0]
+
+    def test_nonzero_divergence_fails(self):
+        fails = bench_compare.slo_failures(self._summary(
+            {"breaches": [], "divergence_total": 2}))
+        assert fails and "ORACLE DIVERGENCE" in fails[0]
+
+    def test_cli_slo_gate_fast_selftest(self, tmp_path):
+        """End-to-end: inject a synthetic breach into a copied summary
+        and prove --slo flips the exit code while the plain run passes."""
+        base = {"summary": self._summary(
+            {"breaches": [], "divergence_total": 0})}
+        breach = copy.deepcopy(base)
+        breach["summary"]["SchedulingBasic_X"]["slo"] = {
+            "breaches": [{"sli": "divergence", "window": "6h",
+                          "burn": 100.0, "threshold": 1.0}],
+            "divergence_total": 1}
+        bp = tmp_path / "base.json"
+        np_ = tmp_path / "new.json"
+        bp.write_text(json.dumps(base))
+        np_.write_text(json.dumps(breach))
+        ok = subprocess.run(
+            [sys.executable, TOOL, "--baseline", str(bp), "--new",
+             str(np_)], capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = subprocess.run(
+            [sys.executable, TOOL, "--slo", "--baseline", str(bp),
+             "--new", str(np_)], capture_output=True, text=True)
+        assert bad.returncode == 2
+        assert "SLO BREACH" in bad.stdout
+        assert "ORACLE DIVERGENCE" in bad.stdout
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(not _has_trail(), reason="BENCH_r04/r05 not present")
 class TestFreshBenchCheck:

@@ -85,7 +85,7 @@ def _assignments(api):
     return {uid: p.spec.node_name for uid, p in api.pods.items()}
 
 
-def _run_parity_workload(api):
+def _run_parity_workload(api, audit=False):
     """The parity workload: two clean waves with a mid-run node flap (the
     chaotic twin only — the store is identical again before the next
     call), then a cordon-everything wave that strands a whole batch
@@ -93,6 +93,10 @@ def _run_parity_workload(api):
     drain to fully bound."""
     clock = Clock()
     sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+    if audit:
+        # shadow audit forced onto EVERY drain, replays inline
+        sched.audit.sample_rate = 1.0
+        sched.audit.synchronous = True
     _create(api, _pod_specs(20, seed=100, prefix="a"))
     sched.schedule_pending()
     if isinstance(api, ChaosAPIServer):
@@ -144,6 +148,75 @@ def test_chaos_parity():
     # retries absorbed every transient: zero terminal dispatcher errors
     assert sched.dispatcher.errors == 0
     assert not sched.cache.assumed_pods
+
+
+def test_chaos_audit_zero_divergence():
+    """ISSUE 10 satellite: the shadow-oracle audit at 100% sampling sees
+    ZERO divergence under the seeded fault script — faults degrade
+    paths (retries, fallbacks), never decisions. The audited drains'
+    hash chain stays intact through the churn."""
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED,
+        error_rates={"bind": 0.10, "patch": 0.10, "delete": 0.10},
+        latency_rate=0.25, latency_seconds=(0.001, 0.05)))
+    _nodes(chaos)
+    sched = _run_parity_workload(chaos, audit=True)
+    m = sched.metrics
+    for kind in ("assignment", "reason", "verdict"):
+        assert m.oracle_divergence.value(kind) == 0, kind
+    assert m.shadow_audit_drains.value("clean") >= 3
+    assert m.shadow_audit_drains.value("divergent") == 0
+    assert chaos.injected_errors["bind"] > 0   # the script really fired
+    assert sched.audit.ledger.verify()
+
+
+def test_chaos_audit_catches_injected_perturbation():
+    """The audit must be provably able to FAIL: a deliberately injected
+    wrong-but-valid decision (the test-only perturbation hook — the
+    stand-in for a buggy learned score column, ROADMAP item 5) is
+    caught, counted in oracle_divergence_total and rendered in
+    /debug/audit."""
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.server import SchedulerServer
+    api = APIServer()
+    _nodes(api)
+    clock = Clock()
+    sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
+    flips = []
+
+    def perturb(pd, out):
+        # flip the LAST assigned pod's node: by then load differentiates
+        # the scores, so the flip is outside the oracle's argmax tie set
+        if flips:
+            return
+        for i in range(len(out) - 1, -1, -1):
+            if out[i] >= 0:
+                out[i] = (out[i] + 1) % 6   # another real node
+                flips.append(i)
+                break
+    sched._test_assignment_perturb = perturb
+    _create(api, _pod_specs(16, seed=900, prefix="x"))
+    sched.schedule_pending()
+    sched.audit.flush()
+    assert flips, "the perturbation must have fired"
+    assert sched.metrics.oracle_divergence.value("assignment") >= 1
+    assert sched.metrics.shadow_audit_drains.value("divergent") >= 1
+    srv = SchedulerServer(sched).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/audit?details=1",
+                timeout=10) as r:
+            payload = json.loads(r.read().decode())
+        divergent = [rec for rec in payload["records"]
+                     if rec["outcome"] == "divergent"]
+        assert divergent and divergent[0]["diffs"]["assignment"]
+        assert payload["chainValid"]
+    finally:
+        srv.stop()
 
 
 def _run_wave_parity_workload(api):
@@ -336,6 +409,10 @@ def test_chaos_soak():
         drop_watch_rate=0.03, dup_watch_rate=0.03,
         node_flap_rate=0.02))
     sched = _no_sleep(Scheduler(chaos, batch_size=32, clock=clock))
+    # ISSUE 10: the soak runs with the shadow audit forced onto EVERY
+    # drain — seeded faults must produce zero oracle divergence
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
     n_nodes = 24    # ~380 live pods by the end: size the cluster for them
     _nodes(chaos, n=n_nodes, cpu=32, mem="64Gi")
     seq = 0
@@ -390,3 +467,11 @@ def test_chaos_soak():
     assert chaos.injected_errors["bind"] > 0
     assert chaos.node_flaps > 0
     assert chaos.dropped_events > 0
+    # shadow audit over the whole soak: many drains audited, none
+    # divergent, and the ledger's hash chain survived the churn
+    m = sched.metrics
+    for kind in ("assignment", "reason", "verdict"):
+        assert m.oracle_divergence.value(kind) == 0, kind
+    assert m.shadow_audit_drains.value("clean") > 10
+    assert m.shadow_audit_drains.value("divergent") == 0
+    assert sched.audit.ledger.verify()

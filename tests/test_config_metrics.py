@@ -303,6 +303,34 @@ class TestExpositionLint:
             assert metric.name not in seen
             seen.add(metric.name)
 
+    def test_issue10_families_covered_by_lint(self):
+        """ISSUE 10 satellite: the audit/explain/SLO families are
+        registered AND pre-seeded, so the generic lint above (HELP+TYPE,
+        escaping, +Inf caps) actually exercises them — plus the exact
+        label sets dashboards key on."""
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_oracle_divergence_total"] == "counter"
+        assert types["scheduler_shadow_audit_drains_total"] == "counter"
+        assert types["scheduler_slo_burn_rate"] == "gauge"
+        assert types["scheduler_audit_replay_seconds"] == "histogram"
+        assert types["scheduler_explain_seconds"] == "histogram"
+        kinds = {lbl["kind"] for lbl, _v in
+                 series["scheduler_oracle_divergence_total"]}
+        assert kinds == {"assignment", "reason", "verdict"}
+        outcomes = {lbl["outcome"] for lbl, _v in
+                    series["scheduler_shadow_audit_drains_total"]}
+        assert outcomes == {"clean", "divergent", "skipped", "error"}
+        burn = {(lbl["sli"], lbl["window"]) for lbl, _v in
+                series["scheduler_slo_burn_rate"]}
+        from kubernetes_tpu.obs.slo import DEFAULT_OBJECTIVES, WINDOWS
+        assert burn == {(sli, w) for sli in DEFAULT_OBJECTIVES
+                        for _s, w in WINDOWS}
+        # histogram families carry the +Inf cap via the generic lint;
+        # assert their zero-seed is present too
+        assert ("scheduler_audit_replay_seconds_count" in series
+                and "scheduler_explain_seconds_count" in series)
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

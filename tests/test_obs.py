@@ -1,0 +1,587 @@
+"""Observability layer (ISSUE 10): shadow-oracle audit, decision
+provenance (explain), and the SLO burn-rate engine.
+
+The standing gates this file establishes:
+- the audit at 100% sampling finds ZERO divergences on clean scheduling,
+  and a deliberately perturbed decision IS caught, counted, ledgered and
+  visible through /debug/audit;
+- `explain_row`'s reconstructed winner matches the actual run_batch
+  argmax bit-for-bit across a seeded fuzz of mixed drains, and the
+  margin matches an independent eager evaluation;
+- the drain ledger's hash chain breaks on tampering;
+- the /debug/audit, /debug/explain and /debug/slo endpoints stay
+  well-formed under concurrent drain traffic.
+"""
+
+import json
+import os
+import pickle
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.backend.cache import Cache, Snapshot
+from kubernetes_tpu.obs.audit import DrainLedger, AuditRecord
+from kubernetes_tpu.obs.slo import (DEFAULT_OBJECTIVES, SLOEngine,
+                                    parse_objectives)
+from kubernetes_tpu.ops.program import (ScoreConfig, explain_row,
+                                        initial_carry, pod_rows_from_batch,
+                                        run_batch)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.server import SchedulerServer
+from kubernetes_tpu.state.batch import BatchBuilder
+from kubernetes_tpu.state.tensorize import ClusterState
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _audited_scheduler(api, rate=1.0, sync=True, **kw):
+    sched = Scheduler(api, batch_size=kw.pop("batch_size", 64), **kw)
+    assert sched.audit is not None, "ShadowOracleAudit gate should be on"
+    sched.audit.sample_rate = rate
+    sched.audit.synchronous = sync
+    return sched
+
+
+def _basic_cluster(api, nodes=3):
+    # strictly heterogeneous capacities: once any pod is placed, scores
+    # are strict (no argmax ties), so a perturbed decision cannot hide
+    # inside the oracle's tie set
+    for i in range(nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": 8 + 4 * i, "memory": "16Gi", "pods": 40})
+            .zone(f"z{i % 2}").obj())
+
+
+def _perturb_last(out, n_nodes):
+    """Flip the LAST assigned pod's node (by then load has
+    differentiated the scores, so the flip is out of the argmax set)."""
+    for i in range(len(out) - 1, -1, -1):
+        if out[i] >= 0:
+            out[i] = (out[i] + 1) % n_nodes
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+
+
+class TestSLOEngine:
+    def test_burn_rates_and_windows(self):
+        clock = FakeClock()
+        slo = SLOEngine(clock=clock)
+        # 1% bad over the 5m window with a 1% budget → burn 1.0
+        slo.observe("attempt_latency", good=990, bad=10)
+        burns = slo.burn_rates()
+        assert burns["attempt_latency"]["5m"] == pytest.approx(1.0)
+        assert burns["attempt_latency"]["1h"] == pytest.approx(1.0)
+        # age the events past the 5m window but not the 1h window
+        clock.t += 600
+        slo.observe("attempt_latency", good=100, bad=0)
+        burns = slo.burn_rates()
+        assert burns["attempt_latency"]["5m"] == 0.0
+        assert 0.0 < burns["attempt_latency"]["1h"] < 1.0
+
+    def test_breaches_ladder(self):
+        slo = SLOEngine(clock=FakeClock())
+        # 50% error rate on a 1% budget → burn 50 ≫ every threshold
+        slo.observe("device_fallback", good=10, bad=10)
+        breaches = slo.breaches()
+        assert {b["window"] for b in breaches} == {"5m", "1h", "6h"}
+        assert all(b["sli"] == "device_fallback" for b in breaches)
+
+    def test_no_traffic_is_silent(self):
+        slo = SLOEngine(clock=FakeClock())
+        assert slo.breaches() == []
+        assert all(b == 0.0 for per in slo.burn_rates().values()
+                   for b in per.values())
+
+    def test_objective_overrides_and_validation(self):
+        objs = parse_objectives({"attempt_latency": {
+            "objective": 0.9, "thresholdSeconds": 0.25,
+            "maxBurn": {"5m": 2.0}}})
+        o = objs["attempt_latency"]
+        assert o.objective == 0.9 and o.threshold_s == 0.25
+        assert o.max_burn["5m"] == 2.0 and o.max_burn["6h"] == 1.0
+        with pytest.raises(ValueError):
+            parse_objectives({"nope": {}})
+        with pytest.raises(ValueError):
+            parse_objectives({"divergence": {"objective": 1.5}})
+        with pytest.raises(ValueError):
+            parse_objectives({"divergence": {"maxBurn": {"2d": 1}}})
+
+    def test_config_knob_reaches_engine(self):
+        from kubernetes_tpu.config import KubeSchedulerConfiguration
+        cfg = KubeSchedulerConfiguration(
+            slo_objectives={"e2e_latency": {"thresholdSeconds": 9.0}})
+        cfg.validate()
+        sched = Scheduler(APIServer(), config=cfg)
+        assert sched.slo.threshold("e2e_latency") == 9.0
+        with pytest.raises(ValueError):
+            KubeSchedulerConfiguration(
+                slo_objectives={"bogus": {}}).validate()
+
+    def test_burn_rate_gauge_exposed(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        sched.slo.observe("attempt_latency", good=1)
+        text = sched.metrics.exposition()
+        assert 'scheduler_slo_burn_rate{sli="attempt_latency"' in text
+        assert 'window="5m"' in text
+
+
+# ---------------------------------------------------------------------------
+# hash-chained drain ledger
+
+
+def _rec(i):
+    return AuditRecord(drain_id=i, profile_name="p", strategy="L",
+                       weights={}, pods=[], nodes=[],
+                       fingerprints={"podTableRows": f"h{i}"})
+
+
+class TestDrainLedger:
+    def test_chain_links_and_verifies(self):
+        led = DrainLedger(capacity=8)
+        recs = [led.append(_rec(i)) for i in range(5)]
+        assert led.verify()
+        for a, b in zip(recs, recs[1:]):
+            assert b.prev_hash == a.hash
+        assert led.head == recs[-1].hash
+
+    def test_tamper_breaks_chain(self):
+        led = DrainLedger(capacity=8)
+        for i in range(4):
+            led.append(_rec(i))
+        assert led.verify()
+        led.ring[1].fingerprints["podTableRows"] = "edited"
+        assert not led.verify()
+
+    def test_ring_eviction_keeps_window_valid(self):
+        led = DrainLedger(capacity=3)
+        for i in range(10):
+            led.append(_rec(i))
+        assert len(led.ring) == 3
+        assert led.verify()
+        assert led.appended == 10
+
+
+# ---------------------------------------------------------------------------
+# shadow-oracle audit end to end
+
+
+class TestShadowAudit:
+    def test_clean_schedule_zero_divergence(self):
+        api = APIServer()
+        sched = _audited_scheduler(api)
+        _basic_cluster(api)
+        for i in range(6):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "500m", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("big").req(
+            {"cpu": "100", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        sched.audit.flush()
+        m = sched.metrics
+        for kind in ("assignment", "reason", "verdict"):
+            assert m.oracle_divergence.value(kind) == 0
+        assert m.shadow_audit_drains.value("clean") >= 1
+        assert m.shadow_audit_drains.value("divergent") == 0
+        d = sched.audit.dump()
+        assert d["chainValid"]
+        assert all(r["outcome"] == "clean" for r in d["records"])
+        # the failed pod's reason histogram was diffed too (full replay)
+        assert not any(r["truncated"] for r in d["records"])
+
+    def test_perturbed_assignment_is_caught(self):
+        api = APIServer()
+        sched = _audited_scheduler(api)
+        _basic_cluster(api)
+
+        def perturb(pd, out):
+            _perturb_last(out, 3)
+        sched._test_assignment_perturb = perturb
+        for i in range(4):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "500m", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        sched.audit.flush()
+        m = sched.metrics
+        assert m.oracle_divergence.value("assignment") >= 1
+        assert m.shadow_audit_drains.value("divergent") >= 1
+        d = sched.audit.dump(details=True)
+        diffs = [r["diffs"] for r in d["records"] if r["diffs"]]
+        assert diffs and "assignment" in diffs[0]
+        # SLO divergence SLI burns through every window
+        assert any(b["sli"] == "divergence" for b in sched.slo.breaches())
+        # the flight entry carries the full diff
+        audited = [r for r in sched.flight.dump() if r["audit"]]
+        assert audited and audited[-1]["audit"]["outcome"] == "divergent"
+
+    def test_replay_prefix_cap_truncates(self):
+        api = APIServer()
+        sched = _audited_scheduler(api)
+        sched.audit.max_replay_pods = 2
+        _basic_cluster(api)
+        for i in range(6):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "250m", "memory": "512Mi"}).obj())
+        sched.schedule_pending()
+        sched.audit.flush()
+        recs = sched.audit.ledger.records()
+        assert recs and recs[-1].truncated
+        assert recs[-1].outcome == "clean"
+        # clean records drop their replay payload (memory bound)
+        assert recs[-1].nodes == [] and recs[-1].oracle == {}
+
+    def test_sampling_rate_accumulator(self):
+        api = APIServer()
+        sched = _audited_scheduler(api, rate=0.5)
+        wants = [sched.audit.want() for _ in range(8)]
+        assert wants == [False, True] * 4
+
+    def test_persisted_record_and_cli_roundtrip(self, tmp_path):
+        import tools.audit_replay as ar
+        api = APIServer()
+        sched = _audited_scheduler(api)
+        sched.audit.dirpath = str(tmp_path)
+        _basic_cluster(api)
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "500m", "memory": "1Gi"}).obj())
+        api.create_pod(make_pod("big").req(
+            {"cpu": "100", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        sched.audit.flush()
+        paths = sorted(tmp_path.glob("drain_*.pkl"))
+        assert paths
+        # clean record replays clean (exit 0)
+        assert ar.main([str(paths[0])]) == 0
+        # a tampered device decision → divergence (exit 2) — note the
+        # hash chain covers the INPUT fingerprints, not the outcome
+        with open(paths[0], "rb") as f:
+            payload = pickle.load(f)
+        # tamper the LAST bound pod (loaded cluster → strict scores, so
+        # the edit cannot hide inside the oracle's argmax tie set)
+        victim = next(u for u, _p, _pi in reversed(payload["pods"])
+                      if payload["device"].get(u) is not None)
+        payload["device"][victim] = "n2" \
+            if payload["device"][victim] != "n2" else "n1"
+        bad = tmp_path / "tampered_decision.pkl"
+        with open(bad, "wb") as f:
+            pickle.dump(payload, f)
+        assert ar.main([str(bad)]) == 2
+        # a tampered INPUT fingerprint breaks the hash (exit 3)
+        with open(paths[0], "rb") as f:
+            payload = pickle.load(f)
+        payload["fingerprints"]["carry"] = "0" * 64
+        forged = tmp_path / "tampered_input.pkl"
+        with open(forged, "wb") as f:
+            pickle.dump(payload, f)
+        assert ar.main([str(forged)]) == 3
+
+
+# ---------------------------------------------------------------------------
+# explain_row parity (the bit-for-bit criterion)
+
+
+def _fuzz_state(rng, n_nodes):
+    cache = Cache()
+    for i in range(n_nodes):
+        w = (make_node(f"n{i}")
+             .capacity({"cpu": int(rng.randint(2, 16)),
+                        "memory": f"{rng.randint(4, 32)}Gi", "pods": 110})
+             .zone(f"z{i % 3}")
+             .label("kubernetes.io/hostname", f"n{i}"))
+        if i % 4 == 1:
+            w = w.label("disk", "ssd")
+        cache.add_node(w.obj())
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    state = ClusterState()
+    state.apply_snapshot(snap, full=True)
+    return state
+
+
+def _fuzz_pods(rng, n_pods):
+    pods = []
+    for i in range(n_pods):
+        w = make_pod(f"p{i}").req(
+            {"cpu": f"{rng.randint(1, 8) * 250}m",
+             "memory": f"{rng.randint(1, 8) * 256}Mi"})
+        if i % 5 == 0:
+            w = w.node_selector(
+                {"topology.kubernetes.io/zone": f"z{i % 3}"})
+        if i % 3 == 0:
+            w = w.preferred_node_affinity_in("disk", ["ssd"], weight=7)
+        pods.append(w.obj())
+    return pods
+
+
+class TestExplainRowParity:
+    def test_winner_and_margin_match_run_batch_fuzz(self):
+        """Seeded fuzz of mixed drains: for every pod, the explain_row
+        winner at the pre-pod carry equals the actual run_batch argmax
+        bit-for-bit, and the margin matches an independent eager
+        evaluation of the scan-step formula."""
+        from kubernetes_tpu.ops.program import PodXs, _eval_pod, \
+            _gather_row
+        cfg = ScoreConfig()
+        for seed in range(6):
+            rng = np.random.RandomState(100 + seed)
+            state = _fuzz_state(rng, int(rng.randint(8, 20)))
+            builder = BatchBuilder(state)
+            n = int(rng.randint(6, 16))
+            batch = builder.build(_fuzz_pods(rng, n))
+            assert not batch.host_fallback.any()
+            xs, table = pod_rows_from_batch(batch)
+            na = state.device_arrays()
+            _final, assigns = run_batch(cfg, na, initial_carry(na), xs,
+                                        table)
+            assigns = np.asarray(assigns)
+            carry = initial_carry(na)
+            for i in range(n):
+                t = int(batch.tidx[i])
+                idx, totals, cols, n_feas = explain_row(
+                    cfg, na, carry, table, t, k=4)
+                idx = np.asarray(idx)
+                totals = np.asarray(totals)
+                cols = np.asarray(cols)
+                # independent eager reference at the same carry
+                pod = _gather_row(table, PodXs(
+                    valid=np.bool_(True), sig=np.int32(0),
+                    tidx=np.int32(t)))
+                feas, tot, _p = _eval_pod(cfg, na, carry, pod)
+                masked = np.where(np.asarray(feas), np.asarray(tot), -1)
+                if assigns[i] < 0:
+                    assert totals[0] < 0 or n_feas == 0
+                else:
+                    assert int(idx[0]) == int(assigns[i]), \
+                        f"seed {seed} pod {i}"
+                    assert int(totals[0]) == int(masked[int(idx[0])])
+                    # per-plugin columns sum to the total
+                    assert int(cols[0].sum()) == int(totals[0])
+                    order = np.argsort(-masked, kind="stable")
+                    if len(order) > 1 and totals[1] >= 0:
+                        assert int(totals[0] - totals[1]) == int(
+                            masked[order[0]] - masked[order[1]])
+                # advance the reference carry by one pod (the real scan)
+                one = PodXs(
+                    valid=np.array([batch.valid[i]]),
+                    sig=np.array([batch.sig[i]], np.int32),
+                    tidx=np.array([batch.tidx[i]], np.int32))
+                carry = run_batch(cfg, na, carry, one, table)[0]
+
+    def test_exact_explain_matches_bind_scheduler_level(self):
+        """Scheduler-level: every bound pod of audited drains (groups
+        included) explains to its actual bind via the ledger replay."""
+        api = APIServer()
+        sched = _audited_scheduler(api, batch_size=128)
+        for i in range(6):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 16, "memory": "32Gi", "pods": 60})
+                .zone(f"z{i % 3}").obj())
+        from kubernetes_tpu.obs.explain import explain_pod
+        pods = []
+        for i in range(24):
+            w = make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"})
+            if i % 2 == 0:
+                w = (w.label("app", "web").spread_constraint(
+                    1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                    {"app": "web"}))
+            pods.append(w.obj())
+        for p in pods:
+            api.create_pod(p)
+        sched.schedule_pending()
+        sched.audit.flush()
+        assert sched.metrics.shadow_audit_drains.value("divergent") == 0
+        checked = 0
+        for i in range(24):
+            uid = f"default/p{i}"
+            if not api.pods[uid].spec.node_name:
+                continue
+            out = explain_pod(sched, uid, k=3)
+            assert out.get("mode") == "exact", out
+            assert out["matchesBind"] is True
+            assert out["winner"]["node"] == api.pods[uid].spec.node_name
+            assert "rendered" in out
+            checked += 1
+        assert checked >= 20
+
+    def test_current_state_mode_without_ledger(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=64)
+        if sched.audit is not None:
+            sched.audit.sample_rate = 0.0   # never sampled → no ledger
+        _basic_cluster(api)
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        from kubernetes_tpu.obs.explain import explain_pod
+        out = explain_pod(sched, "default/p0", k=3)
+        assert out["mode"] == "current_state"
+        assert out["winner"] is not None
+        assert out["selfExcluded"]["resources"] is True
+        assert out["boundNode"] == api.pods["default/p0"].spec.node_name
+        missing = explain_pod(sched, "default/ghost")
+        assert "error" in missing
+
+
+# ---------------------------------------------------------------------------
+# endpoints (incl. under concurrent drain traffic)
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestObsEndpoints:
+    def test_audit_explain_slo_endpoints(self):
+        api = APIServer()
+        sched = _audited_scheduler(api)
+        _basic_cluster(api)
+        for i in range(3):
+            api.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "1", "memory": "1Gi"}).obj())
+        sched.schedule_pending()
+        sched.audit.flush()
+        srv = SchedulerServer(sched).start()
+        try:
+            code, body = _get(srv.port, "/debug/audit?details=1")
+            assert code == 200
+            d = json.loads(body)
+            assert d["chainValid"] and d["records"]
+            assert d["records"][-1]["outcome"] == "clean"
+
+            code, body = _get(srv.port, "/debug/explain?pod=default/p0")
+            assert code == 200
+            out = json.loads(body)
+            assert out["mode"] == "exact" and out["matchesBind"]
+
+            code, body = _get(srv.port, "/debug/explain")
+            assert code == 400
+
+            code, body = _get(srv.port,
+                              "/debug/explain?pod=default/ghost")
+            assert code == 404
+
+            code, body = _get(srv.port, "/debug/slo")
+            assert code == 200
+            slo = json.loads(body)
+            assert "burnRates" in slo and "objectives" in slo
+            assert slo["breaches"] == []
+        finally:
+            srv.stop()
+
+    def test_endpoints_under_concurrent_drains(self):
+        """Satellite gate: the three debug surfaces stay well-formed
+        while drains dispatch/commit on another thread."""
+        api = APIServer()
+        sched = _audited_scheduler(api, sync=False, batch_size=64)
+        _basic_cluster(api, nodes=4)
+        srv = SchedulerServer(sched).start()
+        stop = threading.Event()
+        errors: list = []
+
+        def traffic():
+            try:
+                for j in range(12):
+                    for i in range(8):
+                        api.create_pod(make_pod(f"t{j}-{i}").req(
+                            {"cpu": "100m", "memory": "64Mi"}).obj())
+                    sched.schedule_pending()
+            except Exception as e:   # surface scheduling-thread failures
+                errors.append(e)
+            finally:
+                stop.set()
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        try:
+            polls = 0
+            while not stop.is_set() or polls < 3:
+                for path in ("/debug/audit", "/debug/slo",
+                             "/debug/flightrecorder?limit=4"):
+                    code, body = _get(srv.port, path)
+                    assert code == 200
+                    json.loads(body)
+                # exact-mode explain for an already-committed pod (every
+                # drain is sampled, so committed pods are in the ledger)
+                if polls >= 1:
+                    code, body = _get(
+                        srv.port, "/debug/explain?pod=default/t0-0")
+                    if code == 200:
+                        assert json.loads(body)["winner"] is not None
+                polls += 1
+                if stop.is_set():
+                    break
+        finally:
+            t.join(timeout=60)
+            srv.stop()
+        assert not errors, errors
+        sched.audit.flush()
+        assert sched.metrics.shadow_audit_drains.value("divergent") == 0
+        assert sched.audit.ledger.verify()
+
+
+# ---------------------------------------------------------------------------
+# metric families (satellite: pre-seeded exposition)
+
+
+class TestObsMetricFamilies:
+    def test_new_families_preseeded(self):
+        from kubernetes_tpu.metrics import SchedulerMetrics
+        text = SchedulerMetrics().exposition()
+        for needle in (
+                'scheduler_oracle_divergence_total{kind="assignment"} 0',
+                'scheduler_oracle_divergence_total{kind="reason"} 0',
+                'scheduler_oracle_divergence_total{kind="verdict"} 0',
+                'scheduler_shadow_audit_drains_total{outcome="clean"} 0',
+                'scheduler_shadow_audit_drains_total{outcome="divergent"} 0',
+                "scheduler_audit_replay_seconds_count 0",
+                "scheduler_explain_seconds_count 0",
+                'scheduler_slo_burn_rate{sli="divergence",window="6h"} 0'):
+            assert needle in text, needle
+
+
+# ---------------------------------------------------------------------------
+# the 100%-sampling sweep (slow): representative harness workloads must
+# audit clean end to end — the bench-sweep acceptance in test form
+
+
+@pytest.mark.slow
+def test_audit_sweep_harness_workloads():
+    from kubernetes_tpu.perf.harness import run_config
+    cfg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "kubernetes_tpu", "perf", "configs",
+        "performance-config.yaml")
+    os.environ["KTPU_AUDIT_SAMPLE"] = "1.0"
+    try:
+        for case, wl in (("SchedulingBasic", "500Nodes_1000Pods"),
+                         ("TopologySpreading", "500Nodes"),
+                         ("SchedulingNodeAffinity", "500Nodes")):
+            got = run_config(cfg, case, wl)
+            assert got, f"{case}/{wl} not found"
+            item = got[0][0]
+            slo = item.extras.get("slo", {})
+            assert slo.get("divergence_total", 0) == 0, (case, slo)
+            assert slo.get("audited", 0) >= 1, (case, slo)
+    finally:
+        os.environ.pop("KTPU_AUDIT_SAMPLE", None)

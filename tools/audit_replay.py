@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Standalone shadow-audit replay: re-run any ledgered drain off-line.
+
+The shadow-oracle audit (kubernetes_tpu/obs/audit.py) writes one pickle
+per audited drain when `shadowAuditDir` is set — the captured NodeInfo
+clones, the pod list, the input fingerprints and the committed device
+decisions. This tool re-executes that record through the host oracle
+WITHOUT a live scheduler and reports the diff, so "why did pod X land on
+node Y" (or "did drain 1234 really diverge") is answerable from an
+artifact, long after the process is gone:
+
+  python tools/audit_replay.py /path/to/drain_00001234.pkl
+  python tools/audit_replay.py record.pkl --json          # machine form
+  python tools/audit_replay.py record.pkl --cap 0         # full replay
+
+Exit codes: 0 = replay matches the recorded device decisions,
+2 = divergence found, 3 = unusable record.
+
+The oracle framework is rebuilt from the default plugin set with the
+recorded per-profile weights/strategy — exact for default-plugin
+profiles (custom out-of-tree plugin sets need the live scheduler's
+ledger instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_framework(profile_name: str, weights: dict):
+    from kubernetes_tpu.framework.runtime import Framework
+    from kubernetes_tpu.scheduler import DEFAULT_WEIGHTS, default_plugins
+    w = dict(DEFAULT_WEIGHTS)
+    w.update(weights or {})
+    return Framework(profile_name, default_plugins(), weights=w)
+
+
+def replay(payload: dict, cap: int = 64) -> dict:
+    from kubernetes_tpu.obs.audit import (diff_decisions, replay_decisions,
+                                          _sha)
+    fwk = build_framework(payload.get("profile", "default-scheduler"),
+                          payload.get("weights", {}))
+    nodes = [ni.snapshot_clone() for ni in payload["nodes"]]
+    oracle, oracle_reasons, truncated = replay_decisions(
+        fwk, nodes, payload["pods"], device=payload.get("device"),
+        cap=cap)
+    diffs = diff_decisions(payload.get("device", {}),
+                           payload.get("reasonsDevice", {}),
+                           oracle, oracle_reasons,
+                           reasons_ok=payload.get("reasonsOk", True)
+                           and not truncated)
+    # hash integrity: the pickle's chain entry must still hash to itself
+    chain = json.dumps({"drain": payload["drainId"],
+                        "profile": payload["profile"],
+                        "fingerprints": payload["fingerprints"]},
+                       sort_keys=True).encode()
+    hash_ok = _sha(payload.get("prevHash", ""), chain) \
+        == payload.get("hash", "")
+    return {
+        "drainId": payload["drainId"],
+        "profile": payload["profile"],
+        "pods": len(payload["pods"]),
+        "replayed": min(cap, len(payload["pods"])) if cap
+        else len(payload["pods"]),
+        "truncated": truncated,
+        "hashValid": hash_ok,
+        "fingerprints": payload["fingerprints"],
+        "oracle": {uid: (v["host"] if v else None)
+                   for uid, v in oracle.items()},
+        "diffs": diffs,
+        "divergences": sum(len(v) for v in diffs.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("record", help="drain_*.pkl written by the audit "
+                                   "(shadowAuditDir)")
+    ap.add_argument("--cap", type=int, default=64,
+                    help="max pods to replay serially (0 = all; default "
+                         "matches the live audit's prefix cap)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full machine-readable result")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.record, "rb") as f:
+            payload = pickle.load(f)
+        result = replay(payload, cap=args.cap)
+    except Exception as e:
+        print(f"audit_replay: unusable record: {e}", file=sys.stderr)
+        return 3
+
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(f"drain {result['drainId']} ({result['profile']}): "
+              f"{result['pods']} pods, replayed {result['replayed']}"
+              + (" (truncated)" if result["truncated"] else ""))
+        print(f"  ledger hash: "
+              f"{'VALID' if result['hashValid'] else 'BROKEN'}")
+        for kind, items in result["diffs"].items():
+            for d in items:
+                print(f"  DIVERGENCE [{kind}] {d['pod']}: "
+                      f"device={d['device']!r} oracle={d['oracle']!r}")
+        if not result["diffs"]:
+            print("  decisions identical to the host oracle")
+    if not result["hashValid"]:
+        return 3
+    return 2 if result["diffs"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

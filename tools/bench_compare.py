@@ -19,7 +19,11 @@ and fails (exit 2) on:
     group/preemption workloads run wider — their pass-to-pass jitter in
     the BENCH history is ±20%, see NOISE);
   * attempt p99 latency growth >25% (when both sides carry the
-    attempt_p99_ms extra; older BENCH files predate it and skip the check).
+    attempt_p99_ms extra; older BENCH files predate it and skip the check);
+  * with --slo: any burn-rate breach recorded in the candidate's per-
+    workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
+    nonzero shadow-oracle divergence — a bench run whose decisions
+    diverged from the host oracle fails regardless of its throughput.
 
 Workloads present on only one side are reported but never fail (the case
 set grows over time); the `Sharded_` CPU-mesh probe is excluded — it is
@@ -68,9 +72,35 @@ NOISE = {
     # other group workloads
     "GangTraining": 0.30,
     "CoLocatedInference": 0.30,
+    # the 8-virtual-device CPU mesh case (r09+): subprocess scheduling
+    # over XLA host-platform shards jitters with machine load
+    "ShardedBasic": 0.30,
 }
 
 SKIP_PREFIXES = ("Sharded_",)
+
+
+def slo_failures(new: dict) -> list:
+    """--slo gate (ISSUE 10): a bench run breaching a configured
+    burn-rate objective, or recording ANY shadow-oracle divergence,
+    fails the sentinel regardless of its throughput numbers."""
+    fails: list[str] = []
+    for w in sorted(new):
+        if w.startswith(SKIP_PREFIXES):
+            continue
+        slo = new[w].get("slo")
+        if not isinstance(slo, dict):
+            continue
+        for b in slo.get("breaches") or []:
+            fails.append(
+                f"SLO BREACH {w}: {b.get('sli')}/{b.get('window')} "
+                f"burn {b.get('burn')} > {b.get('threshold')}")
+        div = int(slo.get("divergence_total",
+                          slo.get("divergence_bad", 0)) or 0)
+        if div:
+            fails.append(f"ORACLE DIVERGENCE {w}: {div} shadow-audit "
+                         "divergence(s) recorded")
+    return fails
 
 
 def throughput_gate(workload: str) -> float:
@@ -205,6 +235,10 @@ def main(argv=None) -> int:
                          "of reading a file")
     ap.add_argument("--cases", default="",
                     help="with --check: forwarded to bench.py --cases")
+    ap.add_argument("--slo", action="store_true",
+                    help="also gate on the candidate's SLO block: fail "
+                         "on any burn-rate breach or nonzero "
+                         "shadow-oracle divergence (ISSUE 10)")
     args = ap.parse_args(argv)
 
     trail = bench_files()
@@ -238,6 +272,10 @@ def main(argv=None) -> int:
         print(f"baseline: {os.path.basename(base_path)}", file=sys.stderr)
 
     failures, report = compare(base, new)
+    if args.slo:
+        slo_fails = slo_failures(new)
+        failures.extend(slo_fails)
+        report.append(f"SLO gate: {len(slo_fails)} failure(s)")
     for line in report:
         print(f"  {line}")
     if failures:
