@@ -175,6 +175,59 @@ def sharded_case(nodes: int, pods: int, runs: int) -> dict:
         return {"error": str(e)[:200]}
 
 
+def ha_failover_case(nodes: int) -> dict:
+    """Warm takeover vs cold start on the same N-node store (ISSUE 12):
+    a ledger-warmed hot spare's takeover (final tail drain + delta
+    resync + promote, `ha/standby.py`) against a fresh scheduler paying
+    the full construct + LIST + prime() it replaces. The acceptance bar
+    is warm < cold; the entry lands in the bench extras (it reports
+    seconds, not throughput, so it stays out of the `summary` block)."""
+    import time as _t
+    from kubernetes_tpu.backend.apiserver import APIServer, LEASE_NAME
+    from kubernetes_tpu.ha.standby import StandbyScheduler
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    t = {"now": 0.0}
+    clock = lambda: t["now"]                                  # noqa: E731
+    api = APIServer()
+    for i in range(nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": 32, "memory": "64Gi", "pods": 110})
+            .zone(f"z{i % 16}").obj())
+    leader = Scheduler(api, clock=clock)
+    if leader.audit is not None:
+        leader.audit.sample_rate = 1.0   # every drain hits the ledger
+    api.acquire_lease(LEASE_NAME, "bench-leader", clock())
+    leader.prime()
+    for i in range(256):
+        api.create_pod(make_pod(f"ha-pod-{i}").req(
+            {"cpu": "900m", "memory": "1Gi"}).obj())
+    leader.schedule_pending()
+    if leader.audit is not None:
+        leader.audit.flush()
+    ledger = leader.audit.ledger if leader.audit is not None else None
+    standby = StandbyScheduler(api, identity="bench-standby",
+                               ledger=ledger, clock=clock)
+    standby.tick()          # leader still holds: stays standby
+    standby.sync()          # warm the spare: cache + arrays + JIT
+    t["now"] += 20.0        # leader dies (stops renewing past expiry)
+    standby.tick()          # wins the lease; takeover() runs inside
+    warm_s = standby.failover_s
+    t0 = _t.perf_counter()
+    cold = Scheduler(api)
+    cold.prime()
+    cold_s = _t.perf_counter() - t0
+    return {
+        "value": round(warm_s * 1e3, 2), "unit": "ms",
+        "warm_failover_s": round(warm_s, 4),
+        "cold_start_s": round(cold_s, 4),
+        "warm_beats_cold": warm_s < cold_s,
+        "speedup": round(cold_s / warm_s, 2) if warm_s else 0.0,
+        "nodes": nodes, "ledger_drains_seen": standby.drains_seen,
+    }
+
+
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -267,6 +320,16 @@ def main() -> None:
         else:
             results[f"ShardedBasic_{nodes}Nodes_FAILED"] = entry
 
+    if not case_filter or "HAFailover" in case_filter:
+        # warm-spare takeover vs cold start (ISSUE 12 / ROADMAP item 5):
+        # recorded in the extras, not the summary — it reports seconds
+        nodes = 500 if small else 5000
+        try:
+            results[f"HAFailover_{nodes}Nodes"] = ha_failover_case(nodes)
+        except Exception as e:   # HA probe must not sink the headline
+            results[f"HAFailover_{nodes}Nodes_FAILED"] = {
+                "error": str(e)[:200]}
+
     if not results:
         raise SystemExit(f"--cases {args.cases!r} matched no case")
 
@@ -277,8 +340,8 @@ def main() -> None:
     # every non-headline workload) had no first-class number
     summary = {}
     for key, entry in results.items():
-        if "error" in entry:
-            continue
+        if "error" in entry or entry.get("unit") in ("s", "ms"):
+            continue    # HAFailover reports time, not throughput
         hb = float(entry.get("host_build_s", 0.0))
         dv = float(entry.get("device_s", 0.0))
         cm = float(entry.get("commit_s", 0.0))
@@ -310,8 +373,8 @@ def main() -> None:
     print(json.dumps({
         "metric": f"{head_key}_throughput",
         "value": head["value"],
-        "unit": "pods/s",
-        "vs_baseline": head["vs_baseline"],
+        "unit": head.get("unit", "pods/s"),
+        "vs_baseline": head.get("vs_baseline", 0.0),
         "summary": summary,
         "extra": {k: v for k, v in results.items() if k != head_key},
     }))

@@ -47,6 +47,14 @@ class ServiceUnavailable(APIError):
     """503: transient unavailability. Retriable."""
 
 
+class FencedWrite(APIError):
+    """A write carried a stale fencing token (lease generation): the
+    caller was deposed as leader and a newer holder owns the lease.
+    Deliberately TERMINAL — retrying cannot help (the generation only
+    moves forward), so the dispatcher routes it through the same
+    forget/requeue path as Conflict and the assume unwinds cleanly."""
+
+
 # the retriable set mirrors client-go's shouldRetry classification
 # (util/retry + apierrors.SuggestsClientDelay): the call did NOT take
 # effect, so re-issuing it is safe. Conflict/NotFound are terminal — they
@@ -56,6 +64,27 @@ RETRIABLE_ERRORS = (ServerTimeout, TooManyRequests, ServiceUnavailable)
 
 def is_retriable(err: Exception) -> bool:
     return isinstance(err, RETRIABLE_ERRORS)
+
+
+# -- coordination.k8s.io/v1 Lease ------------------------------------------
+
+LEASE_NAME = "kube-scheduler"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease (consumed subset) + the fencing
+    generation: a monotonic counter bumped on every holder CHANGE, handed
+    to the new leader as its fencing token. A write stamped with an older
+    generation is provably from a deposed leader and is rejected
+    (FencedWrite) regardless of how long its flush was paused."""
+
+    name: str = LEASE_NAME
+    holder_identity: str = ""
+    lease_duration_s: float = 15.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+    generation: int = 0
 
 
 @dataclass
@@ -87,6 +116,7 @@ class APIServer:
     pdbs: dict[str, PodDisruptionBudget] = field(default_factory=dict)
     resource_slices: dict[str, ResourceSlice] = field(default_factory=dict)
     resource_claims: dict[str, ResourceClaim] = field(default_factory=dict)
+    leases: dict[str, Lease] = field(default_factory=dict)
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
     workload_handlers: list[WatchHandlers] = field(default_factory=list)
@@ -96,6 +126,72 @@ class APIServer:
     claim_handlers: list[WatchHandlers] = field(default_factory=list)
     slice_handlers: list[WatchHandlers] = field(default_factory=list)
     binding_count: int = 0
+    fenced_rejections: int = 0
+
+    # -- leases (coordination.k8s.io) + fencing -------------------------------
+
+    def get_lease(self, name: str = LEASE_NAME) -> Optional[Lease]:
+        return self.leases.get(name)
+
+    def acquire_lease(self, name: str, identity: str, now: float,
+                      lease_duration_s: float = 15.0) -> Lease:
+        """Take the lease when unheld, expired, or already ours. A holder
+        change bumps lease_transitions AND the fencing generation — the
+        returned lease carries the token the new leader must stamp on its
+        writes. Raises Conflict while another holder's lease is live."""
+        lease = self.leases.setdefault(
+            name, Lease(name=name, lease_duration_s=lease_duration_s))
+        if lease.holder_identity == identity:
+            lease.renew_time = now
+            return lease
+        expired = (not lease.holder_identity
+                   or now - lease.renew_time > lease.lease_duration_s)
+        if not expired:
+            raise Conflict(
+                f"lease {name!r} is held by {lease.holder_identity!r}")
+        if lease.holder_identity:
+            lease.lease_transitions += 1
+        lease.holder_identity = identity
+        lease.lease_duration_s = lease_duration_s
+        lease.renew_time = now
+        lease.generation += 1
+        return lease
+
+    def renew_lease(self, name: str, identity: str, now: float) -> Lease:
+        """Heartbeat an already-held lease. Conflict when the caller no
+        longer holds it (stolen / released) — the deposed-leader signal."""
+        lease = self.leases.get(name)
+        if lease is None:
+            raise NotFound(f"lease {name}")
+        if lease.holder_identity != identity:
+            raise Conflict(
+                f"lease {name!r} is held by {lease.holder_identity!r}, "
+                f"not {identity!r}")
+        lease.renew_time = now
+        return lease
+
+    def release_lease(self, name: str, identity: str) -> None:
+        """Voluntary handoff: clear the holder so the next acquire wins
+        immediately. No-op when the caller isn't the holder."""
+        lease = self.leases.get(name)
+        if lease is None or lease.holder_identity != identity:
+            return
+        lease.holder_identity = ""
+        lease.renew_time = 0.0
+
+    def check_fence(self, fence_token: Optional[int],
+                    name: str = LEASE_NAME) -> None:
+        """Reject a write stamped with a stale lease generation. `None`
+        passes (unfenced legacy writes); a token only fails once a NEWER
+        holder has acquired, so single-leader operation never pays."""
+        if fence_token is None:
+            return
+        lease = self.leases.get(name)
+        if lease is not None and fence_token != lease.generation:
+            self.fenced_rejections += 1
+            raise FencedWrite(
+                f"write fenced: token {fence_token} != lease generation "
+                f"{lease.generation} (holder {lease.holder_identity!r})")
 
     # -- watch registration (LIST+WATCH: informer semantics) ------------------
     # client-go informers LIST current state before watching; a handler
@@ -165,7 +261,8 @@ class APIServer:
                 h.on_update(old, pod)
         return pod
 
-    def delete_pod(self, uid: str) -> None:
+    def delete_pod(self, uid: str, fence_token: Optional[int] = None) -> None:
+        self.check_fence(fence_token)
         pod = self.pods.pop(uid, None)
         if pod is None:
             raise NotFound(uid)
@@ -179,10 +276,12 @@ class APIServer:
             raise NotFound(uid)
         return pod
 
-    def bind(self, pod: Pod, node_name: str) -> None:
+    def bind(self, pod: Pod, node_name: str,
+             fence_token: Optional[int] = None) -> None:
         """POST pods/<name>/binding (reference default_binder.go:51 →
         registry/core/pod/storage BindingREST: sets spec.nodeName, fails on
         conflict if already bound to a different node)."""
+        self.check_fence(fence_token)
         current = self.pods.get(pod.uid)
         if current is None:
             raise NotFound(pod.uid)
@@ -200,7 +299,8 @@ class APIServer:
             if h.on_update:
                 h.on_update(old, new)
 
-    def bind_all(self, pairs: list[tuple[Pod, Pod]]
+    def bind_all(self, pairs: list[tuple[Pod, Pod]],
+                 fence_token: Optional[int] = None
                  ) -> list[tuple[Pod, Exception]]:
         """Bulk Binding subresource: (assumed pod with node set, the
         original object it was derived from). When the stored object IS
@@ -209,8 +309,16 @@ class APIServer:
         object directly; otherwise the stored object is derived from
         `current` exactly like bind(), so a post-drain update survives
         with only nodeName/phase changing. Store updates apply first,
-        then handlers fan out. Returns per-pod failures."""
+        then handlers fan out. Returns per-pod failures. A stale fencing
+        token fails the WHOLE batch per-pod (the deposed leader's bulk
+        flush must bind nothing, and the per-pod failure list rides the
+        caller's existing unwind path)."""
         failures: list[tuple[Pod, Exception]] = []
+        if fence_token is not None:
+            try:
+                self.check_fence(fence_token)
+            except FencedWrite as e:
+                return [(pod, e) for pod, _original in pairs]
         updates: list[tuple[Pod, Pod]] = []
         store = self.pods
         nodes = self.nodes
@@ -246,9 +354,11 @@ class APIServer:
         return failures
 
     def patch_pod_status(self, pod: Pod, condition: dict,
-                         nominated_node_name=None) -> None:
+                         nominated_node_name=None,
+                         fence_token: Optional[int] = None) -> None:
         """nominated_node_name: None = leave unchanged, "" = clear (the
         preemption demotion patch), otherwise set."""
+        self.check_fence(fence_token)
         current = self.pods.get(pod.uid)
         if current is None:
             raise NotFound(pod.uid)

@@ -35,7 +35,18 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.types import Pod
-from .apiserver import Conflict, is_retriable
+from .apiserver import Conflict, FencedWrite, is_retriable
+
+
+def backoff_delay(attempt: int, base: float, cap: float,
+                  rng: random.Random) -> float:
+    """Exponential backoff with equal jitter (client-go wait.Backoff
+    shape): base·2^attempt capped, then scaled into [0.5, 1.0). Shared by
+    the dispatcher's retry loop and the leader elector's acquire retry
+    (ha/lease.py) so every client-side retry in the system jitters the
+    same way."""
+    d = min(base * (2.0 ** attempt), cap)
+    return d * (0.5 + 0.5 * rng.random())
 
 
 class CallType(str, enum.Enum):
@@ -58,6 +69,10 @@ class APICall:
     condition: Optional[dict] = None
     # None = leave unchanged; "" = clear (preemption demotion)
     nominated_node_name: Optional[str] = None
+    # fencing token (lease generation) stamped at ENQUEUE time: a call
+    # enqueued before the leader was deposed keeps its stale token, so
+    # the API server rejects it even if the flush happens much later
+    fence_token: Optional[int] = None
 
 
 @dataclass
@@ -82,11 +97,26 @@ class APIDispatcher:
     _queue: dict[str, APICall] = field(default_factory=dict)   # guarded_by: _lock
     # bulk fast path: (bound pod, the original object it was derived from)
     _binds: list[tuple[Pod, Pod]] = field(default_factory=list)  # guarded_by: _lock
+    # fencing-token provider (ha/fencing.py wires the elector's current
+    # lease generation): consulted at enqueue time, None = unfenced
+    fence: Optional[Callable[[], Optional[int]]] = None
+    # the OLDEST token among bulk binds enqueued since the last flush:
+    # generations are monotonic, so fencing the whole bulk batch at the
+    # oldest token is conservative — a batch spanning a depose boundary
+    # fails entirely and every member requeues through on_bind_error
+    _bind_fence: Optional[int] = None   # guarded_by: _lock
     executed: int = 0
     errors: int = 0
     retries: int = 0
+    fenced: int = 0
+
+    def _stamp(self, call: APICall) -> APICall:
+        if call.fence_token is None and self.fence is not None:
+            call.fence_token = self.fence()
+        return call
 
     def add(self, call: APICall) -> None:
+        self._stamp(call)
         uid = call.pod.uid
         with self._lock:
             pending = self._queue.get(uid)
@@ -119,7 +149,11 @@ class APIDispatcher:
         commit: one list extend instead of B dict transactions. The
         original lets bind_all prove by identity that no interleaved
         update landed, and reuse the assumed copy as the stored object."""
+        token = self.fence() if self.fence is not None else None
         with self._lock:
+            if token is not None and (self._bind_fence is None
+                                      or token < self._bind_fence):
+                self._bind_fence = token
             if self._queue:
                 # a bind supersedes a pending patch — but never a DELETE,
                 # which outranks it (same relevance ordering as add()). The
@@ -145,9 +179,14 @@ class APIDispatcher:
     def _backoff(self, attempt: int) -> float:
         """Exponential backoff with equal jitter (client-go wait.Backoff
         shape): base·2^attempt capped, then scaled into [0.5, 1.0)."""
-        d = min(self.retry_base_seconds * (2.0 ** attempt),
-                self.retry_max_delay_seconds)
-        return d * (0.5 + 0.5 * self._rng.random())
+        return backoff_delay(attempt, self.retry_base_seconds,
+                             self.retry_max_delay_seconds, self._rng)
+
+    def _count_fenced(self, e: Exception) -> None:
+        if isinstance(e, FencedWrite):
+            self.fenced += 1
+            if self.metrics is not None:
+                self.metrics.fenced_writes_rejected.inc()
 
     def _count_retry(self, call_type: CallType) -> None:
         self.retries += 1
@@ -170,20 +209,23 @@ class APIDispatcher:
                 self.sleep(self._backoff(attempt))
                 attempt += 1
 
-    def _execute_binds(self, binds: list) -> list[tuple[Pod, Exception]]:
+    def _execute_binds(self, binds: list,
+                       fence_token: Optional[int] = None
+                       ) -> list[tuple[Pod, Exception]]:
         """Bulk bind with per-pod retry of the retriable failures; returns
         the terminal failures."""
+        kw = {} if fence_token is None else {"fence_token": fence_token}
         terminal: list[tuple[Pod, Exception]] = []
         pending = binds
         attempt = 0
         while pending:
             if hasattr(self.client, "bind_all"):
-                failures = self.client.bind_all(pending)
+                failures = self.client.bind_all(pending, **kw)
             else:
                 failures = []
                 for p, _orig in pending:
                     try:
-                        self.client.bind(p, p.spec.node_name)
+                        self.client.bind(p, p.spec.node_name, **kw)
                     except Exception as e:
                         failures.append((p, e))
             if not failures:
@@ -230,10 +272,12 @@ class APIDispatcher:
         with self._lock:
             binds = self._binds
             self._binds = []
+            bind_fence = self._bind_fence
+            self._bind_fence = None
         if not binds:
             return 0
         n_bulk = len(binds)
-        failures = self._execute_binds(binds)
+        failures = self._execute_binds(binds, fence_token=bind_fence)
         n_fail = len(failures)
         self.executed += n_bulk - n_fail
         self.errors += n_fail
@@ -245,19 +289,24 @@ class APIDispatcher:
                 self.metrics.api_dispatcher_calls.inc(
                     CallType.BIND.value, "error", by=n_fail)
         for pod, e in failures:
+            self._count_fenced(e)
             if self.on_bind_error is not None:
                 self.on_bind_error(pod, pod.spec.node_name, e)
         return n_bulk
 
     def _execute_calls(self, calls: list[APICall]) -> int:
         for call in calls:
+            # fence kwarg only when stamped: stub clients in tests predate
+            # the fence_token parameter, and None means unfenced anyway
+            kw = ({} if call.fence_token is None
+                  else {"fence_token": call.fence_token})
             if call.call_type == CallType.BIND:
-                fn = lambda c=call: self.client.bind(c.pod, c.node_name)
+                fn = lambda c=call: self.client.bind(c.pod, c.node_name, **kw)
             elif call.call_type == CallType.DELETE:
-                fn = lambda c=call: self.client.delete_pod(c.pod.uid)
+                fn = lambda c=call: self.client.delete_pod(c.pod.uid, **kw)
             else:
                 fn = lambda c=call: self.client.patch_pod_status(
-                    c.pod, c.condition or {}, c.nominated_node_name)
+                    c.pod, c.condition or {}, c.nominated_node_name, **kw)
             err = self._execute_with_retry(call.call_type, fn)
             if err is None:
                 self.executed += 1
@@ -265,6 +314,7 @@ class APIDispatcher:
                     self.metrics.api_dispatcher_calls.inc(
                         call.call_type.value, "success")
             else:
+                self._count_fenced(err)
                 self.errors += 1
                 if self.metrics is not None:
                     self.metrics.api_dispatcher_calls.inc(

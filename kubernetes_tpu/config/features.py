@@ -93,6 +93,15 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # precondition for learned score columns (ROADMAP item 5) whose
     # correctness cannot be fuzzed ahead of time.
     "ShadowOracleAudit": FeatureSpec(True, BETA),
+    # active/standby HA (kubernetes_tpu/ha/): lease-based leader election
+    # with generation fencing tokens on every dispatched write, plus the
+    # ledger-warmed hot spare (StandbyScheduler tails the drain ledger +
+    # watch stream and takes over via a warm resync). Off = the
+    # single-instance fallback matrix documented in the README: electors
+    # still work (server.py back-compat) but writes go unfenced and a
+    # standby runs cold — takeover degrades to a full LIST + tensorize +
+    # JIT warm-up.
+    "ActiveStandbyHA": FeatureSpec(True, ALPHA),
 }
 
 

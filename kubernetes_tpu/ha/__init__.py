@@ -1,0 +1,28 @@
+"""Active/standby high availability (ROADMAP item 5).
+
+Three cooperating parts, mirroring how the reference deploys
+kube-scheduler replicas behind client-go `tools/leaderelection`:
+
+- `ha.lease`: `LeaseLock` + `LeaderElector` — lease-based election over
+  the fake API server with acquire/renew/release, renew deadlines and
+  jittered retry (client-go leaderelection.go semantics, including the
+  slow path where a deposed leader must stop before its lease expires).
+- `ha.fencing`: stamps every dispatched write with the lease generation
+  as a fencing token so a paused ex-leader's in-flight commits are
+  rejected server-side — the split-brain hole election alone leaves open.
+- `ha.standby`: a hot spare that tails the drain ledger + watch events to
+  keep cache, device arrays and JIT caches warm, and takes over with a
+  delta resync instead of a cold LIST + tensorize + compile warm-up.
+"""
+
+from .fencing import fence_dispatcher, unfence_dispatcher
+from .lease import LeaderElector, LeaseLock
+from .standby import StandbyScheduler
+
+__all__ = [
+    "LeaderElector",
+    "LeaseLock",
+    "StandbyScheduler",
+    "fence_dispatcher",
+    "unfence_dispatcher",
+]

@@ -1,0 +1,44 @@
+"""Fenced commits: lease generation as a fencing token on every write.
+
+Leader election alone leaves the classic split-brain hole open: a leader
+that pauses (GC stall, suspended VM) past its lease can wake up with
+bind/patch/delete calls still sitting in its async dispatcher and flush
+them AFTER a successor was elected — double-binding pods the new leader
+already placed. The classic fix (Kleppmann's fencing tokens; Chubby
+sequencers) is a monotonically increasing token issued with the lock and
+checked by the resource: the API server bumps the Lease `generation` on
+every holder change and rejects writes carrying an older one
+(`FencedWrite`, deliberately terminal — the generation only moves
+forward, so retrying cannot help).
+
+This module is only the wiring. The mechanism lives in the layers below:
+
+- `APICall.fence_token` is stamped at ENQUEUE time (dispatcher._stamp),
+  so a call enqueued before deposition keeps its stale token no matter
+  when the flush happens;
+- bulk binds are fenced at the OLDEST token enqueued since the last
+  flush (generations are monotonic, so that is the conservative choice:
+  a batch spanning a depose boundary fails whole, and every member rides
+  `on_bind_error`'s forget/requeue path — no assume leaks);
+- `APIServer.check_fence` rejects stale tokens and counts
+  `fenced_rejections`; the dispatcher surfaces them as
+  `fenced_writes_rejected_total`.
+"""
+
+from __future__ import annotations
+
+from .lease import LeaderElector
+
+
+def fence_dispatcher(dispatcher, elector: LeaderElector) -> None:
+    """Wire the elector's cached lease generation into the dispatcher as
+    its fencing-token provider. Every subsequently-enqueued write is
+    stamped with the generation current AT ENQUEUE — the property the
+    whole scheme rests on."""
+    dispatcher.fence = elector.fence_token
+
+
+def unfence_dispatcher(dispatcher) -> None:
+    """Detach the provider (tests / gate-off fallback). Already-stamped
+    pending calls keep their tokens; only future enqueues are unfenced."""
+    dispatcher.fence = None

@@ -1,0 +1,222 @@
+"""Lease-based leader election (client-go tools/leaderelection).
+
+`LeaseLock` is the resourcelock.LeaseLock analog: it speaks the API
+server's lease verbs (acquire/renew/release with holderIdentity,
+leaseDurationSeconds and a renewTime deadline) and falls back to direct
+Lease-store manipulation for clients that predate the verbs, so foreign
+stub clients in tests keep working.
+
+`LeaderElector` is the leaderelection.LeaderElector loop
+(leaderelection.go:245-282), reduced to the framework's tick-driven
+model: callers invoke `tick()` from their own control loop (the
+reference loops on RetryPeriod); each tick is one acquire-or-renew
+round. The elector implements:
+
+- `OnStartedLeading`/`OnStoppedLeading` callbacks on every transition;
+- the deposed-leader slow path (leaderelection.go:278: RenewDeadline <
+  LeaseDuration): when renews keep failing transiently, the leader
+  steps down at the renew deadline — BEFORE its lease expires — so the
+  next leader can never overlap with a half-dead one;
+- jittered acquire retry through the dispatcher's `backoff_delay`
+  (wait.JitterUntil): a non-leader that just lost an acquire race backs
+  off instead of hammering the lease on every tick;
+- the fencing token: the lease `generation` is captured at acquire time
+  and handed to `ha.fencing` — a deposed leader keeps its STALE cached
+  generation, so writes it flushes late are rejected server-side even
+  if it has not yet noticed it lost the lease.
+"""
+
+from __future__ import annotations
+
+import random
+import time as _time
+from typing import Callable, Optional
+
+from ..backend.apiserver import (APIError, Conflict, LEASE_NAME, Lease,
+                                 NotFound)
+from ..backend.dispatcher import backoff_delay
+
+
+class LeaseLock:
+    """coordination.k8s.io Lease lock over the shared API server."""
+
+    def __init__(self, client, identity: str, name: str = LEASE_NAME,
+                 lease_duration_s: float = 15.0):
+        self.client = client
+        self.identity = identity
+        self.name = name
+        self.lease_duration_s = lease_duration_s
+
+    # -- store access ---------------------------------------------------------
+
+    def _store(self) -> dict:
+        """Fallback Lease store for clients without lease verbs."""
+        leases = getattr(self.client, "leases", None)
+        if leases is None:
+            leases = self.client.leases = {}
+        return leases
+
+    def get(self) -> Optional[Lease]:
+        if hasattr(self.client, "get_lease"):
+            return self.client.get_lease(self.name)
+        return self._store().get(self.name)
+
+    def acquire_or_renew(self, now: float) -> Lease:
+        """One acquire-or-renew attempt; raises Conflict when the lease
+        is held (unexpired) by another identity."""
+        if hasattr(self.client, "acquire_lease"):
+            return self.client.acquire_lease(
+                self.name, self.identity, now,
+                lease_duration_s=self.lease_duration_s)
+        # fallback mirror of APIServer.acquire_lease for foreign clients
+        lease = self._store().setdefault(self.name, Lease(
+            name=self.name, lease_duration_s=self.lease_duration_s))
+        if lease.holder_identity == self.identity:
+            lease.renew_time = now
+            return lease
+        expired = (not lease.holder_identity
+                   or now - lease.renew_time > lease.lease_duration_s)
+        if not expired:
+            raise Conflict(
+                f"lease {self.name!r} is held by {lease.holder_identity!r}")
+        if lease.holder_identity:
+            lease.lease_transitions += 1
+        lease.holder_identity = self.identity
+        lease.lease_duration_s = self.lease_duration_s
+        lease.renew_time = now
+        lease.generation += 1
+        return lease
+
+    def release(self) -> None:
+        if hasattr(self.client, "release_lease"):
+            self.client.release_lease(self.name, self.identity)
+            return
+        lease = self._store().get(self.name)
+        if lease is None or lease.holder_identity != self.identity:
+            return
+        lease.holder_identity = ""
+        lease.renew_time = 0.0
+
+
+class LeaderElector:
+    """client-go leaderelection.LeaderElector (tools/leaderelection):
+    acquire/renew/release against a shared Lease store."""
+
+    def __init__(self, client, identity: str,
+                 lease_duration_s: float = 15.0,
+                 renew_deadline_s: Optional[float] = None,
+                 retry_period_s: float = 2.0,
+                 clock: Callable[[], float] = _time.monotonic,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 metrics=None,
+                 rng: Optional[random.Random] = None):
+        self.client = client
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        # reference defaults: LeaseDuration 15s / RenewDeadline 10s /
+        # RetryPeriod 2s — keep the 2:3 ratio for custom durations
+        self.renew_deadline_s = (renew_deadline_s if renew_deadline_s
+                                 is not None else lease_duration_s * (2 / 3))
+        self.retry_period_s = retry_period_s
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.metrics = metrics
+        self.lock = LeaseLock(client, identity,
+                              lease_duration_s=lease_duration_s)
+        self._leading = False
+        self._last_renew = 0.0      # last SUCCESSFUL acquire/renew
+        self._attempt = 0           # consecutive failed acquire attempts
+        self._next_acquire = 0.0    # backoff gate for non-leader attempts
+        self._generation: Optional[int] = None  # cached at acquire time
+        self._rng = rng if rng is not None else random.Random(
+            hash(identity) & 0xFFFF)
+
+    # -- state ----------------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def fence_token(self) -> Optional[int]:
+        """The lease generation cached at acquire time. Deliberately NOT
+        re-read from the store: a deposed leader that has not ticked yet
+        must keep stamping its STALE generation so its late flushes are
+        fenced. None only before the first acquire (unfenced legacy)."""
+        return self._generation
+
+    # -- transitions ----------------------------------------------------------
+
+    def _transition(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.leader_transitions.inc(reason)
+
+    def _start_leading(self, lease: Lease, now: float) -> None:
+        self._leading = True
+        self._last_renew = now
+        self._attempt = 0
+        self._generation = lease.generation
+        self._transition("acquired")
+        if self.on_started_leading:
+            self.on_started_leading()
+
+    def _stop_leading(self, reason: str) -> None:
+        self._leading = False
+        self._transition(reason)
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
+
+    # -- the loop body --------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One acquire-or-renew round; returns leadership after the round.
+        The reference loops this on RetryPeriod; callers here invoke it
+        from their own control loop."""
+        now = self.clock()
+        if not self._leading and now < self._next_acquire:
+            # acquire backoff (wait.JitterUntil): lost a race recently
+            return False
+        try:
+            lease = self.lock.acquire_or_renew(now)
+        except Conflict:
+            # held, unexpired, by someone else
+            if self._leading:
+                # our lease expired and another elector claimed it
+                self._stop_leading("lost")
+            self._next_acquire = now + backoff_delay(
+                self._attempt, self.retry_period_s,
+                self.lease_duration_s, self._rng)
+            self._attempt += 1
+            return False
+        except (NotFound, APIError):
+            # transient verb failure (chaos: renew latency spikes,
+            # expired-lease storms). A non-leader just retries later; a
+            # leader holds on until the renew DEADLINE, then steps down
+            # — before the lease itself expires — so a successor can
+            # never overlap with a leader that still thinks it renews.
+            if self._leading:
+                if now - self._last_renew >= self.renew_deadline_s:
+                    self._stop_leading("renew_deadline")
+                    return False
+                return True
+            self._next_acquire = now + backoff_delay(
+                self._attempt, self.retry_period_s,
+                self.lease_duration_s, self._rng)
+            self._attempt += 1
+            return False
+        self._last_renew = now
+        self._attempt = 0
+        if not self._leading:
+            # covers both fresh acquire and an elector re-created after
+            # restart while its lease is still valid: it IS the holder
+            self._start_leading(lease, now)
+        else:
+            self._generation = lease.generation
+        return True
+
+    def release(self) -> None:
+        """Voluntary handoff (LeaderElector release on cancel): clear the
+        lease so the next candidate acquires immediately."""
+        self.lock.release()
+        if self._leading:
+            self._stop_leading("released")

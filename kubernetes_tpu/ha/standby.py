@@ -1,0 +1,186 @@
+"""Ledger-warmed hot spare: a standby Scheduler that takes over warm.
+
+The reference's passive replicas stand fully cold: a standby
+kube-scheduler that wins the lease starts from an empty informer cache
+and pays a full LIST before its first scheduling cycle. This framework
+can do better because the expensive state is REBUILDABLE FROM STREAMS it
+can subscribe to while passive:
+
+- the watch stream keeps the standby's cache/queue/PodTable current (its
+  inner Scheduler registers the normal informer handlers; bind echoes
+  from the active leader land through `_on_pod_update[_bulk]` and
+  `confirm_bound` exactly like the leader's own echoes would);
+- the drain-ledger tail (obs/audit.py DrainLedger.tail) streams the
+  leader's committed drains, giving the standby a lag signal
+  (`ha_ledger_tail_lag_drains`), the chain head for handoff continuity,
+  and the sync cadence for refreshing its DEVICE state: each `sync()`
+  re-tensorizes the snapshot and touches `ensure_arrays()` inside
+  SanitizerRails transfer windows, so node arrays stay current and every
+  kernel's JIT cache is populated BEFORE takeover ever happens.
+
+Takeover (`OnStartedLeading`) is then cheap: drain the remaining ledger
+tail, splice this instance's (empty) audit ledger onto the dead leader's
+chain head so the hash chain verifies across the handoff, run `resync()`
+— which rides the columnar ingest bulk paths against an already-warm
+device tier, reconciling only the delta since the last record and
+re-enqueueing the dead leader's uncommitted drains (their binds never
+committed, so they are still unbound in the store) — and `promote()`.
+`ha_failover_seconds` records the cost; the `failover` SLI burns budget
+when it exceeds the objective.
+
+The `ActiveStandbyHA` gate governs the fencing + warm-spare wiring; with
+the gate off the elector still works (single-instance back-compat) but
+writes go unfenced and takeover degrades to a cold start.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional
+
+from ..scheduler import Scheduler
+from .fencing import fence_dispatcher
+from .lease import LeaderElector
+
+
+class StandbyScheduler:
+    """One standby instance: inner Scheduler (role "standby") + elector
+    + ledger-tail subscription. Call `tick()` from the control loop (it
+    runs the election round; takeover fires via OnStartedLeading) and
+    `sync()` on whatever cadence the deployment wants its spare warmed."""
+
+    def __init__(self, client, identity: str = "scheduler-standby",
+                 ledger=None,
+                 lease_duration_s: float = 15.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 scheduler: Optional[Scheduler] = None,
+                 **scheduler_kwargs):
+        """`ledger` is the active leader's DrainLedger (the streamed
+        export; in-process the subscription is direct). None = no ledger
+        feed: the standby still warms from the watch stream alone."""
+        self.scheduler = (scheduler if scheduler is not None
+                          else Scheduler(client, **scheduler_kwargs))
+        self.enabled = self.scheduler.feature_gates.enabled(
+            "ActiveStandbyHA")
+        self.scheduler.ha_role = "standby"
+        self.ledger = ledger
+        self.cursor = 0              # last consumed ledger seq
+        self.last_hash = ""          # hash of the last consumed record
+        self.drains_seen = 0
+        self.takeovers = 0
+        self.failover_s: Optional[float] = None
+        self.elector = LeaderElector(
+            client, identity,
+            lease_duration_s=lease_duration_s,
+            clock=clock if clock is not None else self.scheduler.clock,
+            metrics=self.scheduler.metrics,
+            on_started_leading=self._on_started_leading,
+            on_stopped_leading=self._on_stopped_leading)
+        if self.enabled:
+            fence_dispatcher(self.scheduler.dispatcher, self.elector)
+
+    # -- election -------------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One election round; a win runs takeover via the callback."""
+        return self.elector.tick()
+
+    def _on_started_leading(self) -> None:
+        self.takeover()
+
+    def _on_stopped_leading(self) -> None:
+        self.scheduler.demote()
+
+    # -- warm sync ------------------------------------------------------------
+
+    def sync(self, refresh: bool = True) -> int:
+        """Consume the ledger tail + (optionally) refresh device state.
+        Returns the number of drain records consumed. The refresh is the
+        point of the hot spare: snapshot → tensorize → ensure_arrays
+        keeps node arrays current and mints every kernel's JIT entry
+        while passive, so takeover pays neither."""
+        if not self.enabled:
+            return 0    # gate off: no ledger tail, no device pre-warm —
+            #             takeover degrades to the pre-HA cold resync
+        consumed = 0
+        sched = self.scheduler
+        if self.ledger is not None:
+            sched.metrics.ha_ledger_tail_lag.set(
+                float(self.ledger.lag(self.cursor)))
+            for rec in self.ledger.tail(self.cursor):
+                self.cursor = rec.seq
+                self.last_hash = rec.hash
+                consumed += 1
+            self.drains_seen += consumed
+        if refresh:
+            # the same staged phases the leader's drain loop declares, so
+            # the transfer-guard discipline holds on the standby too
+            with sched.rails.declared("host_snapshot"):
+                sched.cache.update_snapshot(sched.snapshot)
+            with sched.rails.declared("host_tensorize"):
+                sched.state.apply_snapshot(sched.snapshot)
+                sched.state.ensure_arrays()
+        return consumed
+
+    # -- takeover -------------------------------------------------------------
+
+    def takeover(self) -> float:
+        """OnStartedLeading: final tail drain, chain splice, delta
+        resync, promote. Returns (and records) the failover seconds."""
+        sched = self.scheduler
+        t0 = _time.perf_counter()
+        self.sync(refresh=False)     # catch the tail; device state is
+        #                              refreshed by resync() below anyway
+        if self.enabled and self.ledger is not None \
+                and sched.audit is not None:
+            # continue the dead leader's hash chain: our first audited
+            # drain links to its last, so verify() holds across handoff
+            head = self.ledger.head_hash()
+            try:
+                sched.audit.ledger.splice(head, seq=self.ledger.cursor())
+            except ValueError:
+                pass    # this instance audited drains before (re-elect
+                #         after a previous reign): its chain continues
+        # delta reconcile: the watch stream kept cache/queue current and
+        # sync() kept the device tier warm, so the LIST rebuild rides the
+        # columnar bulk paths into already-compiled kernels — and
+        # re-enqueues the dead leader's uncommitted drains (never bound,
+        # so still unbound in the store)
+        sched.resync()
+        sched.promote()
+        dt = _time.perf_counter() - t0
+        self.failover_s = dt
+        self.takeovers += 1
+        sched.metrics.ha_failover.observe(dt)
+        if sched.slo is not None:
+            obj = sched.slo.objectives.get("failover")
+            bad = 1 if (obj is not None and dt > obj.threshold_s) else 0
+            sched.slo.observe("failover", good=1 - bad, bad=bad)
+        return dt
+
+    # -- serving --------------------------------------------------------------
+
+    def debug(self) -> dict:
+        """/debug/ha payload."""
+        lease = self.elector.lock.get()
+        return {
+            "role": self.scheduler.ha_role,
+            "gate": self.enabled,
+            "identity": self.elector.identity,
+            "leader": self.elector.is_leader(),
+            "fenceToken": self.elector.fence_token(),
+            "lease": None if lease is None else {
+                "holder": lease.holder_identity,
+                "durationSeconds": lease.lease_duration_s,
+                "renewTime": lease.renew_time,
+                "transitions": lease.lease_transitions,
+                "generation": lease.generation,
+            },
+            "ledgerCursor": self.cursor,
+            "ledgerLag": (self.ledger.lag(self.cursor)
+                          if self.ledger is not None else None),
+            "drainsSeen": self.drains_seen,
+            "takeovers": self.takeovers,
+            "failoverSeconds": self.failover_s,
+            "fencedRejected": self.scheduler.dispatcher.fenced,
+        }

@@ -455,6 +455,29 @@ class SchedulerMetrics:
             "(obs/slo.py): error_rate / (1 - objective); 1.0 = consuming "
             "exactly the budget.",
             ("sli", "window")))
+        # active/standby HA (kubernetes_tpu/ha/, ISSUE 12)
+        self.leader_transitions = r.register(Counter(
+            n + "leader_election_transitions_total",
+            "Leader-elector state transitions, by reason: acquired "
+            "(took the lease), released (voluntary handoff), lost "
+            "(another holder claimed an expired lease), renew_deadline "
+            "(deposed-leader slow path: renews kept failing past the "
+            "renew deadline, stepped down before lease expiry).",
+            ("reason",)))
+        self.ha_failover = r.register(Histogram(
+            n + "ha_failover_seconds",
+            "Wall time of one standby takeover: final ledger tail drain "
+            "+ delta resync + promotion (ha/standby.py). The warm-spare "
+            "contract: well under a cold LIST + tensorize + JIT warm-up."))
+        self.ha_ledger_tail_lag = r.register(Gauge(
+            n + "ha_ledger_tail_lag_drains",
+            "Drains the standby's ledger-tail cursor is behind the "
+            "leader's drain ledger head, measured at each sync."))
+        self.fenced_writes_rejected = r.register(Counter(
+            n + "fenced_writes_rejected_total",
+            "Dispatcher writes rejected by the API server for carrying "
+            "a stale fencing token (lease generation) — a deposed "
+            "leader's late flush, unwound through on_bind_error."))
         self.dispatcher_inflight = r.register(Gauge(
             n + "dispatcher_inflight",
             "In-flight work of the async commit pipeline at scrape time: "
@@ -541,6 +564,11 @@ class SchedulerMetrics:
         for sli in DEFAULT_OBJECTIVES:
             for _secs, window in WINDOWS:
                 self.slo_burn_rate.set(0.0, sli, window)
+        for reason in ("acquired", "released", "lost", "renew_deadline"):
+            self.leader_transitions.inc(reason, by=0)
+        self.ha_failover.seed()
+        self.ha_ledger_tail_lag.set(0.0)
+        self.fenced_writes_rejected.inc(by=0)
 
     def sync_compile_ledger(self) -> None:
         """Mirror the process-global compile ledger (perf/ledger.py) into

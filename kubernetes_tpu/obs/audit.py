@@ -81,6 +81,9 @@ class AuditRecord:
     weights: dict                  # plugin weights (CLI framework rebuild)
     pods: list                     # [(uid, Pod, PodInfo)] in queue order
     nodes: list                    # PRIVATE NodeInfo clones at capture
+    # monotonic ledger sequence number, assigned at append: the tail
+    # cursor for streaming subscribers (ha/standby.py). 0 = unappended.
+    seq: int = 0
     framework: object = None       # live replay framework (not pickled)
     fingerprints: dict = field(default_factory=dict)
     ext_gen: int = 0               # scheduler external-mutation counter
@@ -270,8 +273,23 @@ class DrainLedger:
     """Fixed-capacity ring of AuditRecords forming a hash chain.
 
     Appended by the scheduling thread at capture time (chain order ==
-    dispatch order), outcome fields updated in place by the worker, read
-    by the debug HTTP thread."""
+    dispatch order), outcome fields updated in place by the audit worker,
+    read by the debug HTTP thread AND tailed by a standby scheduler
+    (ha/standby.py). Three threads touch live records concurrently, so
+    the discipline is explicit:
+
+    - ring/head/appended/anchor are guarded by `_lock` (annotations
+      below, checked by jaxsan's lock discipline);
+    - the chain fields of an appended record (seq, prev_hash, hash, and
+      the `chain_bytes()` inputs drain_id/profile_name/fingerprints) are
+      IMMUTABLE after `append` — `verify()` may read them from a ring
+      snapshot without holding the lock;
+    - every OTHER record field (device decisions, outcome, diffs, the
+      replay-payload clears) mutates only under `lock` — the audit
+      worker takes it via the `lock` property, so a tail subscriber
+      never observes a half-written outcome or a nodes list being
+      cleared mid-iteration.
+    """
 
     def __init__(self, capacity: int = 128):
         self._lock = threading.Lock()
@@ -282,6 +300,13 @@ class DrainLedger:
         # prev_hash of the oldest retained record: verify() anchors here
         self._window_anchor = GENESIS  # guarded_by: _lock
 
+    @property
+    def lock(self):
+        """The ledger lock, shared with record mutators (the audit
+        worker) and tail subscribers so record field updates are atomic
+        with respect to reads — see the class docstring discipline."""
+        return self._lock
+
     def append(self, rec: AuditRecord) -> AuditRecord:
         with self._lock:
             rec.prev_hash = self.head
@@ -289,6 +314,7 @@ class DrainLedger:
             self.head = rec.hash
             self.ring.append(rec)
             self.appended += 1
+            rec.seq = self.appended
             if len(self.ring) > self.capacity:
                 dropped = self.ring.pop(0)
                 self._window_anchor = dropped.hash
@@ -296,7 +322,10 @@ class DrainLedger:
 
     def verify(self) -> bool:
         """Recompute the retained window's chain; False = a record was
-        edited after the fact (or the chain was spliced)."""
+        edited after the fact (or the chain was spliced). Safe against a
+        concurrent appender: chain fields are immutable post-append, so
+        verifying a ring snapshot taken under the lock cannot see a
+        half-linked record."""
         with self._lock:
             records = list(self.ring)
             anchor = self._window_anchor
@@ -309,6 +338,46 @@ class DrainLedger:
                 return False
             prev = rec.hash
         return prev == head
+
+    # -- streaming (ha/standby.py tail subscription) --------------------------
+
+    def cursor(self) -> int:
+        """Sequence number of the newest appended record (tail cursor)."""
+        with self._lock:
+            return self.appended
+
+    def head_hash(self) -> str:
+        """Current chain head (splice anchor for a successor ledger)."""
+        with self._lock:
+            return self.head
+
+    def tail(self, after_seq: int, limit: int = 0) -> list:
+        """Retained records with seq > after_seq, oldest first. A cursor
+        that fell off the ring window simply yields everything retained —
+        the subscriber detects the gap via `lag()` and resyncs."""
+        with self._lock:
+            out = [r for r in self.ring if r.seq > after_seq]
+        if limit and len(out) > limit:
+            out = out[:limit]
+        return out
+
+    def lag(self, after_seq: int) -> int:
+        """How many drains a subscriber at `after_seq` is behind."""
+        with self._lock:
+            return max(0, self.appended - after_seq)
+
+    def splice(self, head: str, seq: int = 0) -> None:
+        """Adopt a predecessor ledger's head as this EMPTY ledger's chain
+        anchor (HA takeover): the successor's first record links to the
+        dead leader's last, so the combined chain across the handoff
+        verifies end to end. Refuses on a non-empty ledger — splicing
+        mid-chain is exactly the tamper `verify()` exists to catch."""
+        with self._lock:
+            if self.ring or self.appended:
+                raise ValueError("splice requires an empty ledger")
+            self.head = head
+            self._window_anchor = head
+            self.appended = seq
 
     def find(self, drain_id: int) -> Optional[AuditRecord]:
         with self._lock:
@@ -334,12 +403,17 @@ class DrainLedger:
         return out
 
     def dump(self, limit: int = 0, details: bool = False) -> dict:
+        valid = self.verify()
         with self._lock:
-            head, appended = self.head, self.appended
-        return {"head": head, "appended": appended,
-                "chainValid": self.verify(),
-                "records": [r.to_dict(details=details)
-                            for r in self.records(limit)]}
+            # to_dict reads worker-mutated fields (outcome, diffs):
+            # serialize under the lock so a concurrent _process can't
+            # hand the HTTP thread a half-written record
+            recs = list(self.ring)
+            if limit and len(recs) > limit:
+                recs = recs[-limit:]
+            return {"head": self.head, "appended": self.appended,
+                    "chainValid": valid,
+                    "records": [r.to_dict(details=details) for r in recs]}
 
 
 # ---------------------------------------------------------------------------
@@ -447,8 +521,9 @@ class ShadowOracleAudit:
     def abandon(self, rec: AuditRecord, reason: str) -> None:
         """The drain degraded off the audited dispatch path before its
         results existed (host fallback, overlay, device fault)."""
-        rec.outcome = "skipped"
-        rec.skip_reason = reason
+        with self.ledger.lock:
+            rec.outcome = "skipped"
+            rec.skip_reason = reason
         self._count("skipped")
 
     # -- submit (scheduling thread, commit time) ------------------------------
@@ -462,15 +537,17 @@ class ShadowOracleAudit:
         for i, (uid, _pod, _pi) in enumerate(rec.pods):
             a = int(out[i]) if i < len(out) else -1
             device[uid] = names[a] if a >= 0 else None
-        rec.device = device
-        rec.reasons_dev = dict(fail_msgs)
-        # an external cluster event between dispatch and commit moves the
-        # snapshot the device diagnosis reads — assignments stay exact
-        # (computed from the captured carry), reasons are not comparable
-        rec.reasons_ok = ext_gen == rec.ext_gen
-        if rec.explain_ctx is not None:
-            rec.explain_ctx.assignments = np.array(out[:len(rec.pods)])
-        rec._flight = flight_rec
+        with self.ledger.lock:
+            rec.device = device
+            rec.reasons_dev = dict(fail_msgs)
+            # an external cluster event between dispatch and commit moves
+            # the snapshot the device diagnosis reads — assignments stay
+            # exact (computed from the captured carry), reasons are not
+            # comparable
+            rec.reasons_ok = ext_gen == rec.ext_gen
+            if rec.explain_ctx is not None:
+                rec.explain_ctx.assignments = np.array(out[:len(rec.pods)])
+            rec._flight = flight_rec
         if self.synchronous:
             self._process(rec)
             return
@@ -495,7 +572,8 @@ class ShadowOracleAudit:
             try:
                 self._process(rec)
             except Exception:       # the audit must never kill the worker
-                rec.outcome = "error"
+                with self.ledger.lock:
+                    rec.outcome = "error"
                 self._count("error")
             finally:
                 self._queue.task_done()
@@ -513,28 +591,41 @@ class ShadowOracleAudit:
         t0 = _time.perf_counter()
         try:
             # replay over fresh clones: rec.nodes is the LEDGERED capture
-            # state — the CLI pickle and /debug re-read it pristine
-            nodes = [ni.snapshot_clone() for ni in rec.nodes]
+            # state — the CLI pickle and /debug re-read it pristine. The
+            # clone pass is the only rec.nodes read; take it under the
+            # ledger lock so the eventual clear (below) can never race a
+            # tail subscriber or a second iteration of this list.
+            with self.ledger.lock:
+                nodes = [ni.snapshot_clone() for ni in rec.nodes]
+                device = dict(rec.device)
+                reasons_dev = dict(rec.reasons_dev)
+                reasons_ok = rec.reasons_ok
             oracle, oracle_reasons, truncated = replay_decisions(
-                rec.framework, nodes, rec.pods, device=rec.device,
+                rec.framework, nodes, rec.pods, device=device,
                 cap=self.max_replay_pods)
         except Exception as e:
-            rec.outcome = "error"
-            rec.skip_reason = f"replay: {e}"
-            rec.replay_s = _time.perf_counter() - t0
+            with self.ledger.lock:
+                rec.outcome = "error"
+                rec.skip_reason = f"replay: {e}"
+                rec.replay_s = _time.perf_counter() - t0
             self._count("error")
             return
-        rec.replay_s = _time.perf_counter() - t0
-        rec.oracle = oracle
-        rec.reasons_oracle = oracle_reasons
-        rec.truncated = truncated
-        rec.diffs = diff_decisions(
-            rec.device, rec.reasons_dev, oracle, oracle_reasons,
-            reasons_ok=rec.reasons_ok and not truncated)
-        divergent = bool(rec.diffs)
-        rec.outcome = "divergent" if divergent else "clean"
+        diffs = diff_decisions(
+            device, reasons_dev, oracle, oracle_reasons,
+            reasons_ok=reasons_ok and not truncated)
+        divergent = bool(diffs)
+        # one atomic publication of the verdict: a tail subscriber (or
+        # /debug/audit) sees either a fully "pending" record or a fully
+        # replayed one — never outcome without diffs or vice versa
+        with self.ledger.lock:
+            rec.replay_s = _time.perf_counter() - t0
+            rec.oracle = oracle
+            rec.reasons_oracle = oracle_reasons
+            rec.truncated = truncated
+            rec.diffs = diffs
+            rec.outcome = "divergent" if divergent else "clean"
         if self.metrics is not None:
-            for kind, items in rec.diffs.items():
+            for kind, items in diffs.items():
                 self.metrics.oracle_divergence.inc(kind, by=len(items))
             self.metrics.audit_replay_duration.observe(rec.replay_s)
         self._count(rec.outcome)
@@ -545,7 +636,7 @@ class ShadowOracleAudit:
         if flight is not None:
             flight.audit = {"outcome": rec.outcome,
                             "divergences": rec.divergence_count(),
-                            "diffs": rec.diffs,
+                            "diffs": diffs,
                             "hash": rec.hash}
         if self.dirpath:
             self._persist(rec)
@@ -555,9 +646,10 @@ class ShadowOracleAudit:
             # fingerprints and explain context stay; divergent records
             # keep everything for the post-mortem (and the pickle, when
             # persistence is on, already captured the full payload)
-            rec.nodes = []
-            rec.oracle = {}
-            rec.reasons_oracle = {}
+            with self.ledger.lock:
+                rec.nodes = []
+                rec.oracle = {}
+                rec.reasons_oracle = {}
 
     def _count(self, outcome: str) -> None:
         if self.metrics is not None:

@@ -10,6 +10,7 @@ existing observation sites:
   device_fallback   drains degraded off the device tier (faults, breaker)
   divergence        shadow-oracle audits that found ANY divergence
   gang_quorum_wait  gang quorum waits beyond the wait objective
+  failover          HA takeovers slower than the failover objective
 
 Events land in fixed-resolution time buckets (one shared ring per SLI);
 each window's error rate is the bucket sum over its look-back, and
@@ -61,6 +62,10 @@ DEFAULT_OBJECTIVES = {
     "device_fallback": Objective(0.999),
     "divergence": Objective(0.9999),
     "gang_quorum_wait": Objective(0.99, threshold_s=30.0),
+    # HA takeover duration (ha/standby.py): a failover slower than the
+    # threshold burns budget — the warm-standby contract is that takeover
+    # costs a delta resync, not a cold LIST + tensorize + JIT warm-up
+    "failover": Objective(0.99, threshold_s=30.0),
 }
 
 

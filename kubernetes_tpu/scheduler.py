@@ -507,6 +507,11 @@ class Scheduler:
         self._gang_dom_key = (-1, -1)  # (staging_gen, node bucket) it fits
         # first-gated time per workload ref → gang_quorum_wait_seconds
         self._gang_gated_since: dict[str, float] = {}
+        # HA role lifecycle (ha/standby.py, ActiveStandbyHA gate):
+        # "active" schedules; "standby" only consumes watch events to keep
+        # cache/queue/device state warm — schedule_pending refuses to
+        # dispatch until promote() flips the role at takeover
+        self.ha_role = "active"
         # hand every GangScheduling plugin its Handle (this Scheduler)
         from .plugins.gangscheduling import GangScheduling
         for prof in self.profiles.values():
@@ -1055,6 +1060,11 @@ class Scheduler:
         device results still in flight commit on a later call (or
         `wait_pending()`), which is what lets ingestion of the next pod
         chunk overlap the tunneled device readback."""
+        if self.ha_role == "standby":
+            # a standby never writes: binds from a non-leader would race
+            # the active scheduler (and be fenced anyway). Takeover calls
+            # promote() before resuming the loop.
+            return 0
         if self.profiler is not None:
             self.profiler.ensure_running()
         start = self.scheduled_count
@@ -2066,6 +2076,17 @@ class Scheduler:
             for q in d.qpis:
                 self._schedule_one_host(q)
 
+    def promote(self) -> None:
+        """Standby → active (the OnStartedLeading takeover hook —
+        ha/standby.py calls this after its ledger-warmed reconcile)."""
+        self.ha_role = "active"
+
+    def demote(self) -> None:
+        """Active → standby (deposed leader: OnStoppedLeading). Pending
+        drains stay in flight — their commits carry the old fencing token
+        and are rejected server-side, unwinding through on_bind_error."""
+        self.ha_role = "standby"
+
     def resync(self) -> None:
         """Rebuild cache + queue from a fresh LIST of the API server — the
         reflector relist path (client-go Reflector.ListAndWatch after
@@ -2078,6 +2099,15 @@ class Scheduler:
         for uid in list(self._waiting_pods):
             self._reject_waiting(uid, "resync")
         self.dispatcher.flush()   # the rejects enqueue status patches
+        # gang continuity (HA takeover correctness): the fresh queue
+        # re-derives the gated_by_ref index deterministically below, but
+        # two pieces of gang state live OUTSIDE the queue and would
+        # silently reset with it — the quorum-wait start times (dropping
+        # the gang_quorum_wait observation for any gang that ungates
+        # after the resync) and each surviving group's scheduling
+        # deadline (restarting the Permit timeout from zero). Carry both.
+        gated_since = dict(self._gang_gated_since)
+        old_wm = self.workload_manager
         self.cache = Cache(clock=self.clock)
         self.snapshot = Snapshot()
         self.queue = SchedulingQueue(**self._queue_kwargs)
@@ -2107,6 +2137,12 @@ class Scheduler:
         bound_pods: list[Pod] = []
         unbound_pods: list[Pod] = []
         wm_add = self.workload_manager.add_pod
+        # ORDERING CONTRACT (guarded by the resync regression tests in
+        # tests/test_gang_device.py): every pod registers in the fresh
+        # WorkloadManager BEFORE queue.add_bulk re-runs PreEnqueue, so
+        # gang gating re-derives against complete membership — a gang
+        # whose quorum already arrived re-gates then ungates in the same
+        # add_bulk pass instead of stranding behind PreEnqueue.
         for pod in self.client.pods.values():
             wm_add(pod)
             if pod.spec.node_name:
@@ -2121,6 +2157,17 @@ class Scheduler:
             if n_gated:
                 self.metrics.queue_incoming_pods.inc("gated", "PodAdd",
                                                      by=n_gated)
+        # restore the carried gang state for groups that survived the
+        # rebuild: quorum-wait clocks for refs STILL gated (a ref whose
+        # gate cleared during the rebuild was already observed or its
+        # pods are gone), and Permit deadlines for surviving groups
+        now = self.clock()
+        for ref in self.queue.gated_refs():
+            self._gang_gated_since[ref] = gated_since.get(ref, now)
+        for key, info in old_wm.pod_group_infos.items():
+            fresh = self.workload_manager.pod_group_infos.get(key)
+            if fresh is not None:
+                fresh.scheduling_deadline = info.scheduling_deadline
         self._invalidate_device_state()
         self.cache.update_snapshot(self.snapshot)
         # full=True: the fresh cache restarts its generation counters, so

@@ -23,116 +23,43 @@ in-process model:
   drain ledger: recent audits, divergence diffs, chain validity),
   /debug/explain?pod=<ns/name>&k=N (per-bind plugin-level score
   decomposition — exact replay when the drain is in the audit ledger)
-  and /debug/slo (per-SLI multi-window burn rates + breaches).
-- `LeaderElector` drives a Lease object stored in the APIServer
-  (coordination.k8s.io/Lease semantics: acquire when unheld or expired,
-  renew while holding, release on stop). Multiple scheduler instances
-  sharing one APIServer elect exactly one active scheduler; standbys call
-  `tick()` and take over when the holder stops renewing — the
-  active/passive HA pattern of the reference.
+  /debug/slo (per-SLI multi-window burn rates + breaches) and /debug/ha
+  (HA role, lease + fencing token, ledger-tail cursor/lag, takeover
+  count and last failover seconds).
+- Leader election moved to `kubernetes_tpu/ha/` (ISSUE 12): the Lease
+  object lives in the API server (backend/apiserver.py, with generation
+  fencing tokens), `LeaderElector` in ha/lease.py (renew deadlines,
+  jittered acquire retry, transition metrics). Both are re-exported here
+  for back-compat — `from kubernetes_tpu.server import LeaderElector`
+  keeps working. Multiple scheduler instances sharing one APIServer
+  elect exactly one active scheduler; standbys call `tick()` and take
+  over when the holder stops renewing — the active/passive HA pattern
+  of the reference, now with the warm-spare takeover (/debug/ha).
 """
 
 from __future__ import annotations
 
 import json
 import threading
-import time as _time
-from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Optional
 
-LEASE_NAME = "kube-scheduler"
-
-
-@dataclass
-class Lease:
-    """coordination.k8s.io/v1 Lease (consumed subset)."""
-
-    name: str = LEASE_NAME
-    holder_identity: str = ""
-    lease_duration_s: float = 15.0
-    renew_time: float = 0.0
-    lease_transitions: int = 0
-
-
-class LeaderElector:
-    """client-go leaderelection.LeaderElector (tools/leaderelection):
-    acquire/renew/release against a shared Lease store."""
-
-    def __init__(self, client, identity: str,
-                 lease_duration_s: float = 15.0,
-                 clock: Callable[[], float] = _time.monotonic,
-                 on_started_leading: Optional[Callable[[], None]] = None,
-                 on_stopped_leading: Optional[Callable[[], None]] = None):
-        self.client = client
-        self.identity = identity
-        self.lease_duration_s = lease_duration_s
-        self.clock = clock
-        self.on_started_leading = on_started_leading
-        self.on_stopped_leading = on_stopped_leading
-        self._leading = False
-
-    def _lease(self) -> Lease:
-        lease = getattr(self.client, "leases", None)
-        if lease is None:
-            self.client.leases = {}
-        return self.client.leases.setdefault(LEASE_NAME, Lease(
-            lease_duration_s=self.lease_duration_s))
-
-    def is_leader(self) -> bool:
-        return self._leading
-
-    def tick(self) -> bool:
-        """One acquire-or-renew round; returns leadership after the round.
-        The reference loops this on RetryPeriod; callers here invoke it
-        from their own control loop."""
-        lease = self._lease()
-        now = self.clock()
-        expired = (not lease.holder_identity
-                   or now - lease.renew_time > lease.lease_duration_s)
-        if lease.holder_identity == self.identity:
-            lease.renew_time = now
-            if not self._leading:
-                # e.g. an elector re-created after restart while its lease
-                # is still valid: it IS the holder — reflect that
-                self._leading = True
-                if self.on_started_leading:
-                    self.on_started_leading()
-            return True
-        if expired:
-            if lease.holder_identity and lease.holder_identity != self.identity:
-                lease.lease_transitions += 1
-            lease.holder_identity = self.identity
-            lease.renew_time = now
-            self._leading = True
-            if self.on_started_leading:
-                self.on_started_leading()
-            return True
-        if self._leading:
-            # lost the lease (another holder renewed)
-            self._leading = False
-            if self.on_stopped_leading:
-                self.on_stopped_leading()
-        return False
-
-    def release(self) -> None:
-        lease = self._lease()
-        if lease.holder_identity == self.identity:
-            lease.holder_identity = ""
-            lease.renew_time = 0.0
-        if self._leading:
-            self._leading = False
-            if self.on_stopped_leading:
-                self.on_stopped_leading()
+from .backend.apiserver import LEASE_NAME, Lease  # noqa: F401 (re-export)
+from .ha.lease import LeaderElector
 
 
 class SchedulerServer:
     """healthz/readyz/metrics endpoints for one Scheduler instance."""
 
     def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
-                 elector: Optional[LeaderElector] = None):
+                 elector: Optional[LeaderElector] = None,
+                 ha=None):
+        """`ha` is an optional ha.StandbyScheduler whose debug() payload
+        backs /debug/ha; without one the endpoint reports the reduced
+        role/lease view assembled from `scheduler` + `elector`."""
         self.scheduler = scheduler
         self.elector = elector
+        self.ha = ha
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -234,6 +161,34 @@ class SchedulerServer:
                     code = 404 if "error" in out else 200
                     self._send(code, json.dumps(out, indent=2,
                                                 default=str),
+                               "application/json")
+                elif self.path.startswith("/debug/ha"):
+                    if outer.ha is not None:
+                        payload = outer.ha.debug()
+                    else:
+                        el = outer.elector
+                        lease = (el.lock.get() if el is not None
+                                 else None)
+                        payload = {
+                            "role": getattr(outer.scheduler, "ha_role",
+                                            "active"),
+                            "identity": (el.identity if el is not None
+                                         else None),
+                            "leader": (el.is_leader() if el is not None
+                                       else True),
+                            "fenceToken": (el.fence_token()
+                                           if el is not None else None),
+                            "lease": None if lease is None else {
+                                "holder": lease.holder_identity,
+                                "durationSeconds": lease.lease_duration_s,
+                                "renewTime": lease.renew_time,
+                                "transitions": lease.lease_transitions,
+                                "generation": lease.generation,
+                            },
+                            "fencedRejected":
+                                outer.scheduler.dispatcher.fenced,
+                        }
+                    self._send(200, json.dumps(payload, indent=2),
                                "application/json")
                 elif self.path.startswith("/debug/slo"):
                     self._send(200, json.dumps(

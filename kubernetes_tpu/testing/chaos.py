@@ -14,7 +14,14 @@ tolerates every day (and the resilient commit pipeline must absorb):
   watch-loss scenario `Scheduler.resync()` recovers from;
 - node flaps: a random node deleted and immediately re-created between
   API calls (delete + add events both fan out), mid-batch from the
-  scheduler's point of view.
+  scheduler's point of view;
+- lease chaos (ISSUE 12): expired-lease storms (the held lease's
+  renewTime is aged so any candidate's next acquire wins), stolen leases
+  mid-renew (holder swapped to a chaos thief between the elector's read
+  and its renew — the Conflict path), renew latency spikes (injected via
+  `sleep`, so a FakeClock-wired sleep pushes the elector past its renew
+  deadline) and a clock-skew knob added to the timestamp the API server
+  sees, so the election loop is chaos-covered like every other verb.
 
 Determinism: every injection draws from ONE `random.Random(seed)`, so a
 given (seed, workload, call sequence) replays the same fault script —
@@ -31,11 +38,13 @@ import random
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..backend.apiserver import (APIServer, Conflict, ServerTimeout,
-                                 TooManyRequests, WatchHandlers)
+from ..backend.apiserver import (APIServer, Conflict, LEASE_NAME,
+                                 ServerTimeout, TooManyRequests,
+                                 WatchHandlers)
 
 # verbs accepted in ChaosConfig.error_rates
-VERBS = ("create", "update", "bind", "patch", "delete")
+VERBS = ("create", "update", "bind", "patch", "delete",
+         "lease_acquire", "lease_renew", "lease_release")
 
 
 @dataclass
@@ -54,6 +63,24 @@ class ChaosConfig:
     dup_watch_rate: float = 0.0
     # per-API-call probability of a node flap (delete + re-create)
     node_flap_rate: float = 0.0
+    # lease chaos (ISSUE 12): probability per acquire/renew that the held
+    # lease's renewTime is aged past its duration (expired-lease storm)
+    lease_expire_rate: float = 0.0
+    # probability per renew that the lease is stolen mid-renew (holder
+    # swapped under the elector → Conflict on its renew)
+    lease_steal_rate: float = 0.0
+    # renew latency spikes: probability + delay range, injected via the
+    # facade's `sleep` (wire it to a FakeClock to push an elector past
+    # its renew deadline deterministically)
+    renew_latency_rate: float = 0.0
+    renew_latency_seconds: tuple[float, float] = (0.0, 0.0)
+    # constant skew added to the timestamp the HOLDER's renews record
+    # (fresh acquires use the candidate's true clock): a negative skew
+    # models a leader whose clock lags — its renewTimes land in the
+    # past, so candidates see the lease expire early. The two-clocks
+    # problem leases exist to tolerate; skewing every verb identically
+    # would cancel out.
+    clock_skew_s: float = 0.0
 
     def validate(self) -> None:
         unknown = set(self.error_rates) - set(VERBS)
@@ -83,6 +110,9 @@ class ChaosAPIServer:
         self.duplicated_events = 0
         self.node_flaps = 0
         self.injected_latency_total = 0.0
+        self.lease_expirations = 0
+        self.lease_steals = 0
+        self.renew_latency_spikes = 0
 
     def __getattr__(self, name: str):
         return getattr(self.inner, name)
@@ -173,15 +203,15 @@ class ChaosAPIServer:
         self._inject("update")
         return self.inner.update_pod(pod)
 
-    def delete_pod(self, uid: str):
+    def delete_pod(self, uid: str, fence_token=None):
         self._inject("delete")
-        return self.inner.delete_pod(uid)
+        return self.inner.delete_pod(uid, fence_token=fence_token)
 
-    def bind(self, pod, node_name: str):
+    def bind(self, pod, node_name: str, fence_token=None):
         self._inject("bind")
-        return self.inner.bind(pod, node_name)
+        return self.inner.bind(pod, node_name, fence_token=fence_token)
 
-    def bind_all(self, pairs):
+    def bind_all(self, pairs, fence_token=None):
         """Per-pair injection: the injected subset fails (transient or
         conflict), the rest passes through to the real bulk bind."""
         self._maybe_flap()
@@ -204,10 +234,76 @@ class ChaosAPIServer:
             else:
                 pass_through.append(pair)
         if pass_through:
-            failures.extend(self.inner.bind_all(pass_through))
+            failures.extend(self.inner.bind_all(pass_through,
+                                                fence_token=fence_token))
         return failures
 
-    def patch_pod_status(self, pod, condition, nominated_node_name=None):
+    def patch_pod_status(self, pod, condition, nominated_node_name=None,
+                         fence_token=None):
         self._inject("patch")
         return self.inner.patch_pod_status(pod, condition,
-                                           nominated_node_name)
+                                           nominated_node_name,
+                                           fence_token=fence_token)
+
+    # -- lease chaos (ISSUE 12) -----------------------------------------------
+
+    def _lease_chaos(self, name: str, renewing: bool = False) -> None:
+        """Age or steal the held lease between the elector's read and
+        its write — the races a real coordination API exposes."""
+        cfg = self.cfg
+        lease = self.inner.get_lease(name)
+        if lease is None or not lease.holder_identity:
+            return
+        if cfg.lease_expire_rate \
+                and self.rng.random() < cfg.lease_expire_rate:
+            lease.renew_time -= lease.lease_duration_s + 1.0
+            self.lease_expirations += 1
+        if renewing and cfg.lease_steal_rate \
+                and self.rng.random() < cfg.lease_steal_rate:
+            # a rogue holder claimed the lease mid-renew: the elector's
+            # renew hits Conflict; the thief never renews, so the real
+            # candidates recover after expiry (and the generation bump
+            # fences any write stamped before the steal)
+            self.lease_steals += 1
+            lease.lease_transitions += 1
+            lease.generation += 1
+            lease.holder_identity = f"chaos-thief-{self.lease_steals}"
+
+    def _renew_spike(self) -> None:
+        cfg = self.cfg
+        if cfg.renew_latency_rate \
+                and self.rng.random() < cfg.renew_latency_rate:
+            lo, hi = cfg.renew_latency_seconds
+            d = lo + (hi - lo) * self.rng.random()
+            self.renew_latency_spikes += 1
+            self.injected_latency_total += d
+            self.sleep(d)
+
+    def get_lease(self, name: str = LEASE_NAME):
+        return self.inner.get_lease(name)
+
+    def acquire_lease(self, name, identity, now, lease_duration_s=15.0):
+        # the elector renews through acquire (same-identity fast path),
+        # so a renew-shaped acquire gets the renew chaos: latency spikes
+        # and mid-renew steals, not just acquire-time errors
+        lease = self.inner.get_lease(name)
+        renewing = lease is not None and lease.holder_identity == identity
+        if renewing:
+            self._renew_spike()
+        self._inject("lease_renew" if renewing else "lease_acquire")
+        self._lease_chaos(name, renewing=renewing)
+        skew = self.cfg.clock_skew_s if renewing else 0.0
+        return self.inner.acquire_lease(
+            name, identity, now + skew,
+            lease_duration_s=lease_duration_s)
+
+    def renew_lease(self, name, identity, now):
+        self._renew_spike()
+        self._inject("lease_renew")
+        self._lease_chaos(name, renewing=True)
+        return self.inner.renew_lease(name, identity,
+                                      now + self.cfg.clock_skew_s)
+
+    def release_lease(self, name, identity):
+        self._inject("lease_release")
+        return self.inner.release_lease(name, identity)

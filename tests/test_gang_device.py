@@ -496,3 +496,89 @@ class TestGangChaos:
         assert chaos.injected_errors["bind"] > 0
         assert sched.dispatcher.errors == 0
         assert not sched.cache.assumed_pods
+
+
+class TestGangResyncContinuity:
+    """resync() must not drop gang state (ISSUE 12 satellite): the fresh
+    queue re-derives gated_by_ref, but the quorum-wait clocks and Permit
+    deadlines live OUTSIDE it and must be carried across the rebuild."""
+
+    def test_gated_gang_survives_resync_and_binds_on_quorum(self):
+        """Ordering-contract guard: a half-arrived gang stays gated
+        through a resync (wm registers every pod BEFORE add_bulk re-runs
+        PreEnqueue), then binds the moment quorum arrives."""
+        api = APIServer()
+        for i in range(8):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _workload(api, "train", min_count=8)
+        for i in range(5):                     # below quorum: gates
+            api.create_pod(make_pod(f"train-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("train").obj())
+        assert sched.schedule_pending() == 0
+        assert ("train", "") in {r[:2] for r in sched.queue.gated_refs()} \
+            or sched.queue.gated_refs()        # still gated, shape-agnostic
+        sched.resync()
+        # the rebuilt queue must re-gate (not strand, not leak) the gang
+        assert sched.schedule_pending() == 0
+        assert all(not p.spec.node_name for p in api.pods.values())
+        for i in range(5, 8):                  # quorum arrives after resync
+            api.create_pod(make_pod(f"train-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("train").obj())
+        assert sched.schedule_pending() == 8
+        assert not sched.queue.gated_refs()
+
+    def test_quorum_wait_clock_survives_resync(self):
+        """The regression this satellite fixes: resync() used to rebuild
+        the queue without carrying `_gang_gated_since`, silently dropping
+        the gang_quorum_wait observation for any gang that ungated after
+        a resync. The wait must be measured from the ORIGINAL gate time,
+        not from the resync (and not lost entirely)."""
+        api = APIServer()
+        for i in range(8):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _workload(api, "train", min_count=6)
+        for i in range(3):
+            api.create_pod(make_pod(f"train-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("train").obj())
+        sched.schedule_pending()               # gates at t=0
+        sched._clock.t = 5.0
+        sched.resync()                         # mid-wait watch-loss relist
+        sched._clock.t = 10.0
+        for i in range(3, 6):                  # quorum: ungates at t=10
+            api.create_pod(make_pod(f"train-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("train").obj())
+        assert sched.schedule_pending() == 6
+        m = sched.metrics.gang_quorum_wait
+        assert m.count() == 1
+        assert m.sum() >= 10.0                 # from t=0, not the resync
+
+    def test_permit_deadline_survives_resync(self):
+        """A surviving group's Permit deadline must not restart from
+        zero across a resync (the reference's podGroupInfo outlives any
+        one informer relist)."""
+        api = APIServer()
+        for i in range(4):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        sched = _sched(api)
+        _gang(api, "train", size=4, min_count=4)
+        # start the group's Permit clock, as the serial barrier would
+        info = sched.workload_manager.pod_group_infos[
+            ("default", "train", "")]
+        info.scheduling_timeout(sched._clock.t)
+        deadline = info.scheduling_deadline
+        assert deadline is not None
+        sched._clock.t = 7.0
+        sched.resync()
+        fresh = sched.workload_manager.pod_group_infos[
+            ("default", "train", "")]
+        assert fresh is not info               # the manager WAS rebuilt
+        assert fresh.scheduling_deadline == deadline

@@ -1,0 +1,600 @@
+"""Active/standby HA (ISSUE 12): lease-fenced failover with a hot spare.
+
+Four gates this file establishes:
+
+- the lease state machine (ha/lease.py): acquire → renew → depose →
+  re-elect, the deposed-leader slow path (step down at the renew
+  DEADLINE, before the lease expires), and the backoff-gated acquire
+  retry — all against the API server's lease verbs;
+- the fencing proof (ha/fencing.py + backend/dispatcher.py): a deposed
+  leader's delayed flush carries its STALE generation and is rejected
+  server-side (`fenced_writes_rejected_total` > 0), the unwind forgets
+  every assumed pod, and the successor binds the affected pods exactly
+  once (zero double-binds);
+- warm-standby state parity (ha/standby.py): after N audited drains a
+  synced standby's device staging arrays BIT-MATCH a fresh scheduler's
+  tensorize of the same store;
+- the kill-at-every-phase failover soak (slow): the leader dies at
+  host_build / device / commit / mid-flush, the spare takes over, and
+  the final assignment map is IDENTICAL to an unkilled run — with zero
+  double-binds, zero shadow-oracle divergence at 100% sampling, and the
+  drain-ledger hash chain intact across the spliced handoff.
+
+Lease chaos (testing/chaos.py): expired-lease storms, mid-renew steals,
+renew latency spikes and the clock-skew knob run the electors through
+the races a real coordination API exposes, seeded (CHAOS_SEED=N).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.backend.apiserver import (APIServer, FencedWrite,
+                                              LEASE_NAME)
+from kubernetes_tpu.ha import (LeaderElector, StandbyScheduler,
+                               fence_dispatcher)
+from kubernetes_tpu.config import KubeSchedulerConfiguration
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.chaos import ChaosAPIServer, ChaosConfig
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class Killed(Exception):
+    """Simulated process death: propagates out of the scheduling loop,
+    leaving whatever the 'process' had not committed uncommitted."""
+
+
+def _no_sleep(sched):
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _nodes(api, n=6, cpu=16, mem="32Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _pod_specs(n, seed, prefix="p"):
+    rng = random.Random(seed)
+    return [(f"{prefix}{i}", 250 * rng.randint(1, 6), 512 * rng.randint(1, 4))
+            for i in range(n)]
+
+
+def _create(api, specs):
+    for name, cpu, mem in specs:
+        api.create_pod(make_pod(name)
+                       .req({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}).obj())
+
+
+def _assignments(api):
+    return {uid: p.spec.node_name for uid, p in api.pods.items()}
+
+
+def _drive_to_quiescence(api, sched, clock, want_bound, max_rounds=60):
+    for _ in range(max_rounds):
+        sched.schedule_pending()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= want_bound:
+            return
+        clock.t += 10.0
+        sched.flush_queues()
+    raise AssertionError(
+        f"did not quiesce: "
+        f"{sum(1 for p in api.pods.values() if p.spec.node_name)}"
+        f"/{want_bound} bound, pending={sched.pending_summary()}")
+
+
+def _audited(sched):
+    """Force the shadow audit onto every drain, replayed inline (the
+    ledger must see every drain for the tail/handoff assertions)."""
+    assert sched.audit is not None, "ShadowOracleAudit gate must be on"
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
+    return sched
+
+
+def _standby(api, clock, ledger=None, identity="sched-b", **kw):
+    inner = _audited(_no_sleep(Scheduler(api, batch_size=32, clock=clock,
+                                         **kw)))
+    return StandbyScheduler(api, identity=identity, ledger=ledger,
+                            clock=clock, scheduler=inner)
+
+
+# -- lease state machine -------------------------------------------------------
+
+
+def test_lease_acquire_renew_depose_reelect():
+    """The full state machine: fresh acquire mints generation 1; renews
+    keep it; a dead leader's expiry hands the lease (and generation 2)
+    to the next candidate; the deposed leader notices via Conflict but
+    KEEPS its stale cached fence token."""
+    api = APIServer()
+    clock = Clock()
+    events = []
+    a = LeaderElector(api, "sched-a", clock=clock,
+                      on_started_leading=lambda: events.append("a-start"),
+                      on_stopped_leading=lambda: events.append("a-stop"))
+    b = LeaderElector(api, "sched-b", clock=clock,
+                      on_started_leading=lambda: events.append("b-start"),
+                      on_stopped_leading=lambda: events.append("b-stop"))
+
+    assert a.tick() is True and a.fence_token() == 1
+    assert b.tick() is False and b.fence_token() is None
+    clock.t = 10.0
+    assert a.tick() is True          # renew: same holder, same generation
+    assert a.fence_token() == 1
+    assert api.get_lease(LEASE_NAME).lease_transitions == 0
+
+    clock.t = 40.0                   # a stops renewing (dead)
+    assert b.tick() is True          # expired lease → b acquires
+    assert b.fence_token() == 2
+    lease = api.get_lease(LEASE_NAME)
+    assert lease.holder_identity == "sched-b"
+    assert lease.lease_transitions == 1
+    # the deposed leader's next tick observes the loss — but its cached
+    # token stays STALE (the fencing contract: late flushes must carry it)
+    assert a.tick() is False
+    assert not a.is_leader()
+    assert a.fence_token() == 1
+    assert events == ["a-start", "b-start", "a-stop"]
+
+    # voluntary release hands off without waiting for expiry
+    b.release()
+    assert not b.is_leader()
+    clock.t = 45.0                   # past a's post-conflict backoff gate
+    assert a.tick() is True
+    assert a.fence_token() == 3      # every holder change bumps it
+    assert events[-1] == "a-stop" or events[-2:] == ["b-stop", "a-start"]
+
+
+def test_deposed_leader_steps_down_before_lease_expiry():
+    """client-go's RenewDeadline < LeaseDuration slow path: when renews
+    fail transiently, the leader steps down at the renew deadline (10s)
+    — while its lease (15s) is still valid in the store — so a
+    successor can never overlap a half-dead leader."""
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED, error_rates={"lease_renew": 1.0}))
+    stops = []
+    a = LeaderElector(chaos, "sched-a", clock=clock,
+                      on_stopped_leading=lambda: stops.append(clock.t))
+    assert a.tick() is True          # the first acquire is not a renew
+
+    for t in (2.0, 4.0, 6.0, 8.0):
+        clock.t = t
+        assert a.tick() is True      # transient renew failures: hold on
+        assert a.is_leader()
+    clock.t = 10.0                   # the renew deadline (15 * 2/3)
+    assert a.tick() is False
+    assert not a.is_leader()
+    assert stops == [10.0]
+    # the slow path fired BEFORE lease expiry: the store still shows a
+    # valid, unexpired lease held by the stepped-down leader
+    lease = chaos.get_lease(LEASE_NAME)
+    assert lease.holder_identity == "sched-a"
+    assert clock.t - lease.renew_time < lease.lease_duration_s
+    assert chaos.injected_errors["lease_renew"] >= 5
+
+
+def test_nonleader_acquire_backoff_gates_retries():
+    """A candidate that lost the race backs off (jittered retry_period)
+    instead of hammering the lease on every tick."""
+    api = APIServer()
+    clock = Clock()
+    a = LeaderElector(api, "sched-a", clock=clock)
+    b = LeaderElector(api, "sched-b", clock=clock)
+    assert a.tick() is True
+    assert b.tick() is False
+    gate = b._next_acquire
+    assert clock.t < gate            # a backoff window was armed
+    clock.t = gate / 2
+    before = api.get_lease(LEASE_NAME).renew_time
+    assert b.tick() is False         # gated: no API call at all
+    assert api.get_lease(LEASE_NAME).renew_time == before
+
+
+# -- fencing -------------------------------------------------------------------
+
+
+def test_fence_token_rejection_at_api_server():
+    """API-server-level contract: a write stamped with a generation
+    older than the lease's current one raises FencedWrite; None passes
+    (unfenced legacy clients)."""
+    api = APIServer()
+    _nodes(api, n=2)
+    api.acquire_lease(LEASE_NAME, "sched-a", 0.0)       # generation 1
+    pod = api.create_pod(make_pod("f0").req({"cpu": "100m"}).obj())
+    api.acquire_lease(LEASE_NAME, "sched-b", 20.0)      # generation 2
+    with pytest.raises(FencedWrite):
+        api.bind(pod, "n0", fence_token=1)
+    assert api.fenced_rejections == 1
+    assert not api.pods[pod.uid].spec.node_name
+    api.bind(pod, "n0", fence_token=2)                  # current token: ok
+    api.patch_pod_status(pod, {"type": "PodScheduled"}, fence_token=None)
+    assert api.pods[pod.uid].spec.node_name == "n0"
+
+
+def test_deposed_leader_delayed_flush_is_fenced_and_unwinds():
+    """The fencing proof: a leader assumes pods and enqueues their binds
+    (stamped with generation 1), dies before flushing; the standby takes
+    over (generation 2); the dead leader's delayed flush is rejected
+    wholesale, the unwind forgets every assumed pod, and the successor
+    binds them — each exactly once."""
+    api = APIServer()
+    _nodes(api)
+    clock = Clock()
+    leader = _audited(_no_sleep(Scheduler(api, batch_size=32, clock=clock)))
+    el_a = LeaderElector(api, "sched-a", clock=clock,
+                         metrics=leader.metrics)
+    fence_dispatcher(leader.dispatcher, el_a)
+    assert el_a.tick() is True
+    leader.prime()
+
+    _create(api, _pod_specs(12, seed=100, prefix="w"))
+    # assume + enqueue WITHOUT flushing: drain the queue by hand — this
+    # is the instant a real process dies between commit and flush
+    qpis = leader.queue.drain(32)
+    leader._schedule_batch(qpis)
+    leader._drain_pending()
+    assert len(leader.dispatcher) > 0
+    assert leader.cache.assumed_pods
+
+    standby = _standby(api, clock, ledger=leader.audit.ledger)
+    clock.t = 20.0                   # the dead leader's lease expires
+    assert standby.tick() is True
+    assert standby.scheduler.ha_role == "active"
+    assert standby.elector.fence_token() == 2
+
+    # the zombie wakes up and flushes: every bind carries generation 1
+    leader.dispatcher.flush()
+    assert leader.dispatcher.fenced > 0
+    assert api.fenced_rejections > 0
+    assert leader.metrics.fenced_writes_rejected.value() > 0
+    assert not leader.cache.assumed_pods           # the unwind forgot them
+    assert all(not p.spec.node_name for p in api.pods.values())
+
+    # the successor now binds the (still unbound) pods — exactly once
+    _drive_to_quiescence(api, standby.scheduler, clock, want_bound=12)
+    assert api.binding_count == 12
+    m = standby.scheduler.metrics
+    assert m.leader_transitions.value("acquired") == 1
+    assert standby.scheduler.audit.ledger.verify()
+
+
+# -- lease chaos ---------------------------------------------------------------
+
+
+def test_chaos_expired_lease_storms_and_steals():
+    """Seeded lease chaos: expirations yank the lease from under the
+    holder, mid-renew steals force the Conflict path, and the system
+    still converges to exactly one leader with a monotonically bumped
+    generation once the storm stops."""
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED, lease_expire_rate=0.3, lease_steal_rate=0.3))
+    a = LeaderElector(chaos, "sched-a", clock=clock)
+    b = LeaderElector(chaos, "sched-b", clock=clock)
+    max_gen = 0
+    for _ in range(200):
+        clock.t += 2.0
+        a.tick()
+        b.tick()
+        lease = chaos.get_lease(LEASE_NAME)
+        if lease is not None:
+            assert lease.generation >= max_gen      # fence tokens: monotonic
+            max_gen = lease.generation
+        for el in (a, b):
+            if el.is_leader():
+                # a CURRENT leader's cached token matches the store (only
+                # deposed leaders go stale — that is the fencing contract)
+                assert el.fence_token() <= lease.generation
+    assert chaos.lease_expirations > 0
+    assert chaos.lease_steals > 0
+    # storm over: a stolen lease's thief never renews, so after expiry
+    # the real candidates recover to exactly one leader
+    chaos.cfg.lease_expire_rate = chaos.cfg.lease_steal_rate = 0.0
+    clock.t += 20.0
+    for _ in range(8):
+        clock.t += 2.0
+        a.tick()
+        b.tick()
+    assert sum(1 for el in (a, b) if el.is_leader()) == 1
+    leader = a if a.is_leader() else b
+    assert leader.fence_token() == chaos.get_lease(LEASE_NAME).generation
+
+
+def test_chaos_renew_latency_spike_deposes_leader():
+    """A renew that takes longer than the lease duration (injected via a
+    clock-wired sleep) leaves the stored renewTime stale: the next
+    candidate sees an expired lease and takes over; the laggard's next
+    renew hits Conflict and it steps down."""
+    clock = Clock()
+
+    def skew_sleep(s):
+        clock.t += s
+
+    chaos = ChaosAPIServer(config=ChaosConfig(
+        seed=SEED, renew_latency_rate=1.0,
+        renew_latency_seconds=(16.0, 16.0)), sleep=skew_sleep)
+    a = LeaderElector(chaos, "sched-a", clock=clock)
+    b = LeaderElector(chaos, "sched-b", clock=clock)
+    assert a.tick() is True          # acquire: no renew spike yet
+    clock.t = 2.0
+    a.tick()                         # renew stalls 16s inside the call
+    assert clock.t >= 18.0
+    assert chaos.renew_latency_spikes == 1
+    assert b.tick() is True          # renewTime=2, now=18: expired
+    assert a.tick() is False         # Conflict → deposed
+    assert not a.is_leader() and b.is_leader()
+    assert a.fence_token() == 1 and b.fence_token() == 2
+
+
+def test_chaos_clock_skew_expires_leases_early():
+    """The clock-skew knob: a holder whose clock LAGS (skew < -duration)
+    records renewTimes in the past, so candidates — reading true time —
+    see the lease expire out from under a leader that believes it just
+    renewed. The two-clocks failure leases exist to tolerate; the
+    takeover still bumps the generation so fencing holds."""
+    clock = Clock()
+    chaos = ChaosAPIServer(config=ChaosConfig(seed=SEED,
+                                              clock_skew_s=-16.0))
+    a = LeaderElector(chaos, "sched-a", clock=clock)
+    b = LeaderElector(chaos, "sched-b", clock=clock)
+    assert a.tick() is True          # fresh acquire: true clock
+    clock.t = 2.0
+    assert a.tick() is True          # renew recorded at 2 - 16 = -14
+    clock.t = 2.5
+    assert b.tick() is True          # 2.5 - (-14) > 15: looks expired
+    assert b.fence_token() == 2      # the bump still fences a's writes
+    assert a.tick() is False         # Conflict: a finds out
+    assert not a.is_leader() and b.is_leader()
+
+
+# -- warm standby --------------------------------------------------------------
+
+
+def test_standby_warm_state_parity_after_drains():
+    """The hot-spare contract: after N audited drains, a synced
+    standby's device staging arrays BIT-MATCH a fresh scheduler's
+    tensorize of the same store — takeover pays neither the LIST nor
+    the tensorize it already did while passive."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    leader = _audited(_no_sleep(Scheduler(api, batch_size=32, clock=clock)))
+    el_a = LeaderElector(api, "sched-a", clock=clock)
+    fence_dispatcher(leader.dispatcher, el_a)
+    assert el_a.tick() is True
+    leader.prime()
+
+    standby = _standby(api, clock, ledger=leader.audit.ledger)
+    assert standby.tick() is False   # the leader renews; spare stays warm
+    for wave in range(3):            # N drains land through the leader
+        _create(api, _pod_specs(16, seed=100 + wave, prefix=f"w{wave}-"))
+        leader.schedule_pending()
+        el_a.tick()
+        standby.sync()
+    assert standby.drains_seen >= 3
+    assert standby.ledger.lag(standby.cursor) == 0
+    assert standby.last_hash == leader.audit.ledger.head_hash()
+    assert standby.scheduler.ha_role == "standby"
+    assert standby.scheduler.schedule_pending() == 0   # standbys never write
+
+    fresh = Scheduler(api, batch_size=32, clock=clock)
+    fresh.prime()
+    warm = standby.scheduler.state
+    assert warm.node_index == fresh.state.node_index
+    for name, ours, theirs in zip(warm.arrays._fields,
+                                  warm.ensure_arrays(),
+                                  fresh.state.ensure_arrays()):
+        assert np.array_equal(np.asarray(ours), np.asarray(theirs)), \
+            f"standby staging array {name!r} diverged from fresh tensorize"
+
+
+def test_debug_ha_endpoint():
+    """/debug/ha serves the standby's full HA view (role, lease, fence
+    token, ledger cursor/lag, takeovers)."""
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.server import SchedulerServer
+    api = APIServer()
+    _nodes(api, n=2)
+    clock = Clock()
+    leader = _audited(_no_sleep(Scheduler(api, batch_size=16, clock=clock)))
+    el_a = LeaderElector(api, "sched-a", clock=clock)
+    fence_dispatcher(leader.dispatcher, el_a)
+    assert el_a.tick() is True
+    standby = _standby(api, clock, ledger=leader.audit.ledger)
+    standby.tick()
+    standby.sync()
+    srv = SchedulerServer(standby.scheduler, ha=standby).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/ha", timeout=10) as r:
+            payload = json.loads(r.read().decode())
+    finally:
+        srv.stop()
+    assert payload["role"] == "standby" and payload["leader"] is False
+    assert payload["lease"]["holder"] == "sched-a"
+    assert payload["lease"]["generation"] == 1
+    assert payload["ledgerLag"] == 0 and payload["takeovers"] == 0
+
+
+def test_gate_off_fallback_matrix():
+    """With `ActiveStandbyHA` off the elector still works, but the
+    dispatcher goes unfenced, sync() is a no-op (no ledger tail, no
+    device pre-warm) and takeover skips the splice — a cold resync, the
+    pre-ISSUE-12 posture."""
+    api = APIServer()
+    _nodes(api, n=2)
+    clock = Clock()
+    leader = _audited(_no_sleep(Scheduler(api, batch_size=16, clock=clock)))
+    el_a = LeaderElector(api, "sched-a", clock=clock)
+    fence_dispatcher(leader.dispatcher, el_a)
+    assert el_a.tick() is True
+    leader.prime()
+    _create(api, _pod_specs(6, seed=9))
+    leader.schedule_pending()
+    leader.audit.flush()
+    assert leader.audit.ledger.cursor() > 0
+
+    inner = _audited(_no_sleep(Scheduler(
+        api, batch_size=16, clock=clock,
+        config=KubeSchedulerConfiguration(
+            feature_gates={"ActiveStandbyHA": False}))))
+    standby = StandbyScheduler(api, identity="sched-b",
+                               ledger=leader.audit.ledger,
+                               clock=clock, scheduler=inner)
+    assert standby.enabled is False
+    # elector still works; writes are simply unfenced
+    assert standby.tick() is False
+    assert standby.scheduler.dispatcher.fence is None
+    # sync() is a no-op: nothing consumed, cursor never advances
+    assert standby.sync() == 0
+    assert standby.cursor == 0 and standby.drains_seen == 0
+    # takeover is a cold resync with no splice: this instance's chain
+    # starts from genesis, not the dead leader's head
+    clock.t += 20.0
+    assert standby.tick() is True
+    assert standby.takeovers == 1
+    assert standby.scheduler.ha_role == "active"
+    assert standby.scheduler.audit.ledger.cursor() == 0
+    assert standby.scheduler.audit.ledger.head_hash() \
+        != leader.audit.ledger.head_hash()
+
+
+# -- the failover soak ---------------------------------------------------------
+
+
+class MidFlushKiller:
+    """Leader-only client facade: when armed, the next bulk bind commits
+    its first half and then the 'process' dies — the half-flushed batch
+    a real crash leaves behind."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.armed = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def bind_all(self, pairs, fence_token=None):
+        if self.armed and len(pairs) > 1:
+            self.armed = False
+            self.inner.bind_all(pairs[:len(pairs) // 2],
+                                fence_token=fence_token)
+            raise Killed("died mid-flush")
+        return self.inner.bind_all(pairs, fence_token=fence_token)
+
+
+def _arm_kill(leader, phase):
+    """Wire the simulated death into the chosen drain phase."""
+    if phase == "host_build":
+        orig = leader.builder.build
+
+        def die(*a, **k):
+            leader.builder.build = orig
+            raise Killed("died in host build")
+        leader.builder.build = die
+    elif phase == "device":
+        # dispatched, never committed: results die in flight
+        def die(*a, **k):
+            raise Killed("died before commit")
+        leader._commit_next = die
+    elif phase == "commit":
+        # committed locally (cache + dispatcher enqueue), never flushed
+        orig_flush = leader.dispatcher.flush
+
+        def die_flush(*a, **k):
+            if len(leader.dispatcher):
+                raise Killed("died before the API flush")
+            return orig_flush(*a, **k)
+        leader.dispatcher.flush = die_flush
+    elif phase == "mid_flush":
+        leader.client.armed = True
+    else:                            # pragma: no cover
+        raise AssertionError(phase)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase",
+                         ["host_build", "device", "commit", "mid_flush"])
+def test_failover_kill_matrix(phase):
+    """Kill the leader at every drain phase: the warm spare takes over
+    and the final assignment map is IDENTICAL to an unkilled run — zero
+    double-binds, zero oracle divergence at 100% sampling, hash chain
+    intact across the spliced handoff."""
+    # unkilled twin: one scheduler, same store mutations
+    api0 = APIServer()
+    _nodes(api0, n=8, cpu=32, mem="64Gi")
+    clock0 = Clock()
+    ref = _audited(_no_sleep(Scheduler(api0, batch_size=32, clock=clock0)))
+    _create(api0, _pod_specs(20, seed=100, prefix="a"))
+    ref.schedule_pending()
+    _create(api0, _pod_specs(24, seed=200, prefix="b"))
+    _drive_to_quiescence(api0, ref, clock0, want_bound=44)
+    baseline = _assignments(api0)
+    assert len(baseline) == 44 and all(baseline.values())
+
+    # killed run: leader + warm standby on one store
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    client = MidFlushKiller(api) if phase == "mid_flush" else api
+    leader = _audited(_no_sleep(Scheduler(client, batch_size=32,
+                                          clock=clock)))
+    el_a = LeaderElector(api, "sched-a", clock=clock)
+    fence_dispatcher(leader.dispatcher, el_a)
+    assert el_a.tick() is True
+    _create(api, _pod_specs(20, seed=100, prefix="a"))
+    leader.schedule_pending()
+
+    standby = _standby(api, clock, ledger=leader.audit.ledger)
+    assert standby.tick() is False
+    standby.sync()                   # warm: cache + arrays + JIT minted
+
+    _create(api, _pod_specs(24, seed=200, prefix="b"))
+    _arm_kill(leader, phase)
+    with pytest.raises(Killed):
+        leader.schedule_pending()
+    # the leader is dead: it never ticks, renews or flushes again
+    clock.t += 20.0                  # its lease expires
+    assert standby.tick() is True    # takeover: tail drain, splice,
+    sched_b = standby.scheduler      # delta resync, promote
+    assert sched_b.ha_role == "active"
+    assert standby.takeovers == 1
+    assert standby.failover_s is not None
+
+    _drive_to_quiescence(api, sched_b, clock, want_bound=44)
+
+    # assignment-set parity with the unkilled twin
+    assert _assignments(api) == baseline
+    # zero double-binds: every pod bound exactly once, ever
+    assert api.binding_count == 44
+    assert not sched_b.cache.assumed_pods
+    assert sched_b.reconcile() == []
+    # zero shadow-oracle divergence on BOTH sides of the handoff
+    for sched in (leader, sched_b):
+        for kind in ("assignment", "reason", "verdict"):
+            assert sched.metrics.oracle_divergence.value(kind) == 0, kind
+    # the spliced hash chain verifies across the handoff, and the
+    # successor's chain really does continue the dead leader's
+    assert leader.audit.ledger.verify()
+    assert sched_b.audit.ledger.verify()
+    assert sched_b.metrics.ha_failover.count() >= 1
+    assert sched_b.metrics.leader_transitions.value("acquired") == 1

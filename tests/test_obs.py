@@ -177,6 +177,83 @@ class TestDrainLedger:
         assert led.appended == 10
 
 
+class TestDrainLedgerStreaming:
+    """The standby-facing streaming surface (ISSUE 12): seq cursors,
+    tail/lag, chain splice for the failover handoff, and thread safety
+    of append/verify under a concurrent tail subscriber."""
+
+    def test_seq_tail_lag_and_head(self):
+        led = DrainLedger(capacity=8)
+        for i in range(5):
+            led.append(_rec(i))
+        assert [r.seq for r in led.tail(0)] == [1, 2, 3, 4, 5]
+        assert [r.seq for r in led.tail(3)] == [4, 5]
+        assert led.lag(3) == 2 and led.lag(5) == 0
+        assert led.cursor() == 5
+        assert led.head_hash() == led.head
+        # a laggard whose cursor fell behind the ring gets what is still
+        # retained; lag() reports the true arrears
+        led2 = DrainLedger(capacity=3)
+        for i in range(10):
+            led2.append(_rec(i))
+        assert [r.seq for r in led2.tail(0)] == [8, 9, 10]
+        assert led2.lag(0) == 10
+
+    def test_splice_continues_a_foreign_chain(self):
+        """Failover handoff: the successor splices its empty ledger onto
+        the dead leader's head so verify() holds ACROSS schedulers."""
+        a = DrainLedger(capacity=8)
+        for i in range(4):
+            a.append(_rec(i))
+        b = DrainLedger(capacity=8)
+        b.splice(a.head_hash(), seq=a.cursor())
+        rec = b.append(_rec(99))
+        assert rec.prev_hash == a.head_hash()
+        assert rec.seq == 5
+        assert b.verify()
+        with pytest.raises(ValueError):
+            b.splice("other")   # non-empty: its chain already continues
+
+    def test_concurrent_append_vs_tail_and_verify(self):
+        """Thread-safety gate: one appender (the leader's audit worker)
+        races a tail subscriber (the standby) that interleaves verify(),
+        tail() and lag(). verify() must never observe a half-linked
+        chain, tail seqs must be strictly increasing, and the subscriber
+        must land exactly on the final cursor."""
+        led = DrainLedger(capacity=64)
+        n = 400
+        errors, seen = [], []
+        stop = threading.Event()
+
+        def tailer():
+            cursor = 0
+            try:
+                while not stop.is_set() or led.lag(cursor):
+                    if not led.verify():
+                        errors.append("verify() saw a broken chain")
+                        return
+                    for r in led.tail(cursor):
+                        if r.seq <= cursor:
+                            errors.append(f"tail not monotonic at {r.seq}")
+                            return
+                        cursor = r.seq
+                        seen.append(r.seq)
+            except Exception as e:          # pragma: no cover
+                errors.append(repr(e))
+
+        t = threading.Thread(target=tailer)
+        t.start()
+        for i in range(n):
+            led.append(_rec(i))
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert errors == []
+        assert led.verify()
+        assert seen and seen[-1] == n
+        assert all(a < b for a, b in zip(seen, seen[1:]))
+
+
 # ---------------------------------------------------------------------------
 # shadow-oracle audit end to end
 

@@ -24,6 +24,11 @@ and fails (exit 2) on:
     workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
     nonzero shadow-oracle divergence — a bench run whose decisions
     diverged from the host oracle fails regardless of its throughput.
+    The SLI set is whatever obs/slo.py configures — with ISSUE 12 that
+    gained failover time as a sixth SLI (`failover`: HA takeovers slower
+    than the objective burn budget and gate here like any other breach);
+    the warm-vs-cold takeover numbers themselves ride the bench extras
+    (`HAFailover_*`), which are recorded but never gated.
 
 Workloads present on only one side are reported but never fail (the case
 set grows over time); the `Sharded_` CPU-mesh probe is excluded — it is
