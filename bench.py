@@ -4,7 +4,8 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "pods/s", "vs_baseline": N/270,
    "summary": {"<Case>_<Workload>": {"pods_per_s": N, "p50": N, "p99": N,
-               "attempt_p50_ms": N, "attempt_p99_ms": N}, ...},
+               "attempt_p50_ms": N, "attempt_p99_ms": N,
+               "e2e_p50_ms": N, "e2e_p99_ms": N}, ...},
    "extra": {"TopologySpreading_...": {...}, "SchedulingPodAntiAffinity_...":
    {...}}}
 
@@ -133,6 +134,8 @@ def run():
         "p50": round(perc(0.50)), "p99": round(perc(0.99)),
         "attempt_p50_ms": round(m.attempt_duration.quantile(0.50) * 1e3, 3),
         "attempt_p99_ms": round(m.attempt_duration.quantile(0.99) * 1e3, 3),
+        "e2e_p50_ms": round(m.sli_duration.quantile(0.50) * 1e3, 3),
+        "e2e_p99_ms": round(m.sli_duration.quantile(0.99) * 1e3, 3),
         "slo": sched.slo.snapshot(compact=True),
     }
 
@@ -239,6 +242,11 @@ def main() -> None:
                     help="write one collapsed-stack host profile per "
                          "workload (continuous profiler; render with "
                          "flamegraph.pl or speedscope.app)")
+    ap.add_argument("--timeline-dir", default="",
+                    help="write one JSON-lines telemetry timeline per "
+                         "workload (obs/timeline.py per-second "
+                         "aggregates: binds, requeue causes, e2e "
+                         "segments, cluster-probe samples)")
     ap.add_argument("--cases", default="",
                     help="comma-separated case filter (e.g. "
                          "SchedulingBasic,TopologySpreading); default all")
@@ -278,7 +286,8 @@ def main() -> None:
             got = run_config(cfg, case, workload, verbose=verbose,
                              metrics_path="bench_metrics.prom",
                              trace_dir=args.trace_dir,
-                             profile_dir=args.profile_dir)
+                             profile_dir=args.profile_dir,
+                             timeline_dir=args.timeline_dir)
             measured_s += time.perf_counter() - t0
             if not got:
                 raise SystemExit(f"workload {case}/{workload} not found")
@@ -351,6 +360,11 @@ def main() -> None:
             "p50": entry.get("p50", 0), "p99": entry.get("p99", 0),
             "attempt_p50_ms": entry.get("attempt_p50_ms", 0.0),
             "attempt_p99_ms": entry.get("attempt_p99_ms", 0.0),
+            # queue→bind e2e percentiles (ISSUE 13): the SLI clock that
+            # starts at FIRST enqueue and survives requeues — what
+            # tools/bench_compare.py's e2e-latency gate reads.
+            "e2e_p50_ms": entry.get("e2e_p50_ms", 0.0),
+            "e2e_p99_ms": entry.get("e2e_p99_ms", 0.0),
             # host-phase shares of the drain cycle (ISSUE 9): what
             # fraction of scheduler_drain_phase_seconds Python still owns.
             # host_share = (host_build + commit) / cycle is the columnar

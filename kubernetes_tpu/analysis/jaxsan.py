@@ -57,7 +57,8 @@ ENTRY_POINTS = {
     "kubernetes_tpu.ops.program": (
         "run_batch", "run_uniform", "run_wave", "run_wave_scan",
         "run_plan", "wave_statics", "diagnose_row",
-        "dry_run_select_victims", "scatter_rows", "explain_row"),
+        "dry_run_select_victims", "scatter_rows", "explain_row",
+        "cluster_probe"),
     "kubernetes_tpu.ops.gang": ("run_gang",),
     "kubernetes_tpu.parallel.sharding": ("run_batch_sharded",),
 }

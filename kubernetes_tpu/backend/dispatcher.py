@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.types import Pod
+from ..obs.journey import EV_BIND_FLUSH as _EV_BIND_FLUSH
 from .apiserver import Conflict, FencedWrite, is_retriable
 
 
@@ -80,6 +81,9 @@ class APIDispatcher:
     client: object  # APIServer-shaped
     on_bind_error: Optional[Callable[[Pod, str, Exception], None]] = None
     metrics: Optional[object] = None  # SchedulerMetrics (api_dispatcher_calls)
+    # obs/journey.py ledger (attached by the scheduler): bind_enqueue /
+    # bind_flush transitions + the commit_backlog clock start
+    journey: Optional[object] = None
     # retry policy (config knobs apiRetryMaxAttempts/apiRetryBaseSeconds):
     # attempt budget INCLUDES the first try; base doubles per retry with
     # equal jitter, capped at retry_max_delay_seconds
@@ -118,6 +122,8 @@ class APIDispatcher:
     def add(self, call: APICall) -> None:
         self._stamp(call)
         uid = call.pod.uid
+        if call.call_type == CallType.BIND and self.journey is not None:
+            self.journey.bind_enqueued([uid], self.journey.clock())
         with self._lock:
             pending = self._queue.get(uid)
             if pending is not None:
@@ -149,6 +155,9 @@ class APIDispatcher:
         commit: one list extend instead of B dict transactions. The
         original lets bind_all prove by identity that no interleaved
         update landed, and reuse the assumed copy as the stored object."""
+        if self.journey is not None and pairs:
+            self.journey.bind_enqueued([pair[0].uid for pair in pairs],
+                                       self.journey.clock())
         token = self.fence() if self.fence is not None else None
         with self._lock:
             if token is not None and (self._bind_fence is None
@@ -277,6 +286,12 @@ class APIDispatcher:
         if not binds:
             return 0
         n_bulk = len(binds)
+        # journey: flush recorded BEFORE execution — the API write is the
+        # flush's effect, and the bind-echo confirm must sort after it
+        if self.journey is not None:
+            self.journey.record_bulk([pair[0].uid for pair in binds],
+                                     _EV_BIND_FLUSH, self.journey.clock(),
+                                     detail="bulk")
         failures = self._execute_binds(binds, fence_token=bind_fence)
         n_fail = len(failures)
         self.executed += n_bulk - n_fail
@@ -307,6 +322,9 @@ class APIDispatcher:
             else:
                 fn = lambda c=call: self.client.patch_pod_status(
                     c.pod, c.condition or {}, c.nominated_node_name, **kw)
+            if call.call_type == CallType.BIND and self.journey is not None:
+                self.journey.record(call.pod.uid, _EV_BIND_FLUSH,
+                                    self.journey.clock())
             err = self._execute_with_retry(call.call_type, fn)
             if err is None:
                 self.executed += 1

@@ -32,6 +32,8 @@ from typing import Callable, Optional
 from ..api.types import Pod
 from ..framework.types import (ActionType, ClusterEvent, EventResource,
                                QueuedPodInfo, QueueingHint)
+from ..obs.journey import (EV_ENQUEUE as _EV_ENQUEUE, EV_GATE as _EV_GATE,
+                           EV_UNGATE as _EV_UNGATE)
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
 DEFAULT_POD_MAX_BACKOFF = 10.0
@@ -261,6 +263,10 @@ class SchedulingQueue:
         self.in_flight_pods: dict[str, int] = {}     # uid → pop event seq
         self.in_flight_events: list[_InFlightEvent] = []
         self.moved_in_cycle: dict[str, int] = {}     # uid → cycle when moved by event
+        # journey ledger (obs/journey.py), attached by the scheduler: the
+        # queue owns the enqueue/gate/ungate/pop transitions AND the
+        # first-enqueue e2e SLI clock restore for fresh QueuedPodInfos
+        self.journey = None
 
     # -- ordering ------------------------------------------------------------
 
@@ -312,7 +318,20 @@ class SchedulingQueue:
 
     def add(self, pod: Pod) -> None:
         from ..framework.types import PodInfo
-        qpi = QueuedPodInfo(pod_info=PodInfo.of(pod), timestamp=self.clock())
+        now = self.clock()
+        # the e2e SLI clock starts at the pod's FIRST enqueue, not its
+        # first pop — and a re-add of a known pod (watch replay, fresh
+        # QueuedPodInfo after a bind error) must restore the original
+        # clock, not restart it
+        t0 = now
+        journey = self.journey
+        if journey is not None:
+            if journey.first_enqueue(pod.uid, now):
+                journey.record(pod.uid, _EV_ENQUEUE, now)
+            else:
+                t0 = journey.e2e_start(pod.uid, now)
+        qpi = QueuedPodInfo(pod_info=PodInfo.of(pod), timestamp=now,
+                            initial_attempt_timestamp=t0)
         self._add_qpi(qpi)
 
     def add_bulk(self, pods: list[Pod]) -> int:
@@ -325,9 +344,21 @@ class SchedulingQueue:
         pre = self.pre_enqueue
         active_add = self.active_q.add
         nominator_add = self.nominator.add
+        journey = self.journey
+        fresh = []
+        gates = []
         gated = 0
         for pod in pods:
-            qpi = QueuedPodInfo(pod_info=PodInfo.of(pod), timestamp=now)
+            qpi = QueuedPodInfo(pod_info=PodInfo.of(pod), timestamp=now,
+                                initial_attempt_timestamp=now)
+            if journey is not None:
+                if journey.first_enqueue(pod.uid, now):
+                    fresh.append(pod.uid)
+                else:
+                    # known pod, fresh QPI (resync rebuild / watch
+                    # replay): restore the e2e SLI clock
+                    qpi.initial_attempt_timestamp = journey.e2e_start(
+                        pod.uid, now)
             if pre is not None:
                 status = pre(pod)
                 if not status.is_success():
@@ -337,13 +368,22 @@ class SchedulingQueue:
                     self.unschedulable_since[pod.uid] = now
                     self._index_gated(pod)
                     gated += 1
+                    if journey is not None:
+                        gates.append((pod.uid, status.plugin or ""))
                     continue
             active_add(pod.metadata.uid, qpi)
             if pod.status.nominated_node_name:
                 nominator_add(qpi)
+        if journey is not None:
+            journey.record_bulk(fresh, _EV_ENQUEUE, now)
+            if gates:
+                journey.record_bulk([u for u, _ in gates], _EV_GATE, now,
+                                    detail=[p for _, p in gates])
         return gated
 
     def _add_qpi(self, qpi: QueuedPodInfo) -> None:
+        was_gated = qpi.gated
+        journey = self.journey
         if self.pre_enqueue is not None:
             status = self.pre_enqueue(qpi.pod)
             if not status.is_success():
@@ -352,8 +392,13 @@ class SchedulingQueue:
                 self.unschedulable_pods[qpi.pod.uid] = qpi
                 self.unschedulable_since[qpi.pod.uid] = self.clock()
                 self._index_gated(qpi.pod)
+                if journey is not None and not was_gated:
+                    journey.record(qpi.pod.uid, _EV_GATE, self.clock(),
+                                   detail=status.plugin or "")
                 return
         qpi.gated = False
+        if journey is not None and was_gated:
+            journey.record(qpi.pod.uid, _EV_UNGATE, self.clock())
         self.active_q.add(qpi.pod.uid, qpi)
         self.nominator.add(qpi)
 
@@ -408,6 +453,8 @@ class SchedulingQueue:
         if qpi is None:
             return None
         self._mark_in_flight(qpi)
+        if self.journey is not None:
+            self.journey.popped([qpi], self.clock())
         return qpi
 
     def drain(self, max_pods: int = 0) -> list[QueuedPodInfo]:
@@ -421,14 +468,16 @@ class SchedulingQueue:
                                            max(max_pods, 0))
             for qpi in out:
                 self._mark_in_flight(qpi)
-            return out
-        out = []
-        while max_pods <= 0 or len(out) < max_pods:
-            qpi = self.active_q.pop()
-            if qpi is None:
-                break
-            self._mark_in_flight(qpi)
-            out.append(qpi)
+        else:
+            out = []
+            while max_pods <= 0 or len(out) < max_pods:
+                qpi = self.active_q.pop()
+                if qpi is None:
+                    break
+                self._mark_in_flight(qpi)
+                out.append(qpi)
+        if out and self.journey is not None:
+            self.journey.popped(out, self.clock())
         return out
 
     def _mark_in_flight(self, qpi: QueuedPodInfo) -> None:

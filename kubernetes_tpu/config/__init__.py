@@ -97,6 +97,12 @@ class KubeSchedulerConfiguration:
     # directory for standalone replay records (one pickle per audited
     # drain, re-runnable via tools/audit_replay.py); "" = in-memory only
     shadow_audit_dir: str = ""
+    # telemetry timeline (obs/timeline.py, `TelemetryTimeline` gate):
+    # ring depth in seconds, and the JSON-lines export sink — each
+    # per-second bucket is appended as it rotates out of "current"
+    # ("" = in-memory ring only)
+    timeline_horizon_seconds: int = 900
+    timeline_export_path: str = ""
     # SLO burn-rate objectives (obs/slo.py): sli name → {"objective":
     # fraction, "thresholdSeconds": latency bound, "maxBurn": {window:
     # rate}} overriding the defaults; unknown sli names are rejected
@@ -134,6 +140,8 @@ class KubeSchedulerConfiguration:
             raise ValueError("shadowAuditSampleRate must be in [0, 1]")
         if self.shadow_audit_max_replay_pods < 0:
             raise ValueError("shadowAuditMaxReplayPods must be >= 0")
+        if self.timeline_horizon_seconds < 1:
+            raise ValueError("timelineHorizonSeconds must be >= 1")
         from ..obs.slo import validate_objectives
         validate_objectives(self.slo_objectives)  # raises on unknown sli
         known = set(_default_plugin_names()) | set(self.extra_plugins)
@@ -183,6 +191,8 @@ class KubeSchedulerConfiguration:
             "shadowAuditSampleRate": self.shadow_audit_sample_rate,
             "shadowAuditMaxReplayPods": self.shadow_audit_max_replay_pods,
             "shadowAuditDir": self.shadow_audit_dir,
+            "timelineHorizonSeconds": self.timeline_horizon_seconds,
+            "timelineExportPath": self.timeline_export_path,
             "sloObjectives": dict(self.slo_objectives),
             "extraPlugins": list(self.extra_plugins),
             "featureGates": dict(self.feature_gates),
@@ -234,6 +244,8 @@ class KubeSchedulerConfiguration:
             shadow_audit_max_replay_pods=d.get("shadowAuditMaxReplayPods",
                                                64),
             shadow_audit_dir=d.get("shadowAuditDir", ""),
+            timeline_horizon_seconds=d.get("timelineHorizonSeconds", 900),
+            timeline_export_path=d.get("timelineExportPath", ""),
             slo_objectives=dict(d.get("sloObjectives", {})),
             extra_plugins=tuple(d.get("extraPlugins", ())),
             feature_gates=dict(d.get("featureGates", {})))

@@ -102,6 +102,21 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # standby runs cold — takeover degrades to a full LIST + tensorize +
     # JIT warm-up.
     "ActiveStandbyHA": FeatureSpec(True, ALPHA),
+    # pod-journey tracing (obs/journey.py): the columnar lifecycle ring
+    # behind /debug/pod and the scheduler_e2e_segment_seconds families.
+    # Off = no transition recording; the first-enqueue SLI clock is NOT
+    # gated (the e2e bugfix holds regardless).
+    "PodJourneyTracing": FeatureSpec(True, BETA),
+    # on-device cluster analytics (ops/program.py cluster_probe): one
+    # reduction over the resident carry per drain → utilization
+    # percentiles, fragmentation/stranded indices, topology-domain
+    # imbalance (/debug/cluster, scheduler_cluster_* gauges, flight
+    # recorder, timeline).
+    "ClusterStateProbe": FeatureSpec(True, BETA),
+    # per-second telemetry timeline ring (obs/timeline.py):
+    # /debug/timeline + the config-gated JSON-lines exporter
+    # (timeline_export_path) + bench --timeline-dir.
+    "TelemetryTimeline": FeatureSpec(True, BETA),
 }
 
 

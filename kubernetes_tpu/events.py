@@ -217,6 +217,10 @@ class FlightRecord:
     # by the audit worker AFTER the replay lands ({} = unsampled).
     # Single reference assignment by the worker; readers snapshot it.
     audit: dict = field(default_factory=dict)
+    # resolved cluster_probe snapshot for this drain (scheduler
+    # _resolve_probe): utilization percentiles / fragmentation / domain
+    # imbalance over the post-drain carry. {} = probe off or dropped.
+    probe: dict = field(default_factory=dict)
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -233,7 +237,8 @@ class FlightRecord:
                 "fallback": self.fallback, "events": self.events,
                 "drainId": self.drain_id,
                 "hotFrames": list(self.hot_frames),
-                "audit": dict(self.audit)}
+                "audit": dict(self.audit),
+                "probe": dict(self.probe)}
 
 
 class FlightRecorder:

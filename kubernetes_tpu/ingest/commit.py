@@ -154,6 +154,10 @@ class CommitEngine:
             queue.in_flight_events.clear()
         nb = len(bound_pods)
         if nb:
+            from ..obs.journey import EV_ASSIGN
+            sched.journey.record_bulk(
+                [uid for uid, _node in event_refs], EV_ASSIGN, now,
+                detail=[node for _uid, node in event_refs])
             sched.dispatcher.add_binds(bound_pods)
             sched.events.scheduled_bulk(event_refs, now=now)
             sched.scheduled_count += nb

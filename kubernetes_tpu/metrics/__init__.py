@@ -246,6 +246,16 @@ DEVICE_SCORE_PLUGINS = (
     "NodeResourcesBalancedAllocation", "PodTopologySpread",
     "InterPodAffinity", "ImageLocality")
 
+# cluster_probe gauge label sets (ops/program.py PROBE_STATS columns
+# split across the two per-resource families + the domain family); the
+# exposition lint asserts these exact sets
+CLUSTER_UTIL_STATS = ("p50", "p90", "p99", "max", "mean")
+CLUSTER_FRAG_KINDS = ("fragmentation", "stranded")
+CLUSTER_DOM_STATS = ("domains", "max", "min", "spread")
+# resources every cluster exposes — pre-seeded so the series exist
+# before the first probe; the live probe adds the rtable's real set
+CLUSTER_SEED_RESOURCES = ("cpu", "memory")
+
 
 class SchedulerMetrics:
     """The scheduler's series, bound to one Registry (metrics.go Register)."""
@@ -484,6 +494,48 @@ class SchedulerMetrics:
             "queued api_calls (dispatcher) and dispatched-but-uncommitted "
             "drains.",
             ("kind",), callback=inflight))
+        # pod-journey tracing + on-device cluster analytics
+        # (kubernetes_tpu/obs/journey.py + ops cluster_probe, ISSUE 13)
+        self.e2e_segment = r.register(Histogram(
+            n + "e2e_segment_seconds",
+            "Queue→bind e2e latency decomposition by segment: queue_wait "
+            "(ready in queue to pop), gate_wait (PreEnqueue-gated, incl. "
+            "gang quorum), drain (device dispatch to commit), "
+            "commit_backlog (dispatcher enqueue to bind-echo confirm).",
+            buckets=exponential_buckets(0.0001, 2, 22),
+            label_names=("segment",)))
+        self.pod_requeues = r.register(Counter(
+            n + "pod_requeues_total",
+            "Pods re-entering the scheduling queue, by cause (journey "
+            "ledger requeue transitions: preemption nomination, "
+            "FencedWrite unwind, breaker fallback, gang split, resync, "
+            "bind error, plain unschedulable).",
+            ("cause",)))
+        self.journey_transitions = r.register(Counter(
+            n + "journey_transitions_total",
+            "Pod lifecycle transitions recorded by the journey ledger, "
+            "by event.",
+            ("event",)))
+        self.cluster_utilization = r.register(Gauge(
+            n + "cluster_utilization_ratio",
+            "cluster_probe per-resource utilization at the latest drain "
+            "sample: nearest-rank percentiles over nodes advertising the "
+            "resource, plus the exact aggregate mean (sum used / sum "
+            "capacity).",
+            ("resource", "stat")))
+        self.cluster_fragmentation = r.register(Gauge(
+            n + "cluster_fragmentation_index",
+            "cluster_probe free-capacity health per resource: "
+            "fragmentation = 1 - largest single free block / total free; "
+            "stranded = free capacity on bottleneck-tight nodes / total "
+            "free.",
+            ("resource", "kind")))
+        self.cluster_domain_imbalance = r.register(Gauge(
+            n + "cluster_domain_imbalance",
+            "cluster_probe topology-domain pod-density stats (pods per "
+            "valid node per domain) over the gang engine's Tesserae "
+            "dom-id column.",
+            ("stat",)))
         # pre-seed the zero samples so dashboards (and bench_metrics.prom)
         # always carry the fault-path series, faults or not
         from ..backend.dispatcher import CallType
@@ -569,6 +621,20 @@ class SchedulerMetrics:
         self.ha_failover.seed()
         self.ha_ledger_tail_lag.set(0.0)
         self.fenced_writes_rejected.inc(by=0)
+        from ..obs.journey import CAUSES, EVENTS, SEGMENTS
+        for segment in SEGMENTS:
+            self.e2e_segment.seed(segment)
+        for cause in CAUSES:
+            self.pod_requeues.inc(cause, by=0)
+        for event in EVENTS:
+            self.journey_transitions.inc(event, by=0)
+        for res in CLUSTER_SEED_RESOURCES:
+            for stat in CLUSTER_UTIL_STATS:
+                self.cluster_utilization.set(0.0, res, stat)
+            for kind in CLUSTER_FRAG_KINDS:
+                self.cluster_fragmentation.set(0.0, res, kind)
+        for stat in CLUSTER_DOM_STATS:
+            self.cluster_domain_imbalance.set(0.0, stat)
 
     def sync_compile_ledger(self) -> None:
         """Mirror the process-global compile ledger (perf/ledger.py) into

@@ -1,0 +1,257 @@
+"""Pod-journey tracing: a columnar per-pod lifecycle ledger.
+
+Every observability layer before this one (flight recorder, profiler/
+ledger, shadow audit, SLO engine) sees the world one *drain* at a time;
+none can answer "where did pod X spend its 40ms between enqueue and
+bind". The JourneyLedger records every pod state transition with
+monotonic timestamps into a ring of parallel columns — first enqueue,
+PreEnqueue gate/ungate (incl. gang quorum waits), pop into drain N,
+assignment or FitError, dispatcher enqueue/flush, bind-echo confirm,
+and every requeue with its *cause* (preemption nomination, FencedWrite
+unwind, breaker fallback, gang split, resync) — so `/debug/pod?uid=`
+renders a full causal timeline and queue→bind e2e latency decomposes
+into the `scheduler_e2e_segment_seconds{segment=...}` families.
+
+Hot-path contract: NO per-pod dict/object churn for transitions — the
+ring is five parallel Python lists extended in bulk (one `extend` per
+column per drain, not per pod) and trimmed amortized. The only per-pod
+dict state is two flat clocks the e2e SLI itself needs:
+
+  * `_first_seen` — the pod's FIRST enqueue time. This is the e2e SLI
+    clock's source of truth: it survives requeues, bind-error unwinds
+    (which mint a fresh QueuedPodInfo) and `resync()` (which rebuilds
+    the whole queue from a LIST). It is maintained even with the
+    `PodJourneyTracing` gate off, because the SLI bugfix must hold
+    regardless of whether tracing is on.
+  * `_bind_enq` — dispatcher-enqueue time, popped at bind-echo confirm
+    to produce the `commit_backlog` segment.
+
+Both are dropped at bind-echo confirm / pod delete, so they are bounded
+by the in-flight pod population, not pod history.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional
+
+# transition codes — index into EVENTS (column `_ev` stores the int)
+EV_ENQUEUE = 0       # first add to the scheduling queue
+EV_GATE = 1          # PreEnqueue gated (detail = gating plugin)
+EV_UNGATE = 2        # gate cleared (gang quorum met / gate removed)
+EV_POP = 3           # popped off the activeQ into a scheduling attempt
+EV_DRAIN = 4         # entered device drain N (detail = path)
+EV_ASSIGN = 5        # node chosen (detail = node name)
+EV_FIT_ERROR = 6     # unschedulable (detail = rejector plugins)
+EV_REQUEUE = 7       # re-entered the queue (detail = cause)
+EV_BIND_ENQUEUE = 8  # bind handed to the API dispatcher
+EV_BIND_FLUSH = 9    # dispatcher flushed the bind to the API server
+EV_BIND_CONFIRM = 10  # bind echo confirmed through the watch stream
+
+EVENTS = ("enqueue", "gate", "ungate", "pop", "drain", "assign",
+          "fit_error", "requeue", "bind_enqueue", "bind_flush",
+          "bind_confirm")
+
+# requeue causes (the `cause` label set of scheduler_pod_requeues_total;
+# exposition-lint asserts this exact set)
+CAUSES = ("preemption", "fence_unwind", "breaker_fallback", "gang_split",
+          "resync", "bind_error", "unschedulable")
+
+# e2e decomposition segments (the `segment` label set of
+# scheduler_e2e_segment_seconds; exposition-lint asserts this exact set)
+SEGMENTS = ("queue_wait", "gate_wait", "drain", "commit_backlog")
+
+
+class JourneyLedger:
+    """Ring-buffered columnar transition log + the e2e SLI clocks."""
+
+    def __init__(self, capacity: int = 1 << 16,
+                 clock: Callable[[], float] = _time.monotonic,
+                 metrics=None, enabled: bool = True):
+        self.capacity = capacity
+        self.clock = clock
+        self.metrics = metrics
+        self.timeline = None   # obs/timeline.py ring, attached by the owner
+        self.enabled = enabled
+        # parallel columns (the ring): object ref, event code, timestamp,
+        # detail string, drain id
+        self._uid: list = []
+        self._ev: list = []
+        self._ts: list = []
+        self._detail: list = []
+        self._drain: list = []
+        # e2e SLI clock: uid → first-enqueue time (see module docstring —
+        # maintained even when transition recording is disabled)
+        self._first_seen: dict[str, float] = {}
+        # uid → dispatcher-enqueue time (commit_backlog segment)
+        self._bind_enq: dict[str, float] = {}
+
+    # -- e2e SLI clock --------------------------------------------------------
+
+    def first_enqueue(self, uid: str, now: float) -> bool:
+        """Record the pod's first-enqueue time; True iff this was the
+        first sighting (a requeue/re-add of a known pod returns False and
+        leaves the original clock untouched)."""
+        if uid in self._first_seen:
+            return False
+        self._first_seen[uid] = now
+        return True
+
+    def e2e_start(self, uid: str, default: Optional[float] = None):
+        """The pod's FIRST enqueue time (the e2e SLI clock start), or
+        `default` when the pod was never seen (e.g. ledger restarted)."""
+        return self._first_seen.get(uid, default)
+
+    def forget(self, uid: str) -> None:
+        """Drop the per-pod clocks (bind confirmed or pod deleted)."""
+        self._first_seen.pop(uid, None)
+        self._bind_enq.pop(uid, None)
+
+    # -- recording ------------------------------------------------------------
+
+    def record(self, uid: str, ev: int, now: float, detail: str = "",
+               drain: int = 0) -> None:
+        if not self.enabled:
+            return
+        self._uid.append(uid)
+        self._ev.append(ev)
+        self._ts.append(now)
+        self._detail.append(detail)
+        self._drain.append(drain)
+        if self.metrics is not None:
+            self.metrics.journey_transitions.inc(EVENTS[ev])
+        if len(self._uid) >= self.capacity * 2:
+            self._trim()
+
+    def record_bulk(self, uids: list, ev: int, now: float,
+                    detail="", drain: int = 0) -> None:
+        """Bulk transition append: one extend per column for the whole
+        batch. `detail` is a shared string or a per-pod list aligned
+        with `uids`."""
+        if not self.enabled or not uids:
+            return
+        n = len(uids)
+        self._uid.extend(uids)
+        self._ev.extend([ev] * n)
+        self._ts.extend([now] * n)
+        self._detail.extend(detail if isinstance(detail, list)
+                            else [detail] * n)
+        self._drain.extend([drain] * n)
+        if self.metrics is not None:
+            self.metrics.journey_transitions.inc(EVENTS[ev], by=n)
+        if len(self._uid) >= self.capacity * 2:
+            self._trim()
+
+    def _trim(self) -> None:
+        """Amortized ring behavior: let the columns grow to 2× capacity,
+        then cut back to capacity in one slice-delete per column."""
+        cut = len(self._uid) - self.capacity
+        if cut <= 0:
+            return
+        del self._uid[:cut]
+        del self._ev[:cut]
+        del self._ts[:cut]
+        del self._detail[:cut]
+        del self._drain[:cut]
+
+    def popped(self, qpis: list, now: float) -> None:
+        """Pods popped off the activeQ into a scheduling attempt: EV_POP
+        plus the queue_wait segment (time since the last ready-enqueue,
+        which `qpi.timestamp` tracks across requeues)."""
+        if not self.enabled or not qpis:
+            return
+        waits = [max(now - q.timestamp, 0.0) for q in qpis]
+        if self.metrics is not None:
+            self.metrics.e2e_segment.observe_array(waits, "queue_wait")
+        if self.timeline is not None:
+            self.timeline.segment(now, "queue_wait", sum(waits), len(waits))
+            self.timeline.bump(now, "pops", len(waits))
+        self.record_bulk([q.pod.uid for q in qpis], EV_POP, now)
+
+    # -- dispatcher / commit hooks -------------------------------------------
+
+    def bind_enqueued(self, uids: list, now: float) -> None:
+        """Binds handed to the API dispatcher: transition + the
+        commit_backlog clock start (per-pod, popped at confirm)."""
+        if not self.enabled:
+            return
+        enq = self._bind_enq
+        for uid in uids:
+            enq[uid] = now
+        self.record_bulk(uids, EV_BIND_ENQUEUE, now)
+
+    def bind_confirmed(self, uids: list, now: float) -> list:
+        """Bind-echo confirms: transition + commit_backlog segment
+        durations (dispatcher enqueue → echo) for the pods that had a
+        recorded enqueue. Drops the per-pod clocks."""
+        enq_pop = self._bind_enq.pop
+        first_pop = self._first_seen.pop
+        waits: list = []
+        for uid in uids:
+            t0 = enq_pop(uid, None)
+            if t0 is not None:
+                waits.append(max(now - t0, 0.0))
+            first_pop(uid, None)
+        self.record_bulk(uids, EV_BIND_CONFIRM, now)
+        return waits
+
+    # -- query (cold path: /debug/pod) ---------------------------------------
+
+    def pod(self, uid: str) -> dict:
+        """Full causal timeline for one pod: every ring transition (in
+        order) plus the derived per-segment decomposition."""
+        transitions = [
+            {"t": self._ts[i], "event": EVENTS[self._ev[i]],
+             "detail": self._detail[i], "drain": self._drain[i]}
+            for i in range(len(self._uid)) if self._uid[i] == uid
+        ]
+        return {
+            "uid": uid,
+            "firstEnqueue": self._first_seen.get(uid),
+            "transitions": transitions,
+            "segments": self._segments(transitions),
+        }
+
+    @staticmethod
+    def _segments(transitions: list) -> dict:
+        """Decompose a transition list into the e2e segment sums (the
+        per-pod analog of scheduler_e2e_segment_seconds)."""
+        seg = {name: 0.0 for name in SEGMENTS}
+        ready_at = None      # last enqueue/ungate/requeue time
+        gated_at = None
+        drained_at = None
+        bind_enq_at = None
+        for tr in transitions:
+            ev, t = tr["event"], tr["t"]
+            if ev in ("enqueue", "requeue"):
+                ready_at = t
+            elif ev == "gate":
+                gated_at = t
+            elif ev == "ungate":
+                if gated_at is not None:
+                    seg["gate_wait"] += max(t - gated_at, 0.0)
+                    gated_at = None
+                ready_at = t
+            elif ev == "pop":
+                if ready_at is not None:
+                    seg["queue_wait"] += max(t - ready_at, 0.0)
+                    ready_at = None
+            elif ev == "drain":
+                drained_at = t
+            elif ev in ("assign", "fit_error"):
+                if drained_at is not None:
+                    seg["drain"] += max(t - drained_at, 0.0)
+                    drained_at = None
+            elif ev == "bind_enqueue":
+                bind_enq_at = t
+            elif ev == "bind_confirm":
+                if bind_enq_at is not None:
+                    seg["commit_backlog"] += max(t - bind_enq_at, 0.0)
+                    bind_enq_at = None
+        return seg
+
+    def stats(self) -> dict:
+        return {"transitions": len(self._uid),
+                "capacity": self.capacity,
+                "trackedPods": len(self._first_seen),
+                "enabled": self.enabled}

@@ -1,0 +1,150 @@
+"""Streaming telemetry timeline: a per-second aggregate ring.
+
+One bucket per wall-clock second, accumulating every SLI the scheduler
+streams (binds, failures, requeues by cause, drains, e2e segment
+sums/counts) plus the latest `cluster_probe` snapshot and an SLO sample
+taken when the bucket closes. The ring holds the last `horizon` seconds;
+`/debug/timeline?seconds=N` serves the newest N buckets as JSON, and a
+config-gated JSON-lines exporter (`timeline_export_path`) appends each
+bucket to disk as it rotates out of "current" — one line per second, so
+a tail of the file IS the live timeline.
+
+Buckets are plain dicts keyed by integer second; the hot-path cost of a
+sample is one dict lookup + a few float adds.
+"""
+
+from __future__ import annotations
+
+import json
+import time as _time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from .journey import CAUSES, SEGMENTS
+
+
+def _new_bucket(sec: int) -> dict:
+    return {
+        "t": sec,
+        "binds": 0,
+        "failures": 0,
+        "requeues": {},          # cause → count
+        "drains": 0,
+        "pops": 0,
+        "e2e": {},               # segment → [sum_seconds, count]
+        "probe": None,           # latest cluster_probe snapshot this second
+        "slo": None,             # SLO sample stamped when the bucket closes
+    }
+
+
+class Timeline:
+    """Per-second aggregate ring over all SLIs + probe outputs."""
+
+    def __init__(self, horizon: int = 900,
+                 clock: Callable[[], float] = _time.monotonic,
+                 export_path: str = "",
+                 slo_sample: Optional[Callable[[], dict]] = None,
+                 enabled: bool = True):
+        self.horizon = horizon
+        self.clock = clock
+        self.export_path = export_path
+        self.slo_sample = slo_sample
+        self.enabled = enabled
+        self._buckets: OrderedDict[int, dict] = OrderedDict()
+        self._exported = 0   # buckets written to the JSON-lines export
+
+    # -- bucket plumbing ------------------------------------------------------
+
+    def _bucket(self, now: float) -> dict:
+        sec = int(now)
+        b = self._buckets.get(sec)
+        if b is None:
+            self._rotate(sec)
+            b = self._buckets[sec] = _new_bucket(sec)
+        return b
+
+    def _rotate(self, new_sec: int) -> None:
+        """A new second began: stamp the closing bucket with an SLO
+        sample, stream closed buckets to the exporter, evict old ones."""
+        if self._buckets:
+            last = next(reversed(self._buckets))
+            if self.slo_sample is not None and new_sec > last:
+                try:
+                    self._buckets[last]["slo"] = self.slo_sample()
+                except Exception:  # sampling must never break the hot path
+                    pass
+        if self.export_path:
+            self._export_closed(new_sec)
+        while len(self._buckets) >= self.horizon:
+            self._buckets.popitem(last=False)
+            if self._exported > 0:
+                self._exported -= 1
+
+    def _export_closed(self, new_sec: int) -> None:
+        closed = [b for sec, b in self._buckets.items() if sec < new_sec]
+        # `_exported` counts closed buckets already streamed; eviction only
+        # ever removes exported buckets, so index from the tail.
+        fresh = closed[self._exported:]
+        if not fresh:
+            return
+        try:
+            with open(self.export_path, "a") as fh:
+                for b in fresh:
+                    fh.write(json.dumps(b, separators=(",", ":")) + "\n")
+            self._exported = len(closed)
+        except OSError:
+            self.export_path = ""  # disable on a broken sink, don't spin
+
+    # -- hot-path samples -----------------------------------------------------
+
+    def bump(self, now: float, field: str, by: int = 1) -> None:
+        if not self.enabled:
+            return
+        b = self._bucket(now)
+        b[field] = b.get(field, 0) + by
+
+    def requeue(self, now: float, cause: str, by: int = 1) -> None:
+        if not self.enabled:
+            return
+        rq = self._bucket(now)["requeues"]
+        rq[cause] = rq.get(cause, 0) + by
+
+    def segment(self, now: float, segment: str, total: float,
+                count: int) -> None:
+        """Accumulate `count` observations summing to `total` seconds."""
+        if not self.enabled or count <= 0:
+            return
+        e2e = self._bucket(now)["e2e"]
+        cell = e2e.get(segment)
+        if cell is None:
+            e2e[segment] = [total, count]
+        else:
+            cell[0] += total
+            cell[1] += count
+
+    def probe(self, now: float, snapshot: dict) -> None:
+        if not self.enabled:
+            return
+        self._bucket(now)["probe"] = snapshot
+
+    # -- queries / export -----------------------------------------------------
+
+    def series(self, seconds: int = 60) -> dict:
+        """The newest `seconds` buckets, oldest first."""
+        buckets = list(self._buckets.values())[-max(int(seconds), 1):]
+        return {
+            "horizonSeconds": self.horizon,
+            "causes": list(CAUSES),
+            "segments": list(SEGMENTS),
+            "buckets": buckets,
+        }
+
+    def to_jsonl(self, path: str) -> int:
+        """Dump the whole ring as JSON lines (one bucket per line);
+        returns the number of buckets written. Used by
+        `bench.py --timeline-dir` for one timeline per workload."""
+        buckets = list(self._buckets.values())
+        with open(path, "w") as fh:
+            for b in buckets:
+                fh.write(json.dumps(b, separators=(",", ":")) + "\n")
+        return len(buckets)

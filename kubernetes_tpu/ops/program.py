@@ -774,6 +774,126 @@ def score_probe(cfg: ScoreConfig, na: NodeArrays, carry: Carry,
                                 carry, table, tidx)
 
 
+# ---------------------------------------------------------------------------
+# cluster analytics: on-device state probe (ISSUE 13)
+#
+# The carry resident in HBM after every drain IS the cluster state — one
+# reduction over it yields the utilization/fragmentation/imbalance
+# signals ROADMAP items 2 and 3 consume, at zero extra h2d. Sampled per
+# drain by the scheduler, surfaced via /debug/cluster, the
+# scheduler_cluster_* gauge families, the flight recorder and the
+# telemetry timeline.
+#
+# Bit-parity contract (tests/test_cluster_probe.py holds this vs a numpy
+# oracle): every cross-node reduction is exact int64 arithmetic (masked
+# sums, scatter-adds of integers); floats appear only in elementwise
+# division/compare, sort, and gather — all deterministic between XLA and
+# numpy, so the probe is bit-reproducible.
+
+# per-resource stat columns of the probe's first output, in order
+PROBE_STATS = ("p50", "p90", "p99", "max", "mean", "frag", "stranded")
+# nearest-rank percentile ranks (idx = floor(q·(m-1) + 0.5) over the m
+# nodes advertising the resource)
+_PROBE_QS = (0.5, 0.9, 0.99)
+# a node whose bottleneck-resource utilization reaches this is "tight":
+# its remaining free capacity in OTHER resources counts as stranded
+PROBE_TIGHT = 0.95
+
+
+@functools.partial(jax.jit, static_argnames=("ndom",))
+def _cluster_probe_jit(na: NodeArrays, carry: Carry, dom, ndom: int):
+    f32, i64 = jnp.float32, jnp.int64
+    valid = na.valid
+    # a (node, resource) cell participates when the node is valid and
+    # advertises capacity for the resource
+    part = valid[:, None] & (na.cap > 0)                        # bool [N, R]
+    used = jnp.where(part, carry.used, 0).astype(i64)           # i64 [N, R]
+    cap = jnp.where(part, na.cap, 0).astype(i64)                # i64 [N, R]
+    util = jnp.where(part,
+                     used.astype(f32) / jnp.maximum(cap, 1).astype(f32),
+                     -1.0).astype(f32)                          # f32 [N, R]
+    m = jnp.sum(part, axis=0).astype(jnp.int32)                 # i32 [R]
+    n_total = util.shape[0]
+
+    # percentiles: non-participants sort to the front as -1, so the m
+    # participants occupy [N-m, N) of each sorted column — nearest-rank
+    # gather at N-m+idx. idx math in f64 (exact for these magnitudes) so
+    # the numpy oracle lands on the identical element.
+    srt = jnp.sort(util, axis=0)                                # f32 [N, R]
+    mf = m.astype(jnp.float64)
+    qcols = []
+    for q in _PROBE_QS + (1.0,):
+        idx = jnp.floor(q * (mf - 1.0) + 0.5).astype(jnp.int32)
+        at = jnp.clip(n_total - m + idx, 0, n_total - 1)
+        qcols.append(jnp.where(m > 0,
+                               jnp.take_along_axis(srt, at[None, :],
+                                                   axis=0)[0], 0.0))
+
+    # aggregate mean utilization: exact int64 sums, one float division
+    sum_used = jnp.sum(used, axis=0)                            # i64 [R]
+    sum_cap = jnp.sum(cap, axis=0)                              # i64 [R]
+    mean = jnp.where(sum_cap > 0,
+                     sum_used.astype(f32) / jnp.maximum(sum_cap, 1).astype(f32),
+                     0.0)
+
+    # fragmentation: 1 - (largest single free block / total free) — 0
+    # when one node could absorb the whole free pool, → 1 as the free
+    # capacity shatters into many small holes
+    free = cap - used                                           # i64 [N, R]
+    tot_free = jnp.sum(free, axis=0)                            # i64 [R]
+    max_free = jnp.max(free, axis=0)                            # i64 [R]
+    frag = jnp.where(tot_free > 0,
+                     1.0 - max_free.astype(f32) /
+                     jnp.maximum(tot_free, 1).astype(f32), 0.0)
+
+    # stranded capacity: free units sitting on nodes whose bottleneck
+    # resource is already ≥ PROBE_TIGHT utilized — capacity that exists
+    # but cannot host a balanced pod
+    bottleneck = jnp.max(jnp.where(part, util, 0.0), axis=1)    # f32 [N]
+    tight = valid & (bottleneck >= PROBE_TIGHT)                 # bool [N]
+    stranded_free = jnp.sum(jnp.where(tight[:, None], free, 0), axis=0)
+    stranded = jnp.where(tot_free > 0,
+                         stranded_free.astype(f32) /
+                         jnp.maximum(tot_free, 1).astype(f32), 0.0)
+
+    per_res = jnp.stack(qcols + [mean, frag, stranded], axis=1)  # f32 [R, 7]
+
+    # topology-domain imbalance over the gang engine's Tesserae dom-id
+    # column: per-domain pod density (pods per valid node), exact int64
+    # scatter-adds; spread = max - min over populated domains
+    dclip = jnp.clip(dom.astype(jnp.int32), 0, ndom - 1)
+    dom_pods = jnp.zeros((ndom,), i64).at[dclip].add(
+        jnp.where(valid, carry.npods, 0).astype(i64))
+    dom_nodes = jnp.zeros((ndom,), i64).at[dclip].add(valid.astype(i64))
+    has = dom_nodes > 0
+    load = jnp.where(has,
+                     dom_pods.astype(f32) /
+                     jnp.maximum(dom_nodes, 1).astype(f32), 0.0)
+    any_dom = jnp.any(has)
+    dmax = jnp.max(jnp.where(has, load, -jnp.inf))
+    dmin = jnp.min(jnp.where(has, load, jnp.inf))
+    dom_stats = jnp.stack([
+        jnp.sum(has).astype(f32),
+        jnp.where(any_dom, dmax, 0.0).astype(f32),
+        jnp.where(any_dom, dmin, 0.0).astype(f32),
+        jnp.where(any_dom, dmax - dmin, 0.0).astype(f32),
+    ])                                                          # f32 [4]
+    return per_res, dom_stats, jnp.sum(valid).astype(jnp.int32)
+
+
+def cluster_probe(na: NodeArrays, carry: Carry, dom, ndom: int):
+    """On-device cluster-state reduction over the resident carry:
+    (per_res f32 [R, 7] — PROBE_STATS columns per resource, dom_stats
+    f32 [4] — (populated domains, max, min, spread) of per-domain pod
+    density, valid_count i32). `dom` is the gang engine's topology
+    dom-id column (i32 [N]), `ndom` its static domain count (jit cache
+    key — stable per cluster topology). Deliberately NON-donating: the
+    carry stays resident for the next drain; the probe only reads it."""
+    na, carry, dom = RAILS.stage((na, carry, dom))
+    return LEDGER.measured_call("cluster_probe", _cluster_probe_jit, na,
+                                carry, dom, ndom)
+
+
 def _apply_assignment(carry: Carry, pod: PodRow, best: jnp.ndarray,
                       assigned: jnp.ndarray) -> Carry:
     onehot = (jnp.arange(carry.npods.shape[0], dtype=jnp.int32) == best) & assigned

@@ -467,6 +467,10 @@ class WorkloadRunner:
                 m.attempt_duration.quantile(0.50) * 1e3, 3),
             "attempt_p99_ms": round(
                 m.attempt_duration.quantile(0.99) * 1e3, 3),
+            # queue→bind e2e percentiles from the SLI histogram (all
+            # attempt-count series merged) — the bench_compare e2e gate
+            "e2e_p50_ms": round(m.sli_duration.quantile(0.50) * 1e3, 3),
+            "e2e_p99_ms": round(m.sli_duration.quantile(0.99) * 1e3, 3),
         }
         waves = m.wave_placement_waves.value()
         if waves:
@@ -505,14 +509,17 @@ def run_config(path: str, case_filter: str = "", workload_filter: str = "",
                verbose: bool = False, scheduler_factory=None,
                metrics_path: str = "",
                trace_dir: str = "",
-               profile_dir: str = "") -> list[tuple[DataItem, float]]:
+               profile_dir: str = "",
+               timeline_dir: str = "") -> list[tuple[DataItem, float]]:
     """Run matching (case, workload) pairs; returns [(item, threshold)].
     `metrics_path` appends each run's Prometheus exposition (the reference
     benchmark collects /metrics the same way, scheduler_perf/util.go);
     `trace_dir` writes one Chrome-trace JSON of the run's span trees per
     workload (loadable at chrome://tracing / ui.perfetto.dev);
     `profile_dir` writes one collapsed-stack host profile per workload
-    (flamegraph.pl / speedscope.app ingest it directly)."""
+    (flamegraph.pl / speedscope.app ingest it directly);
+    `timeline_dir` writes one JSON-lines telemetry timeline per workload
+    (obs/timeline.py: per-second aggregates over all SLIs + probe)."""
     out = []
     for tc in load_test_cases(path):
         if case_filter and case_filter != tc.name:
@@ -543,4 +550,12 @@ def run_config(path: str, case_filter: str = "", workload_filter: str = "",
                 n = prof.write_collapsed(dest)
                 if verbose:
                     print(f"  profile: {dest} ({n} stacks)")
+            tl = getattr(runner.last_scheduler, "timeline", None)
+            if timeline_dir and tl is not None:
+                os.makedirs(timeline_dir, exist_ok=True)
+                dest = os.path.join(timeline_dir,
+                                    f"{tc.name}_{wl.name}.timeline.jsonl")
+                n = tl.to_jsonl(dest)
+                if verbose:
+                    print(f"  timeline: {dest} ({n} buckets)")
     return out

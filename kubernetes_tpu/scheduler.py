@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
-from .backend.apiserver import APIServer, WatchHandlers
+from .backend.apiserver import APIServer, FencedWrite, WatchHandlers
 from .backend.cache import Cache, Snapshot
 from .backend.dispatcher import APICall, APIDispatcher, CallType
 from .backend.queue import ClusterEventWithHint, SchedulingQueue
@@ -41,8 +41,12 @@ from .framework.interface import Code, CycleState, Status
 from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
-from .ops.program import (PodXs, ScoreConfig, WaveXs, initial_carry,
-                          run_batch, run_plan, run_uniform, run_wave,
+from .obs.journey import (EV_ASSIGN as _EV_ASSIGN, EV_DRAIN as _EV_DRAIN,
+                          EV_FIT_ERROR as _EV_FIT_ERROR,
+                          EV_REQUEUE as _EV_REQUEUE)
+from .ops.program import (PROBE_STATS, PodXs, ScoreConfig, WaveXs,
+                          cluster_probe, initial_carry, run_batch,
+                          run_plan, run_uniform, run_wave,
                           table_from_batch)
 from .plugins import noderesources as nr
 from .plugins.node_basics import (NodeName, NodePorts, NodeUnschedulable,
@@ -246,6 +250,10 @@ class _PendingDrain:
     # shadow-oracle audit record captured for this drain (obs/audit.py);
     # None = unsampled. Submitted with the committed decisions.
     audit: object = None
+    # in-flight cluster_probe result (device arrays, ClusterStateProbe
+    # gate): dispatched right after the drain over the post-drain carry,
+    # resolved to a snapshot dict when this drain commits
+    probe: object = None
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -430,6 +438,37 @@ class Scheduler:
             objectives=(config.slo_objectives if config is not None
                         else None))
         self.metrics.slo_burn_rate.callback = self.slo.gauge_callback
+        # pod-journey tracing (obs/journey.py, `PodJourneyTracing` gate):
+        # the columnar lifecycle ring behind /debug/pod and the
+        # e2e_segment families. The ledger also OWNS the first-enqueue
+        # e2e SLI clock, which stays on even with the gate off (the
+        # requeue-restarts-the-clock bugfix must hold regardless), so
+        # the ledger object always exists.
+        from .obs.journey import JourneyLedger
+        from .obs.timeline import Timeline
+        self.journey = JourneyLedger(
+            clock=clock, metrics=self.metrics,
+            enabled=self.feature_gates.enabled("PodJourneyTracing"))
+        self.queue.journey = self.journey
+        self.dispatcher.journey = self.journey
+        # per-second telemetry timeline (obs/timeline.py,
+        # `TelemetryTimeline` gate): /debug/timeline + the config-gated
+        # JSON-lines exporter; SLO samples stamp each closing bucket
+        self.timeline = Timeline(
+            horizon=(config.timeline_horizon_seconds
+                     if config is not None else 900),
+            clock=clock,
+            export_path=(config.timeline_export_path
+                         if config is not None else ""),
+            slo_sample=self._timeline_slo_sample,
+            enabled=self.feature_gates.enabled("TelemetryTimeline"))
+        self.journey.timeline = self.timeline
+        # on-device cluster analytics (ops cluster_probe,
+        # `ClusterStateProbe` gate): one reduction over the resident
+        # carry per device drain; resolved async at commit
+        self._probe_enabled = self.feature_gates.enabled(
+            "ClusterStateProbe")
+        self._last_probe = None      # latest resolved snapshot (dict)
         # external-mutation counter: bumped with every device-state
         # invalidation; the shadow audit compares it across a drain's
         # dispatch→commit window (reason diffs are only valid when the
@@ -505,6 +544,7 @@ class Scheduler:
         self.gang_contiguity_weight = 0
         self._gang_dom = None        # device i32[N] node→domain ids
         self._gang_dom_key = (-1, -1)  # (staging_gen, node bucket) it fits
+        self._gang_ndom = 1          # static domain count (probe jit key)
         # first-gated time per workload ref → gang_quorum_wait_seconds
         self._gang_gated_since: dict[str, float] = {}
         # HA role lifecycle (ha/standby.py, ActiveStandbyHA gate):
@@ -900,6 +940,7 @@ class Scheduler:
                 self._bind_errors.pop(new.uid, None)
                 self.cache.add_pod(new)
                 self.queue.delete(new)
+                self._journey_confirm([new.uid])
                 self.queue.move_all_to_active_or_backoff_queue(
                     EVENT_ASSIGNED_POD_ADD, old, new)
         elif self._responsible(new):
@@ -944,6 +985,7 @@ class Scheduler:
                 q.delete(new)
         if confirm:
             self.cache.confirm_bound(confirm)
+            self._journey_confirm([p.uid for p in confirm])
             # EVENT_ASSIGNED_POD_ADD move sweep: with no unschedulable
             # pods and no in-flight event log (checked above) the per-pod
             # move_all calls are no-ops — elided wholesale
@@ -953,6 +995,7 @@ class Scheduler:
         if pod.uid in self._waiting_pods:
             self._reject_waiting(pod.uid, "pod deleted")
         self._bind_errors.pop(pod.uid, None)
+        self.journey.forget(pod.uid)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
             self._invalidate_device_state()
@@ -1027,12 +1070,92 @@ class Scheduler:
         live = self.queue.gated_refs()
         for ref in list(self._gang_gated_since):
             if ref not in live:
-                wait = max(self.clock() - self._gang_gated_since.pop(ref),
-                           0.0)
+                now = self.clock()
+                wait = max(now - self._gang_gated_since.pop(ref), 0.0)
                 self.metrics.gang_quorum_wait.observe(wait)
+                self.metrics.e2e_segment.observe(wait, "gate_wait")
+                self.timeline.segment(now, "gate_wait", wait, 1)
                 bad = wait > self.slo.threshold("gang_quorum_wait")
                 self.slo.observe("gang_quorum_wait",
                                  good=0 if bad else 1, bad=1 if bad else 0)
+
+    # -- journey / timeline plumbing (obs/journey.py, ISSUE 13) ---------------
+
+    def _journey_confirm(self, uids: list) -> None:
+        """Bind-echo confirms: the journey's bind_confirm transition, the
+        commit_backlog segment (dispatcher enqueue → echo), and the
+        per-pod clock cleanup."""
+        now = self.clock()
+        waits = self.journey.bind_confirmed(uids, now)
+        if waits:
+            self.metrics.e2e_segment.observe_array(waits, "commit_backlog")
+            self.timeline.segment(now, "commit_backlog", sum(waits),
+                                  len(waits))
+        # the timeline's binds cell counts CONFIRMED binds (the watch
+        # echo), not drain assignments — a bind-error retry must not
+        # double-count the pod
+        self.timeline.bump(now, "binds", len(uids))
+
+    def _journey_requeue(self, uids: list, cause: str,
+                         detail: str = "") -> None:
+        """A pod (or batch) re-entered the queue: requeue transition with
+        its cause + the requeue counter + the timeline sample."""
+        if not uids:
+            return
+        now = self.clock()
+        self.metrics.pod_requeues.inc(cause, by=len(uids))
+        self.timeline.requeue(now, cause, by=len(uids))
+        # the transition detail always LEADS with the cause so a
+        # /debug/pod timeline names it even when an error string rides
+        # along ("fence_unwind: write fenced: ...")
+        self.journey.record_bulk(uids, _EV_REQUEUE, now,
+                                 detail=f"{cause}: {detail}" if detail
+                                 else cause)
+
+    def _timeline_slo_sample(self) -> dict:
+        """Compact SLO sample stamped onto each closing timeline bucket:
+        only the nonzero burn rates, keyed sli:window."""
+        return {f"{sli}:{window}": round(rate, 4)
+                for (sli, window), rate in
+                self.slo.gauge_callback().items() if rate}
+
+    def _resolve_probe(self, pd: "_PendingDrain") -> dict:
+        """Resolve a drain's in-flight cluster_probe device result into
+        the snapshot dict served at /debug/cluster, and publish it to the
+        scheduler_cluster_* gauge families. A failed readback drops the
+        sample (the commit itself never aborts on probe faults)."""
+        if pd.probe is None:
+            return {}
+        from .metrics import (CLUSTER_DOM_STATS, CLUSTER_FRAG_KINDS,
+                              CLUSTER_UTIL_STATS)
+        try:
+            per_res = np.asarray(pd.probe[0])
+            dom = np.asarray(pd.probe[1])
+            valid = int(np.asarray(pd.probe[2]))
+        except Exception as e:
+            klog.v(2).info("cluster probe readback failed", err=str(e))
+            return {}
+        rnames = self.state.rtable.names
+        resources: dict = {}
+        for r in range(min(len(rnames), per_res.shape[0])):
+            row = per_res[r]
+            resources[rnames[r]] = {
+                stat: round(float(row[i]), 6)
+                for i, stat in enumerate(PROBE_STATS)}
+            for i, stat in enumerate(CLUSTER_UTIL_STATS):
+                self.metrics.cluster_utilization.set(
+                    float(row[i]), rnames[r], stat)
+            for i, kind in enumerate(CLUSTER_FRAG_KINDS):
+                self.metrics.cluster_fragmentation.set(
+                    float(row[len(CLUSTER_UTIL_STATS) + i]),
+                    rnames[r], kind)
+        domains = {stat: round(float(dom[i]), 6)
+                   for i, stat in enumerate(CLUSTER_DOM_STATS)}
+        for i, stat in enumerate(CLUSTER_DOM_STATS):
+            self.metrics.cluster_domain_imbalance.set(float(dom[i]), stat)
+        return {"t": round(self.clock(), 6), "drainId": pd.drain_id,
+                "validNodes": valid, "resources": resources,
+                "domains": domains}
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -1287,6 +1410,13 @@ class Scheduler:
                 breaker_open=True, consecutive_faults=self._device_faults,
                 fallback="circuit_open", drain_id=did)
             self._drain_pending()
+            # journey: the drain re-routed to the host oracle — the pods'
+            # device attempt is abandoned, not retried
+            self._journey_requeue([q.pod.uid for q in qpis],
+                                  "breaker_fallback")
+            self.journey.record_bulk([q.pod.uid for q in qpis], _EV_DRAIN,
+                                     self.clock(), detail="breaker_host",
+                                     drain=did)
             return sum(1 if self._schedule_one_host(q) else 0 for q in qpis)
 
         with log_context(drain=did):
@@ -1573,11 +1703,23 @@ class Scheduler:
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
+        probe = None
+        if self._probe_enabled:
+            # on-device cluster analytics over the post-drain carry: every
+            # input (na, carry, dom) is already device-resident, so the
+            # probe costs zero extra h2d; the result rides the drain's
+            # async copy window and resolves at commit
+            with self.tracer.span("cluster_probe", drain=did):
+                dom = self._gang_domains(na, need=True)
+                probe = cluster_probe(na, carry, dom, self._gang_ndom)
+        self.journey.record_bulk([q.pod.uid for q in qpis], _EV_DRAIN,
+                                 self.clock(), detail="device", drain=did)
         self._pending.append(_PendingDrain(
             qpis=qpis, profile=profile, batch=segment_batch, table=table,
             na=na, n=n, groups_needed=groups_needed, records=records,
             dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did,
-            gang=gang, facts=self.builder.row_facts, audit=audit_rec))
+            gang=gang, facts=self.builder.row_facts, audit=audit_rec,
+            probe=probe))
         return 0
 
     @contextmanager
@@ -1866,6 +2008,9 @@ class Scheduler:
                 dom[idx] = ids.setdefault(zone, len(ids))
         self._gang_dom = jnp.asarray(dom)
         self._gang_dom_key = key
+        # static domain count for the cluster_probe jit cache key: stable
+        # per topology (changes only when the id mapping is rebuilt)
+        self._gang_ndom = int(dom.max()) + 1 if N else 1
         return self._gang_dom
 
     def _gang_dispatch(self, cfg: ScoreConfig, na, carry, batch, i: int,
@@ -2111,6 +2256,11 @@ class Scheduler:
         self.cache = Cache(clock=self.clock)
         self.snapshot = Snapshot()
         self.queue = SchedulingQueue(**self._queue_kwargs)
+        # re-attach the journey ledger BEFORE any add_bulk below: the
+        # rebuilt queue mints fresh QueuedPodInfos, and add/add_bulk
+        # restore each known pod's first-enqueue e2e clock from the
+        # ledger (the SLI must not restart at a watch-loss resync)
+        self.queue.journey = self.journey
         self.workload_manager = WorkloadManager(clock=self.clock)
         self._gang_gated_since.clear()
         from .backend.debugger import CacheDebugger
@@ -2151,6 +2301,13 @@ class Scheduler:
                 unbound_pods.append(pod)
         self.cache.add_pods(bound_pods)
         if unbound_pods:
+            # journey: every unbound pod re-enters the queue because of
+            # the resync itself — record the cause before add_bulk so
+            # the requeue precedes the (re-)enqueue in the timeline
+            self._journey_requeue(
+                [p.uid for p in unbound_pods if
+                 self.journey.e2e_start(p.uid) is not None],
+                "resync")
             n_gated = self.queue.add_bulk(unbound_pods)
             self.metrics.queue_incoming_pods.inc(
                 "active", "PodAdd", by=len(unbound_pods) - n_gated)
@@ -2447,6 +2604,19 @@ class Scheduler:
                 bad_e += 1
         slo.observe("e2e_latency", good=n - bad_e, bad=bad_e)
         slo.observe("device_fallback", good=1)
+        # journey: the drain segment is the dispatch→commit wall window,
+        # shared by every pod in the drain (the device solves them as one
+        # batch); plus the per-second timeline counters and the resolved
+        # cluster-probe sample
+        window = per_pod * max(n, 1)
+        self.metrics.e2e_segment.observe_array([window] * n, "drain")
+        self.timeline.segment(now, "drain", window * n, n)
+        self.timeline.bump(now, "failures", len(failures))
+        self.timeline.bump(now, "drains", 1)
+        probe_snap = self._resolve_probe(pd)
+        if probe_snap:
+            self._last_probe = probe_snap
+            self.timeline.probe(now, probe_snap)
         hot: tuple = ()
         if self.profiler is not None:
             total_s = sum(pd.phases.values())
@@ -2467,7 +2637,7 @@ class Scheduler:
             fallback="" if pd.records else "host_greedy",
             events={"Scheduled": bound,
                     "FailedScheduling": len(failures)},
-            drain_id=pd.drain_id, hot_frames=hot)
+            drain_id=pd.drain_id, hot_frames=hot, probe=probe_snap)
         if pd.audit is not None:
             # hand the committed decisions to the shadow-audit worker;
             # the replay + diff run off the hot path
@@ -2556,7 +2726,8 @@ class Scheduler:
             err = FitError(qpi.pod, n_nodes)
             err.diagnosis = Diagnosis(unschedulable_plugins=set(plugins),
                                       pre_filter_msg=msg)
-            self._handle_failure(qpi, err, try_preempt=False)
+            self._handle_failure(qpi, err, try_preempt=False,
+                                 requeue_cause="gang_split")
 
     def _fast_commit(self, pairs: list, profile: Profile) -> int:
         """Vectorized commit for hook-free pods: the per-pod work of
@@ -2602,6 +2773,9 @@ class Scheduler:
             qpi.consecutive_errors_count = 0
         if not in_flight:
             self.queue.in_flight_events.clear()
+        self.journey.record_bulk(
+            [pod.uid for _assumed, pod in bound_pods], _EV_ASSIGN, now,
+            detail=[assumed.spec.node_name for assumed, _pod in bound_pods])
         self.dispatcher.add_binds(bound_pods)
         # Scheduled events, bulk + lazy-formatted (pod.uid is already the
         # "ns/name" object ref — no per-pod string building here)
@@ -3071,6 +3245,8 @@ class Scheduler:
                                         node_name=node_name))
         self.scheduled_count += 1
         self.events.scheduled(pod.uid, node_name)
+        self.journey.record(pod.uid, _EV_ASSIGN, self.clock(),
+                            detail=node_name)
         from .metrics import SCHEDULED
         self.metrics.schedule_attempts.inc(
             SCHEDULED, pod.spec.scheduler_name)
@@ -3125,9 +3301,18 @@ class Scheduler:
         fresh = pod.with_node_name("")
         errors = self._bind_errors.get(pod.uid, 0) + 1
         self._bind_errors[pod.uid] = errors
+        # the fresh QueuedPodInfo must NOT restart the queue→bind e2e SLI
+        # clock: the journey ledger holds the pod's first-enqueue time
+        # across the unwind (None = never seen, falls back to timestamp)
         qpi = QueuedPodInfo(pod_info=PodInfo.of(fresh),
                             timestamp=self.clock(),
+                            initial_attempt_timestamp=self.journey.e2e_start(
+                                pod.uid),
                             consecutive_errors_count=errors)
+        self._journey_requeue(
+            [pod.uid],
+            "fence_unwind" if isinstance(err, FencedWrite) else "bind_error",
+            detail=str(err)[:120])
         self.queue.add_unschedulable_if_not_present(qpi)
         self.queue.move_all_to_active_or_backoff_queue(
             EVENT_ASSIGNED_POD_DELETE, pod, None)
@@ -3136,17 +3321,22 @@ class Scheduler:
 
     def _handle_failure(self, qpi: QueuedPodInfo, err: FitError,
                         state: Optional[CycleState] = None,
-                        try_preempt: bool = True) -> None:
+                        try_preempt: bool = True,
+                        requeue_cause: str = "") -> None:
         """schedule_one.go:1038 handleSchedulingFailure. A genuine
         scheduling FitError runs the PostFilter (preemption) path first —
         reserve/permit failures pass try_preempt=False, matching the
         reference where PostFilter only follows schedulePod failures
-        (schedule_one.go:150-170)."""
+        (schedule_one.go:150-170). `requeue_cause` overrides the journey
+        requeue cause (gang unwinds pass "gang_split"); otherwise the
+        cause is "preemption" when this failure nominated a node, else
+        "unschedulable"."""
         self.unschedulable_count += 1
         qpi.unschedulable_plugins = set(err.diagnosis.unschedulable_plugins)
         qpi.pending_plugins = set(err.diagnosis.pending_plugins)
         pod = qpi.pod
         nominated = pod.status.nominated_node_name
+        preempted = False
         profile = self.profiles.get(pod.spec.scheduler_name)
         if (try_preempt and err.num_all_nodes > 0 and profile is not None
                 and profile.framework.post_filter_plugins):
@@ -3165,6 +3355,7 @@ class Scheduler:
                 pod.status.nominated_node_name = nominated
                 self.queue.nominator.add(qpi, nominated)
                 self.preemption_attempts += 1
+                preempted = True
                 self.metrics.preemption_attempts.inc()
                 klog.v(2).info("preemption nominated node", pod=pod.uid,
                                node=nominated)
@@ -3182,6 +3373,17 @@ class Scheduler:
                           msg)
         for plugin, count in err.diagnosis.plugin_node_counts().items():
             self.metrics.unschedulable_nodes.observe(count, plugin)
+        # journey: the FitError transition (detail = rejector plugins)
+        # then the requeue with its cause — the pair /debug/pod renders
+        # as "why it failed" + "why it's back in the queue"
+        self.journey.record(
+            pod.uid, _EV_FIT_ERROR, self.clock(),
+            detail=",".join(sorted(qpi.unschedulable_plugins or ())))
+        self._journey_requeue(
+            [pod.uid],
+            requeue_cause or ("preemption" if preempted
+                              else "unschedulable"),
+            detail=nominated or "")
         self.queue.add_unschedulable_if_not_present(qpi)
         self.dispatcher.add(APICall(
             CallType.STATUS_PATCH, qpi.pod,

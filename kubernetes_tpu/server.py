@@ -23,9 +23,15 @@ in-process model:
   drain ledger: recent audits, divergence diffs, chain validity),
   /debug/explain?pod=<ns/name>&k=N (per-bind plugin-level score
   decomposition — exact replay when the drain is in the audit ledger)
-  /debug/slo (per-SLI multi-window burn rates + breaches) and /debug/ha
+  /debug/slo (per-SLI multi-window burn rates + breaches), /debug/ha
   (HA role, lease + fencing token, ledger-tail cursor/lag, takeover
-  count and last failover seconds).
+  count and last failover seconds), /debug/pod?uid=<ns/name> (the
+  journey ledger's full causal timeline for one pod: every transition
+  with timestamps + the per-segment e2e decomposition),
+  /debug/cluster (the latest resolved cluster_probe snapshot:
+  utilization percentiles, fragmentation/stranded indices, domain
+  imbalance) and /debug/timeline?seconds=N (the per-second aggregate
+  telemetry ring over all SLIs + probe outputs).
 - Leader election moved to `kubernetes_tpu/ha/` (ISSUE 12): the Lease
   object lives in the API server (backend/apiserver.py, with generation
   fencing tokens), `LeaderElector` in ha/lease.py (renew deadlines,
@@ -190,6 +196,35 @@ class SchedulerServer:
                         }
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+                elif self.path.startswith("/debug/pod"):
+                    q = self._query()
+                    uid = q.get("uid", "") or q.get("pod", "")
+                    if not uid:
+                        self._send(400, "missing ?uid=<namespace/name>")
+                        return
+                    journey = outer.scheduler.journey
+                    if not journey.enabled:
+                        self._send(404, "journey tracing off "
+                                        "(PodJourneyTracing gate)")
+                        return
+                    out = journey.pod(uid)
+                    code = (200 if out["transitions"]
+                            or out["firstEnqueue"] is not None else 404)
+                    self._send(code, json.dumps(out, indent=2),
+                               "application/json")
+                elif self.path.startswith("/debug/cluster"):
+                    sched = outer.scheduler
+                    self._send(200, json.dumps({
+                        "probe": sched._last_probe,
+                        "probeEnabled": sched._probe_enabled,
+                        "journey": sched.journey.stats(),
+                    }, indent=2), "application/json")
+                elif self.path.startswith("/debug/timeline"):
+                    q = self._query()
+                    self._send(200, json.dumps(
+                        outer.scheduler.timeline.series(
+                            seconds=int(q.get("seconds", "60"))),
+                        indent=2), "application/json")
                 elif self.path.startswith("/debug/slo"):
                     self._send(200, json.dumps(
                         outer.scheduler.slo.snapshot(), indent=2),

@@ -165,6 +165,63 @@ class TestRealTrail:
         assert "SENTINEL: OK" in out.stdout
 
 
+class TestE2EGate:
+    """Queue→bind e2e latency gate (ISSUE 13): >25% e2e_p99_ms growth
+    trips the sentinel; the field is skipped when either side predates
+    it (0.0 — the seeded value before any observation)."""
+
+    def test_e2e_growth_beyond_gate_fails(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "e2e_p99_ms": 40.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "e2e_p99_ms": 52.0}}   # +30% > 25%
+        failures, _ = bench_compare.compare(base, new)
+        assert any("E2E LATENCY REGRESSION" in f for f in failures)
+
+    def test_e2e_growth_within_gate_passes(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "e2e_p99_ms": 40.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "e2e_p99_ms": 47.0}}   # +17.5%
+        failures, report = bench_compare.compare(base, new)
+        assert not failures
+        assert any("queue->bind e2e p99" in ln for ln in report)
+
+    def test_e2e_skipped_when_baseline_predates_field(self):
+        base = {"SchedulingBasic_x": {"pods_per_s": 100.0}}
+        new = {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                     "e2e_p99_ms": 500.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_cli_synthetic_e2e_regression_flips_exit_code(self, tmp_path):
+        """End-to-end self-test: a copied summary with e2e_p99_ms scaled
+        ×1.5 must trip the sentinel through the CLI, and the unscaled
+        pair must pass."""
+        base = {"summary": {"SchedulingBasic_X": {
+            "pods_per_s": 1000.0, "p50": 900, "p99": 1100,
+            "attempt_p50_ms": 1.0, "attempt_p99_ms": 2.0,
+            "e2e_p50_ms": 12.0, "e2e_p99_ms": 40.0}}}
+        bad_doc = copy.deepcopy(base)
+        bad_doc["summary"]["SchedulingBasic_X"]["e2e_p99_ms"] = 60.0
+        bp = tmp_path / "base.json"
+        gp = tmp_path / "good.json"
+        rp = tmp_path / "regressed.json"
+        bp.write_text(json.dumps(base))
+        gp.write_text(json.dumps(base))
+        rp.write_text(json.dumps(bad_doc))
+        ok = subprocess.run(
+            [sys.executable, TOOL, "--baseline", str(bp), "--new",
+             str(gp)], capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = subprocess.run(
+            [sys.executable, TOOL, "--baseline", str(bp), "--new",
+             str(rp)], capture_output=True, text=True)
+        assert bad.returncode == 2
+        assert "E2E LATENCY REGRESSION" in bad.stdout
+        assert "SENTINEL: FAIL" in bad.stdout
+
+
 class TestSLOGate:
     """--slo (ISSUE 10): burn-rate breaches and shadow-oracle divergence
     recorded in a bench summary fail the sentinel."""

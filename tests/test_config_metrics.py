@@ -331,6 +331,54 @@ class TestExpositionLint:
         assert ("scheduler_audit_replay_seconds_count" in series
                 and "scheduler_explain_seconds_count" in series)
 
+    def test_issue13_families_covered_by_lint(self):
+        """ISSUE 13 satellite: the journey/timeline/cluster-probe
+        families are registered AND pre-seeded with the EXACT label sets
+        the dashboards (and /debug surfaces) key on."""
+        from kubernetes_tpu.metrics import (CLUSTER_DOM_STATS,
+                                            CLUSTER_FRAG_KINDS,
+                                            CLUSTER_SEED_RESOURCES,
+                                            CLUSTER_UTIL_STATS)
+        from kubernetes_tpu.obs.journey import CAUSES, EVENTS, SEGMENTS
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_e2e_segment_seconds"] == "histogram"
+        assert types["scheduler_pod_requeues_total"] == "counter"
+        assert types["scheduler_journey_transitions_total"] == "counter"
+        assert types["scheduler_cluster_utilization_ratio"] == "gauge"
+        assert types["scheduler_cluster_fragmentation_index"] == "gauge"
+        assert types["scheduler_cluster_domain_imbalance"] == "gauge"
+        # the e2e decomposition's exact segment set
+        segments = {lbl["segment"] for lbl, _v in
+                    series["scheduler_e2e_segment_seconds_count"]}
+        assert segments == set(SEGMENTS)
+        assert set(SEGMENTS) == {"queue_wait", "gate_wait", "drain",
+                                 "commit_backlog"}
+        # the requeue-cause label set (every chaos path maps to one)
+        causes = {lbl["cause"] for lbl, _v in
+                  series["scheduler_pod_requeues_total"]}
+        assert causes == set(CAUSES)
+        assert set(CAUSES) == {"preemption", "fence_unwind",
+                               "breaker_fallback", "gang_split",
+                               "resync", "bind_error", "unschedulable"}
+        # every journey transition has a zero-seeded counter series
+        events = {lbl["event"] for lbl, _v in
+                  series["scheduler_journey_transitions_total"]}
+        assert events == set(EVENTS)
+        # cluster gauges: (resource, stat/kind) grid seeded for the
+        # well-known resources; the probe resolve extends it live
+        util = {(lbl["resource"], lbl["stat"]) for lbl, _v in
+                series["scheduler_cluster_utilization_ratio"]}
+        assert util >= {(r, s) for r in CLUSTER_SEED_RESOURCES
+                        for s in CLUSTER_UTIL_STATS}
+        frag = {(lbl["resource"], lbl["kind"]) for lbl, _v in
+                series["scheduler_cluster_fragmentation_index"]}
+        assert frag >= {(r, k) for r in CLUSTER_SEED_RESOURCES
+                        for k in CLUSTER_FRAG_KINDS}
+        dom = {lbl["stat"] for lbl, _v in
+               series["scheduler_cluster_domain_imbalance"]}
+        assert dom == set(CLUSTER_DOM_STATS)
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

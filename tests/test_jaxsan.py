@@ -357,7 +357,7 @@ class TestRepoGate:
         live = [f for f in findings if not f.waived]
         assert live == [], "\n" + "\n".join(f.format() for f in live)
 
-    def test_all_twelve_entries_have_jit_coverage(self):
+    def test_all_thirteen_entries_have_jit_coverage(self):
         an = JaxsanAnalyzer(REPO).load()
         an.run()
         assert an.check_entry_coverage() == []
@@ -367,7 +367,7 @@ class TestRepoGate:
                          "run_wave_scan", "run_plan", "wave_statics",
                          "diagnose_row", "dry_run_select_victims",
                          "run_batch_sharded", "run_gang", "scatter_rows",
-                         "explain_row"}
+                         "explain_row", "cluster_probe"}
 
     def test_threaded_subsystems_are_annotated(self):
         """The lock checker's input contract: the shared rings/queues of

@@ -1,0 +1,467 @@
+"""Pod-journey tracing + telemetry timeline (ISSUE 13).
+
+Gates this file establishes:
+
+- the e2e SLI clock bugfix: the queue→bind SLI clock starts at the
+  pod's FIRST enqueue and survives bind-error requeues and `resync()` —
+  the regressions that previously restarted it (a fresh QueuedPodInfo
+  minted `initial_attempt_timestamp=now`) now have standing tests;
+- journey ↔ EventRecorder causality: every Scheduled event has a
+  matching assign→bind_confirm journey, every FailedScheduling event a
+  fit_error→requeue journey, and per-pod transitions are causally
+  ordered (fuzzed over seeded workloads);
+- the ISSUE 13 acceptance line: a pod fence-unwound by a stale-
+  generation flush and re-bound under the new generation renders its
+  FULL lifecycle — including the `fence_unwind` requeue cause — through
+  `/debug/pod?uid=`, served over HTTP;
+- the timeline ring: per-second buckets, horizon eviction, SLO stamping
+  on close, `series()` and both exporters (streaming JSON-lines +
+  `to_jsonl`), and the `/debug/timeline` + `/debug/cluster` endpoints;
+- gate independence: `PodJourneyTracing=false` stops transition
+  recording but the e2e clock (and the SLI fix) stay on;
+- the ≤5% journey-overhead gate at 5k nodes (slow; the PR 5
+  profiler-gate shape).
+"""
+
+import json
+import random
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer, Conflict
+from kubernetes_tpu.config import KubeSchedulerConfiguration
+from kubernetes_tpu.events import REASON_FAILED_SCHEDULING, REASON_SCHEDULED
+from kubernetes_tpu.ha import LeaderElector, fence_dispatcher
+from kubernetes_tpu.obs.journey import CAUSES, EVENTS, SEGMENTS
+from kubernetes_tpu.obs.timeline import Timeline
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.server import SchedulerServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _no_sleep(sched):
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _nodes(api, n=6, cpu=16, mem="32Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _pod_specs(n, seed, prefix="p"):
+    rng = random.Random(seed)
+    return [(f"{prefix}{i}", 250 * rng.randint(1, 6), 512 * rng.randint(1, 4))
+            for i in range(n)]
+
+
+def _create(api, specs):
+    for name, cpu, mem in specs:
+        api.create_pod(make_pod(name)
+                       .req({"cpu": f"{cpu}m", "memory": f"{mem}Mi"}).obj())
+
+
+def _drive_to_quiescence(api, sched, clock, want_bound, max_rounds=60):
+    for _ in range(max_rounds):
+        sched.schedule_pending()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= want_bound:
+            return
+        clock.t += 10.0
+        sched.flush_queues()
+    raise AssertionError(f"did not quiesce: {want_bound} wanted, "
+                         f"pending={sched.pending_summary()}")
+
+
+def _events_of(journey, uid):
+    return [tr["event"] for tr in journey.pod(uid)["transitions"]]
+
+
+def _fail_binds_once(api, n_failures=1):
+    """Monkeypatch bind_all to terminally fail (Conflict) the first
+    `n_failures` flushes — the deterministic bind-error → requeue path."""
+    real = api.bind_all
+    state = {"left": n_failures}
+
+    def flaky(pairs, **kw):
+        if state["left"] > 0:
+            state["left"] -= 1
+            return [(pod, Conflict("injected bind conflict"))
+                    for pod, _orig in pairs]
+        return real(pairs, **kw)
+
+    api.bind_all = flaky
+    return state
+
+
+class TestE2EClock:
+    def test_bind_error_requeue_keeps_first_enqueue_clock(self):
+        """THE regression this PR fixes: a bind error mints a fresh
+        QueuedPodInfo — its SLI clock must still be the pod's FIRST
+        enqueue time, not the requeue time."""
+        api = APIServer()
+        clock = Clock(t=5.0)
+        sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+        _nodes(api, n=2)
+        api.create_pod(make_pod("w0").req(
+            {"cpu": "500m", "memory": "512Mi"}).obj())   # enqueued at t=5
+        _fail_binds_once(api)
+        clock.t = 17.0
+        sched.schedule_pending()                          # bind fails at 17
+        uid = next(iter(api.pods))
+        assert not api.pods[uid].spec.node_name
+        # the requeued QPI sits in backoff with the ORIGINAL clock
+        qpi = sched.queue.backoff_q._items[uid]
+        assert qpi.initial_attempt_timestamp == 5.0
+        assert sched.journey.e2e_start(uid) == 5.0
+        # journey renders the requeue with its cause
+        requeues = [tr for tr in sched.journey.pod(uid)["transitions"]
+                    if tr["event"] == "requeue"]
+        assert requeues and requeues[0]["detail"].startswith("bind_error")
+        clock.t = 80.0
+        sched.flush_queues()
+        sched.schedule_pending()                          # binds at 80
+        assert api.pods[uid].spec.node_name
+        # SLI observations: ~12s (first attempt) + ~75s (full span).
+        # A clock restarted at the requeue would observe ~63s instead.
+        total = sum(sched.metrics.sli_duration._sums.values())
+        assert total >= (17.0 - 5.0) + (80.0 - 5.0) - 1e-6
+        # confirm dropped the per-pod clocks
+        assert sched.journey.e2e_start(uid) is None
+
+    def test_resync_rebuild_keeps_first_enqueue_clock(self):
+        """resync() rebuilds the whole queue from a LIST: known unbound
+        pods keep their first-enqueue clock and get a `resync` requeue
+        transition; pods first discovered BY the LIST count as fresh
+        enqueues, not requeues."""
+        api = APIServer()
+        clock = Clock(t=5.0)
+        sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+        _nodes(api, n=2, cpu=2)
+        api.create_pod(make_pod("big").req(   # 3 cpu > any node: stranded
+            {"cpu": "3000m", "memory": "1Gi"}).obj())
+        clock.t = 9.0
+        sched.schedule_pending()
+        # a watch loss swallows `late`'s add event: the pod exists in the
+        # store but the scheduler first discovers it via resync's LIST
+        handlers, api.pod_handlers = api.pod_handlers, []
+        api.create_pod(make_pod("late").req(
+            {"cpu": "250m", "memory": "256Mi"}).obj())
+        api.pod_handlers = handlers
+        uid_big = "default/big"
+        uid_late = "default/late"
+        clock.t = 30.0
+        sched.resync()
+        for uid, t0 in ((uid_big, 5.0), (uid_late, 30.0)):
+            got = (sched.queue.active_q._items.get(uid)
+                   or sched.queue.backoff_q._items.get(uid)
+                   or sched.queue.unschedulable_pods.get(uid))
+            assert got is not None and got.initial_attempt_timestamp == t0
+        assert any(tr["event"] == "requeue" and tr["detail"] == "resync"
+                   for tr in sched.journey.pod(uid_big)["transitions"])
+        assert not any(tr["event"] == "requeue"
+                       for tr in sched.journey.pod(uid_late)["transitions"])
+        assert sched.metrics.pod_requeues.value("resync") == 1
+        # capacity finally shows up: both bind, and big's SLI spans from
+        # its t=5 FIRST enqueue, not the resync rebuild
+        clock.t = 35.0
+        api.create_node(make_node("roomy").capacity(
+            {"cpu": 8, "memory": "16Gi", "pods": 80}).obj())
+        _drive_to_quiescence(api, sched, clock, want_bound=2)
+        assert sum(sched.metrics.sli_duration._sums.values()) >= 30.0
+
+
+class TestJourneyVsEventRecorder:
+    def test_causality_fuzz_against_event_recorder(self):
+        """Every EventRecorder decision has a matching, causally-ordered
+        journey: Scheduled ⇒ pop ≤ assign ≤ bind_enqueue ≤ bind_flush ≤
+        bind_confirm; FailedScheduling ⇒ fit_error + an `unschedulable`
+        requeue. Fuzzed over seeded mixed workloads with stranded pods."""
+        for seed in (3, 11, 29):
+            api = APIServer()
+            clock = Clock(t=1.0)
+            sched = _no_sleep(Scheduler(api, batch_size=16, clock=clock))
+            _nodes(api, n=4, cpu=8, mem="16Gi")
+            _create(api, _pod_specs(18, seed=seed))
+            for i in range(4):   # oversize: can never fit → FailedScheduling
+                api.create_pod(make_pod(f"huge{i}").req(
+                    {"cpu": "64", "memory": "128Gi"}).obj())
+            sched.schedule_pending()
+            clock.t += 10.0
+            sched.flush_queues()
+            sched.schedule_pending()
+
+            scheduled = sched.events.events(reason=REASON_SCHEDULED)
+            failed = sched.events.events(reason=REASON_FAILED_SCHEDULING)
+            assert scheduled and failed
+            for ev in scheduled:
+                names = _events_of(sched.journey, ev.object_ref)
+                assert names[0] == "enqueue"
+                for a, b in (("pop", "assign"), ("assign", "bind_enqueue"),
+                             ("bind_enqueue", "bind_flush"),
+                             ("bind_flush", "bind_confirm")):
+                    assert names.index(a) < names.index(b), (
+                        f"{ev.object_ref}: {names}")
+            for ev in failed:
+                j = sched.journey.pod(ev.object_ref)
+                names = [tr["event"] for tr in j["transitions"]]
+                assert "fit_error" in names
+                causes = [tr["detail"].split(":")[0]
+                          for tr in j["transitions"]
+                          if tr["event"] == "requeue"]
+                assert causes and set(causes) <= set(CAUSES)
+            # transitions are append-ordered ⇒ per-pod timestamps are
+            # monotone; every event name is a known EVENTS member
+            for uid in api.pods:
+                trs = sched.journey.pod(uid)["transitions"]
+                ts = [tr["t"] for tr in trs]
+                assert ts == sorted(ts)
+                assert all(tr["event"] in EVENTS for tr in trs)
+            # e2e clocks live exactly for the pods still unbound
+            unbound = sum(1 for p in api.pods.values()
+                          if not p.spec.node_name)
+            assert sched.journey.stats()["trackedPods"] == unbound
+
+
+class TestDebugPodAcceptance:
+    def test_fence_unwound_rebound_pod_renders_full_lifecycle(self):
+        """ISSUE 13 acceptance: a pod assumed under generation 1 whose
+        delayed flush is fenced (the lease was stolen and re-acquired in
+        between) unwinds with a `fence_unwind` requeue, re-binds under
+        the new generation, and /debug/pod?uid= serves the whole causal
+        chain over HTTP."""
+        api = APIServer()
+        _nodes(api)
+        clock = Clock()
+        sched = _no_sleep(Scheduler(api, batch_size=32, clock=clock))
+        el = LeaderElector(api, "sched-a", clock=clock,
+                           metrics=sched.metrics)
+        fence_dispatcher(sched.dispatcher, el)
+        assert el.tick() is True                    # generation 1
+        sched.prime()
+        _create(api, _pod_specs(6, seed=100, prefix="w"))
+        # assume + enqueue WITHOUT flushing (the zombie's limbo window)
+        qpis = sched.queue.drain(32)
+        sched._schedule_batch(qpis)
+        sched._drain_pending()
+        assert len(sched.dispatcher) > 0
+        # a rival steals the expired lease (gen 2), then WE re-acquire
+        # (gen 3): same scheduler, two generations apart
+        rival = LeaderElector(api, "sched-b", clock=clock)
+        clock.t = 20.0
+        assert rival.tick() is True
+        clock.t = 40.0
+        assert el.tick() is True
+        assert el.fence_token() == 3
+        # the delayed flush carries generation 1 → fenced wholesale
+        sched.dispatcher.flush()
+        assert api.fenced_rejections > 0
+        assert all(not p.spec.node_name for p in api.pods.values())
+        assert sched.metrics.pod_requeues.value("fence_unwind") == 6
+        # re-bind under generation 3
+        _drive_to_quiescence(api, sched, clock, want_bound=6)
+
+        srv = SchedulerServer(sched).start()
+        try:
+            uid = "default/w0"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/pod?uid={uid}",
+                    timeout=5) as r:
+                assert r.status == 200
+                out = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        names = [tr["event"] for tr in out["transitions"]]
+        assert names[0] == "enqueue"
+        requeues = [tr for tr in out["transitions"]
+                    if tr["event"] == "requeue"]
+        assert len(requeues) == 1
+        assert requeues[0]["detail"].startswith("fence_unwind")
+        # the second bind attempt completes AFTER the unwind
+        assert names.index("bind_confirm") > names.index("requeue")
+        assert names.count("assign") == 2          # bound, unwound, re-bound
+        assert set(out["segments"]) == set(SEGMENTS)
+        assert out["segments"]["queue_wait"] >= 0.0
+
+    def test_debug_pod_param_and_error_paths(self):
+        api = APIServer()
+        sched = Scheduler(api, batch_size=8)
+        srv = SchedulerServer(sched).start()
+        try:
+            def get(path):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}{path}",
+                            timeout=5) as r:
+                        return r.status, r.read().decode()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read().decode()
+
+            assert get("/debug/pod")[0] == 400
+            assert get("/debug/pod?uid=default/ghost")[0] == 404
+            sched.journey.enabled = False
+            code, body = get("/debug/pod?uid=default/ghost")
+            assert code == 404 and "PodJourneyTracing" in body
+        finally:
+            srv.stop()
+
+
+class TestTimeline:
+    def test_buckets_series_and_horizon(self):
+        slo_calls = []
+        tl = Timeline(horizon=3, clock=lambda: 0.0,
+                      slo_sample=lambda: (slo_calls.append(1) or {"s": 1}))
+        tl.bump(1.2, "binds", 3)
+        tl.segment(1.5, "drain", 0.5, 2)
+        tl.segment(1.9, "drain", 0.3, 1)
+        tl.requeue(2.1, "resync")
+        tl.requeue(2.2, "resync")
+        s = tl.series(seconds=60)
+        assert s["segments"] == list(SEGMENTS)
+        assert [b["t"] for b in s["buckets"]] == [1, 2]
+        b1, b2 = s["buckets"]
+        assert b1["binds"] == 3 and b1["e2e"]["drain"] == [0.8, 3]
+        assert b2["requeues"] == {"resync": 2}
+        # closing bucket 1 stamped an SLO sample exactly once
+        assert b1["slo"] == {"s": 1} and len(slo_calls) == 1
+        # horizon eviction: only the newest `horizon` buckets survive
+        for sec in range(3, 9):
+            tl.bump(float(sec), "pops", 1)
+        assert len(tl.series(seconds=100)["buckets"]) <= 3
+
+    def test_jsonl_exporters(self, tmp_path):
+        stream = tmp_path / "stream.jsonl"
+        tl = Timeline(horizon=100, clock=lambda: 0.0,
+                      export_path=str(stream))
+        for sec in range(4):
+            tl.bump(float(sec), "binds", sec + 1)
+        lines = [json.loads(ln) for ln
+                 in stream.read_text().splitlines()]
+        assert [b["t"] for b in lines] == [0, 1, 2]   # closed buckets only
+        dump = tmp_path / "dump.jsonl"
+        assert tl.to_jsonl(str(dump)) == 4
+        assert len(dump.read_text().splitlines()) == 4
+        # a broken sink disables the exporter instead of spinning
+        tl2 = Timeline(horizon=10, clock=lambda: 0.0,
+                       export_path=str(tmp_path / "no" / "dir" / "x.jsonl"))
+        tl2.bump(0.0, "binds")
+        tl2.bump(1.0, "binds")
+        assert tl2.export_path == ""
+
+    def test_scheduler_timeline_and_cluster_endpoints(self):
+        api = APIServer()
+        clock = Clock(t=1.0)
+        sched = _no_sleep(Scheduler(api, batch_size=16, clock=clock))
+        _nodes(api, n=3)
+        _create(api, _pod_specs(8, seed=5))
+        sched.schedule_pending()
+        srv = SchedulerServer(sched).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/timeline?seconds=9",
+                    timeout=5) as r:
+                tl = json.loads(r.read().decode())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/cluster",
+                    timeout=5) as r:
+                cl = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        assert tl["buckets"] and tl["causes"] == list(CAUSES)
+        bucket = tl["buckets"][-1]
+        assert bucket["binds"] == 8 and bucket["pops"] >= 8
+        assert "queue_wait" in bucket["e2e"]
+        # the probe snapshot rode the drain and resolved at commit
+        assert cl["probeEnabled"] is True
+        probe = cl["probe"]
+        assert probe and probe["validNodes"] == 3
+        assert set(probe["resources"]["cpu"]) == {
+            "p50", "p90", "p99", "max", "mean", "frag", "stranded"}
+        assert probe["domains"]["domains"] >= 1.0
+        assert cl["journey"]["transitions"] > 0
+        assert bucket["probe"] == probe
+
+
+class TestFeatureGates:
+    def test_journey_gate_off_keeps_e2e_clock_on(self):
+        cfg = KubeSchedulerConfiguration(feature_gates={
+            "PodJourneyTracing": False, "ClusterStateProbe": False,
+            "TelemetryTimeline": False})
+        api = APIServer()
+        clock = Clock(t=5.0)
+        sched = _no_sleep(Scheduler(api, batch_size=8, clock=clock,
+                                    config=cfg))
+        _nodes(api, n=2)
+        api.create_pod(make_pod("w0").req(
+            {"cpu": "500m", "memory": "512Mi"}).obj())
+        _fail_binds_once(api)
+        clock.t = 17.0
+        sched.schedule_pending()
+        uid = next(iter(api.pods))
+        # no transitions recorded, no timeline buckets, no probe…
+        assert sched.journey.stats()["transitions"] == 0
+        assert not sched.timeline.series(seconds=60)["buckets"]
+        assert sched._last_probe is None
+        # …but the SLI bugfix holds regardless of the gate
+        assert (sched.queue.backoff_q._items[uid]
+                .initial_attempt_timestamp == 5.0)
+        clock.t = 80.0
+        sched.flush_queues()
+        sched.schedule_pending()
+        assert api.pods[uid].spec.node_name
+        total = sum(sched.metrics.sli_duration._sums.values())
+        assert total >= (17.0 - 5.0) + (80.0 - 5.0) - 1e-6
+
+
+@pytest.mark.slow
+class TestJourneyOverheadGate:
+    def test_overhead_within_5_percent_at_5k_nodes(self):
+        """ISSUE 13 acceptance: SchedulingBasic-shaped 5k-node drains
+        with PodJourneyTracing+TelemetryTimeline+ClusterStateProbe ON
+        stay within 5% of gates-OFF throughput (median of 3 measured
+        passes each, warm shapes — the PR 5 profiler-gate shape)."""
+
+        def _feed(api, n, start=0):
+            api.create_pods([make_pod(f"p{start + i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj() for i in range(n)])
+
+        def one_pass(gate_on):
+            cfg = KubeSchedulerConfiguration(feature_gates={
+                "PodJourneyTracing": gate_on,
+                "TelemetryTimeline": gate_on,
+                "ClusterStateProbe": gate_on})
+            api = APIServer()
+            sched = Scheduler(api, batch_size=8192, config=cfg)
+            for i in range(5000):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 32, "memory": "64Gi", "pods": 110}).obj())
+            sched.prime()
+            t0 = time.perf_counter()
+            created = 0
+            while created < 10000:
+                _feed(api, 512, start=created)
+                created += 512
+                sched.schedule_pending(wait=False)
+            sched.schedule_pending()
+            dt = time.perf_counter() - t0
+            assert sched.scheduled_count == created
+            return created / dt
+
+        one_pass(True)    # warm every executable outside the measurement
+        off = sorted(one_pass(False) for _ in range(3))[1]
+        on = sorted(one_pass(True) for _ in range(3))[1]
+        assert on >= 0.95 * off, (
+            f"journey overhead gate: on={on:.0f} off={off:.0f} pods/s "
+            f"({on / off - 1:+.1%})")

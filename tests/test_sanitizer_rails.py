@@ -226,7 +226,8 @@ class TestNanGuard:
 @pytest.mark.slow
 class TestRetraceBudgetRegression:
     """ISSUE satellite: the warm 2× re-run of EVERY bench workload must
-    mint zero fresh XLA executables across the nine JIT entry kernels —
+    mint zero fresh XLA executables across the thirteen JIT entry
+    kernels —
     the enforced (RetraceBudgetExceeded-raising) replacement for the
     ledger's single-cluster stability check in test_profiler.py."""
 
@@ -264,7 +265,7 @@ class TestRetraceBudgetRegression:
                 never_stable[case] = deltas
                 continue
             # the fixed point must HOLD: the next full re-run fits a zero
-            # retrace budget across all nine entry kernels (raises
+            # retrace budget across all thirteen entry kernels (raises
             # RetraceBudgetExceeded otherwise)
             with RAILS.retrace_budget(0, kernels=KERNELS):
                 run_config(cfg, case, small_wl)

@@ -20,6 +20,9 @@ and fails (exit 2) on:
     the BENCH history is ±20%, see NOISE);
   * attempt p99 latency growth >25% (when both sides carry the
     attempt_p99_ms extra; older BENCH files predate it and skip the check);
+  * queue→bind e2e p99 latency growth >25% (the e2e_p99_ms extra from
+    the sli_duration histogram, recorded since r13 — same
+    skip-when-absent rule);
   * with --slo: any burn-rate breach recorded in the candidate's per-
     workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
     nonzero shadow-oracle divergence — a bench run whose decisions
@@ -52,6 +55,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # default gates
 MAX_THROUGHPUT_DROP = 0.10     # fraction of baseline pods/s
 MAX_P99_GROWTH = 0.25          # fraction of baseline attempt_p99_ms
+# queue→bind e2e latency gate (ISSUE 13): same shape as the attempt-p99
+# gate, fed by the harness e2e_p99_ms extra (the sli_duration histogram's
+# p99). Skipped when either side predates the field.
+MAX_E2E_P99_GROWTH = 0.25
 # host-phase-share gate (ISSUE 9): host_share = (host_build + commit) /
 # drain cycle, recorded in the summary block since r08. A relative
 # regression beyond this fraction means Python is clawing back the cycle
@@ -133,6 +140,8 @@ def normalize(payload: dict) -> dict:
             "p50": float(d.get("p50", 0)), "p99": float(d.get("p99", 0)),
             "attempt_p50_ms": float(d.get("attempt_p50_ms", 0.0)),
             "attempt_p99_ms": float(d.get("attempt_p99_ms", 0.0)),
+            "e2e_p50_ms": float(d.get("e2e_p50_ms", 0.0)),
+            "e2e_p99_ms": float(d.get("e2e_p99_ms", 0.0)),
         }
 
     metric = bench.get("metric", "")
@@ -191,6 +200,16 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
                     f"({growth:+.1%}, gate +{MAX_P99_GROWTH:.0%})")
             if growth > MAX_P99_GROWTH:
                 failures.append(f"P99 LATENCY REGRESSION {line}")
+            report.append(line)
+        b_e2e = float(b.get("e2e_p99_ms") or 0.0)
+        n_e2e = float(n.get("e2e_p99_ms") or 0.0)
+        if b_e2e > 0 and n_e2e > 0:
+            growth = n_e2e / b_e2e - 1.0
+            line = (f"{w}: queue->bind e2e p99 {b_e2e:.1f} -> "
+                    f"{n_e2e:.1f} ms "
+                    f"({growth:+.1%}, gate +{MAX_E2E_P99_GROWTH:.0%})")
+            if growth > MAX_E2E_P99_GROWTH:
+                failures.append(f"E2E LATENCY REGRESSION {line}")
             report.append(line)
         b_hs = float(b.get("host_share") or 0.0)
         n_hs = float(n.get("host_share") or 0.0)
