@@ -380,6 +380,11 @@ def main() -> None:
             # breaches + audit divergence count — what bench_compare's
             # --slo gate reads (fail on breach or nonzero divergence)
             "slo": entry.get("slo", {}),
+            # per-kernel device-time breakdown (ISSUE 14, kernel
+            # observatory delta over the median pass): seconds + p50/p99
+            # per JIT entry — what bench_compare's per-kernel p99 gate
+            # reads, and the named decomposition of device_s above
+            "kernels": entry.get("kernels", {}),
         }
 
     head_key = next(iter(results))

@@ -117,6 +117,13 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # /debug/timeline + the config-gated JSON-lines exporter
     # (timeline_export_path) + bench --timeline-dir.
     "TelemetryTimeline": FeatureSpec(True, BETA),
+    # kernel observatory (perf/observatory.py): per-dispatch device-time
+    # attribution — run-wall histograms keyed (kernel, plan/shape,
+    # backend), the per-drain device lane in the flight recorder and
+    # Chrome trace, the sharded-lane profile, /debug/kernels and the
+    # scheduler_kernel_*/scheduler_shard_* metric families. Process-
+    # global like the compile ledger it extends.
+    "KernelObservatory": FeatureSpec(True, BETA),
 }
 
 

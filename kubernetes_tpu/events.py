@@ -221,6 +221,11 @@ class FlightRecord:
     # _resolve_probe): utilization percentiles / fragmentation / domain
     # imbalance over the post-drain carry. {} = probe off or dropped.
     probe: dict = field(default_factory=dict)
+    # per-kernel dispatch seconds inside this drain's device span
+    # (perf/observatory.py device lane); {} = KernelObservatory off or
+    # host-path drain. Sums to ≤ phases["device_dispatch"] — the named
+    # decomposition of the device phase wall.
+    kernels: dict = field(default_factory=dict)
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -238,7 +243,9 @@ class FlightRecord:
                 "drainId": self.drain_id,
                 "hotFrames": list(self.hot_frames),
                 "audit": dict(self.audit),
-                "probe": dict(self.probe)}
+                "probe": dict(self.probe),
+                "kernels": {k: round(v, 6)
+                            for k, v in self.kernels.items()}}
 
 
 class FlightRecorder:

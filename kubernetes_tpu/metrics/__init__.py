@@ -256,6 +256,10 @@ CLUSTER_DOM_STATS = ("domains", "max", "min", "spread")
 # before the first probe; the live probe adds the rtable's real set
 CLUSTER_SEED_RESOURCES = ("cpu", "memory")
 
+# shard-lane gauge labels pre-seeded at construction (one TPU host's
+# worth of lanes); a live profile on a wider mesh adds its real set
+SHARD_SEED_LANES = tuple(str(i) for i in range(8))
+
 
 class SchedulerMetrics:
     """The scheduler's series, bound to one Registry (metrics.go Register)."""
@@ -436,6 +440,30 @@ class SchedulerMetrics:
             "them (node-array/group/table uploads; device_readback is "
             "the d2h direction).",
             ("phase",)))
+        # kernel observatory (perf/observatory.py, ISSUE 14): mirrored
+        # from the process-global observatory at exposition time
+        self.kernel_device_seconds = r.register(Counter(
+            n + "kernel_device_seconds",
+            "Cumulative warm dispatch wall seconds per JIT kernel entry "
+            "point (compiling calls excluded — xla_compile_seconds "
+            "carries those).",
+            ("kernel",)))
+        self.kernel_dispatch_total = r.register(Counter(
+            n + "kernel_dispatch_total",
+            "Device dispatches per JIT kernel entry point (warm + "
+            "compiling).",
+            ("kernel",)))
+        self.shard_lane_seconds = r.register(Gauge(
+            n + "shard_lane_seconds",
+            "Per-device local compute seconds from the latest "
+            "sharded-lane profile (parallel/sharding.py "
+            "profile_shard_lanes); 0 = unprofiled or unsharded.",
+            ("lane",)))
+        self.shard_imbalance_ratio = r.register(Gauge(
+            n + "shard_imbalance_ratio",
+            "Peak-lane over mean-lane local compute time from the "
+            "latest sharded-lane profile (1.0 = perfectly balanced; "
+            "0 = unprofiled)."))
         # shadow-oracle audit + decision provenance + SLO engine
         # (kubernetes_tpu/obs/, ISSUE 10)
         self.oracle_divergence = r.register(Counter(
@@ -600,8 +628,13 @@ class SchedulerMetrics:
         for kernel in KERNELS:
             self.xla_compiles.inc(kernel, by=0)
             self.xla_compile_seconds.inc(kernel, by=0)
+            self.kernel_device_seconds.inc(kernel, by=0)
+            self.kernel_dispatch_total.inc(kernel, by=0)
         for phase in H2D_PHASES:
             self.h2d_bytes.inc(phase, by=0)
+        for lane in SHARD_SEED_LANES:
+            self.shard_lane_seconds.set(0.0, lane)
+        self.shard_imbalance_ratio.set(0.0)
         # seed the static fallback values; a wired callback (the live
         # scheduler) takes precedence at scrape time
         for kind in ("api_calls", "drains"):
@@ -648,6 +681,22 @@ class SchedulerMetrics:
         for phase, nbytes in GLOBAL.h2d.items():
             self.h2d_bytes._values[(phase,)] = float(nbytes)
 
+    def sync_observatory(self) -> None:
+        """Mirror the kernel observatory (perf/observatory.py) into the
+        kernel_*/shard_* series — absolute assignment for the same
+        process-global reason as the ledger sync above."""
+        from ..perf.observatory import GLOBAL
+        kernels, shard = GLOBAL.metrics_view()
+        for kernel, (dispatches, seconds) in kernels.items():
+            self.kernel_dispatch_total._values[(kernel,)] = float(dispatches)
+            self.kernel_device_seconds._values[(kernel,)] = seconds
+        for i, secs in enumerate(shard.get("laneSeconds", ())):
+            self.shard_lane_seconds.set(float(secs), str(i))
+        ratio = shard.get("imbalanceRatio")
+        if ratio is not None:
+            self.shard_imbalance_ratio.set(float(ratio))
+
     def exposition(self) -> str:
         self.sync_compile_ledger()
+        self.sync_observatory()
         return self.registry.exposition()

@@ -205,6 +205,117 @@ def run_batch_sharded(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                                 fam)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "fam"))
+def _lane_probe_jit(cfg: ScoreConfig, na_l: NodeArrays, carry_l: Carry,
+                    pods: PodXs, table: PodTableDev, fam=None):
+    """One lane's LOCAL compute, collectives elided: the same per-pod
+    eval/argmax/carry-update scan `_sharded_step` runs on each shard,
+    minus the pmax/pmin exchange. Timing this per lane against the full
+    sharded program's blocked wall is what decomposes the mesh gap into
+    compute vs comms (ROADMAP item 1): the slowest lane bounds the
+    compute share, the remainder is collectives + dispatch."""
+    n_local = na_l.cap.shape[0]
+
+    def step(c, x):
+        pod = _gather_row(table, x)
+        mask, score, parts = _eval_pod(cfg, na_l, c, pod, axis=None,
+                                       groups=None, tidx=x.tidx,
+                                       n_global=n_local, fam=fam)
+        masked = jnp.where(mask, score, -1)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        gate = (masked[best] >= 0) & pod.valid
+        c2 = _apply_assignment(c, pod, best, gate)
+        c2 = c2._replace(cache=_row_refresh(cfg, na_l, c2, pod, best,
+                                            gate, parts))
+        return c2, jnp.where(gate, best, -1)
+
+    return lax.scan(step, carry_l, pods)
+
+
+def _lane_carry(host_carry: Carry, sl: slice) -> Carry:
+    """Slice the node axis of a host copy of the carry (groups must be
+    None — the lane probe is group-free)."""
+    cache = host_carry.cache
+    cache_l = type(cache)(
+        sig=cache.sig,
+        **{f: getattr(cache, f)[sl] for f in cache._fields if f != "sig"})
+    return Carry(used=host_carry.used[sl],
+                 nonzero_used=host_carry.nonzero_used[sl],
+                 npods=host_carry.npods[sl],
+                 ports=host_carry.ports[sl], cache=cache_l, groups=None)
+
+
+def profile_shard_lanes(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
+                        carry: Carry, pods: PodXs, table: PodTableDev,
+                        groups: GroupsDev | None = None, fam=None) -> dict:
+    """Sharded-lane profile (ISSUE 14): per-device local-compute seconds,
+    time imbalance, and an all-reduce/comms share estimate for
+    `run_batch_sharded` — the decomposition ROADMAP item 1 needs before
+    porting the single-device toolchain onto the mesh.
+
+    Measurement harness, NOT hot path: re-dispatches the (non-donating)
+    sharded program on the given inputs with a blocking fence for the
+    total wall, then times each lane's node slice through the group-free
+    local scan (`_lane_probe_jit` — one executable for all lanes, they
+    share a shape). `commsShare` attributes everything the slowest lane
+    does not explain to collectives + dispatch; `imbalanceRatio` is
+    max/mean over lanes. Transfers use the explicit device_get/device_put
+    escapes so the sanitizer rails' guard stays honest. When group
+    kernels are active only the total is measured (the local scan has no
+    group-collective twin) and `skipped` says why."""
+    import time as _t
+
+    import numpy as np
+
+    n_dev = int(mesh.devices.size)
+
+    def run_full():
+        out = _run_batch_sharded_jit(cfg, mesh, na, carry, pods, table,
+                                     groups, fam)
+        jax.block_until_ready(out)
+
+    run_full()    # warm — a no-op re-dispatch when the drain already ran
+    t0 = _t.perf_counter()
+    run_full()
+    total = _t.perf_counter() - t0
+    prof = {"nDevices": n_dev, "totalSeconds": round(total, 6),
+            "laneSeconds": [], "imbalanceRatio": 0.0, "commsShare": 0.0,
+            "pods": int(np.asarray(jax.device_get(pods.valid)).shape[0])}
+    if groups is not None or carry.groups is not None:
+        prof["skipped"] = "group kernels active: lane probe is group-free"
+        return prof
+
+    host = jax.tree_util.tree_map(
+        np.asarray, jax.device_get((na, carry, pods, table)))
+    host_na, host_carry, host_pods, host_table = host
+    n_nodes = int(host_na.cap.shape[0])
+    nl = n_nodes // n_dev
+    prof["nodesPerLane"] = nl
+    pods_d, table_d = jax.device_put((host_pods, host_table))
+    lane_in = []
+    for d in range(n_dev):
+        sl = slice(d * nl, (d + 1) * nl)
+        na_l = NodeArrays(*(np.ascontiguousarray(x[sl]) for x in host_na))
+        lane_in.append(jax.device_put((na_l, _lane_carry(host_carry, sl))))
+    # warm the (single, shared-shape) lane executable outside the timings
+    jax.block_until_ready(
+        _lane_probe_jit(cfg, lane_in[0][0], lane_in[0][1], pods_d, table_d,
+                        fam=fam))
+    lanes = []
+    for na_l, carry_l in lane_in:
+        t0 = _t.perf_counter()
+        jax.block_until_ready(
+            _lane_probe_jit(cfg, na_l, carry_l, pods_d, table_d, fam=fam))
+        lanes.append(_t.perf_counter() - t0)
+    mean = sum(lanes) / len(lanes)
+    peak = max(lanes)
+    prof["laneSeconds"] = [round(s, 6) for s in lanes]
+    prof["imbalanceRatio"] = round(peak / mean, 4) if mean > 0 else 0.0
+    prof["commsShare"] = (round(max(0.0, 1.0 - peak / total), 4)
+                          if total > 0 else 0.0)
+    return prof
+
+
 def _note_shard_upload(phase: str, tree) -> None:
     """Attribute a mesh placement's H2D bytes to its drain phase — the
     same `scheduler_h2d_bytes_total{phase}` surface the single-device

@@ -315,6 +315,10 @@ class WorkloadRunner:
         node_seq = 0
         pod_seq = 0
         op_times: list[tuple[str, float]] = []
+        # kernel-observatory bracket: the observatory is process-global,
+        # so per-run numbers must be deltas, not absolutes
+        obs_chk = (sched.observatory.checkpoint()
+                   if sched.observatory.enabled else None)
         for op_i, op in enumerate(tc.workload_template):
             code = op["opcode"]
             t_op = time.perf_counter()
@@ -478,6 +482,15 @@ class WorkloadRunner:
             extras["waves"] = int(waves)
             extras["wave_conflict_ratio"] = round(
                 m.wave_conflict_ratio.sum() / max(nconf, 1), 4)
+        if obs_chk is not None:
+            kernels = sched.observatory.delta_since(obs_chk)
+            if kernels:
+                # per-kernel device-time breakdown of this run (warm
+                # dispatch walls; compile cost lives in the ledger split)
+                extras["kernels"] = kernels
+            shard = sched.observatory.shard_profile()
+            if shard:
+                extras["shard_lanes"] = shard
         prof = getattr(sched, "profiler", None)
         if prof is not None and prof.sample_count:
             # hottest host frames of the run (continuous profiler): the

@@ -19,7 +19,12 @@ own scheduler loop. This module is that profile's device half:
 - host↔device transfer sites (`state/tensorize.py` node-array uploads,
   `ops/groups.py` group-tensor uploads, the signature-table upload and
   the drain readbacks) report byte counts via `note_h2d`, keyed by the
-  drain phase that paid them.
+  drain phase that paid them;
+- warm (non-compiling) call walls are recorded separately
+  (`runCalls`/`runSeconds`), splitting the trace/compile cost out of
+  `compileSeconds` (`compileOverheadSeconds`), and every dispatch is
+  forwarded to the kernel observatory (perf/observatory.py) for
+  per-plan run-time histograms and the per-drain device lane.
 
 The ledger is PROCESS-GLOBAL (`GLOBAL`) because the jit caches it
 observes are process-global; `SchedulerMetrics` mirrors it into
@@ -33,6 +38,7 @@ ZERO compile delta — that invariant is the "no hidden retraces" test.
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from dataclasses import dataclass, field
 
@@ -45,6 +51,13 @@ class KernelRecord:
     compiles: int = 0            # fresh executables minted (cache-size delta)
     compile_seconds: float = 0.0  # wall time of calls that compiled
     donation_misses: int = 0     # donated carry not consumed by the call
+    # warm-call accounting (ISSUE 14 bugfix): compile_seconds conflates
+    # tracing+compile with the first execution; recording the run wall of
+    # NON-compiling calls separately both fixes the split (the derived
+    # compileOverheadSeconds below) and feeds the kernel observatory's
+    # run-time histograms (perf/observatory.py)
+    run_calls: int = 0
+    run_seconds: float = 0.0     # wall time of calls that did NOT compile
 
     @property
     def retraces(self) -> int:
@@ -53,10 +66,25 @@ class KernelRecord:
         TPU — the thing shape-stable dispatch exists to avoid)."""
         return max(self.compiles - 1, 0)
 
+    @property
+    def compile_overhead_seconds(self) -> float:
+        """compile_seconds minus the estimated execution share of the
+        compiling calls (mean warm run wall × compiles) — the pure
+        trace/compile cost, clamped at zero while no warm call has
+        calibrated the estimate yet."""
+        if not self.run_calls:
+            return self.compile_seconds
+        warm = self.run_seconds / self.run_calls
+        return max(self.compile_seconds - warm * self.compiles, 0.0)
+
     def to_dict(self) -> dict:
         return {"calls": self.calls, "compiles": self.compiles,
                 "retraces": self.retraces,
                 "compileSeconds": round(self.compile_seconds, 3),
+                "compileOverheadSeconds": round(
+                    self.compile_overhead_seconds, 3),
+                "runCalls": self.run_calls,
+                "runSeconds": round(self.run_seconds, 6),
                 "donationMisses": self.donation_misses}
 
 
@@ -75,15 +103,22 @@ H2D_PHASES = ("host_snapshot", "host_group_seed", "host_cache",
 
 
 class CompileLedger:
-    """Process-wide compile + transfer accounting (see module docstring)."""
+    """Process-wide compile + transfer accounting (see module docstring).
+
+    Record and snapshot are thread-safe (ISSUE 14): the standby
+    scheduler's warm-up drains and the shadow-audit worker's replays
+    dispatch kernels concurrently with the host loop, and all of them
+    land here. The lock brackets only the counter updates — never the
+    jitted call itself."""
 
     def __init__(self) -> None:
-        self.kernels: dict[str, KernelRecord] = {}
-        self.h2d: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.kernels: dict[str, KernelRecord] = {}  # guarded_by: _lock
+        self.h2d: dict[str, int] = {}               # guarded_by: _lock
 
     # -- compile capture ------------------------------------------------------
 
-    def _rec(self, kernel: str) -> KernelRecord:
+    def _rec(self, kernel: str) -> KernelRecord:  # jaxsan: holds _lock
         rec = self.kernels.get(kernel)
         if rec is None:
             rec = self.kernels[kernel] = KernelRecord()
@@ -105,23 +140,34 @@ class CompileLedger:
         None when the backend compiles without donation): if its buffer
         survives the call, the donation was ignored and the dispatch paid
         a copy of the resident node state — counted as a miss."""
-        rec = self._rec(kernel)
         before = self._cache_size(fn)
         t0 = _time.perf_counter()
         out = fn(*args, **kw)
-        rec.calls += 1
+        dt = _time.perf_counter() - t0
+        delta = 0
         if before >= 0:
             delta = self._cache_size(fn) - before
-            if delta > 0:
-                rec.compiles += delta
-                rec.compile_seconds += _time.perf_counter() - t0
+        miss = False
         if donated is not None:
             # probe one leaf of the donated pytree; is_deleted() is the
             # jax.Array donation witness (True = buffer consumed)
             leaf = getattr(donated, "used", donated)
             deleted = getattr(leaf, "is_deleted", None)
-            if deleted is not None and not deleted():
+            miss = deleted is not None and not deleted()
+        with self._lock:
+            rec = self._rec(kernel)
+            rec.calls += 1
+            if delta > 0:
+                rec.compiles += delta
+                rec.compile_seconds += dt
+            else:
+                rec.run_calls += 1
+                rec.run_seconds += dt
+            if miss:
                 rec.donation_misses += 1
+        # per-dispatch run-time attribution (perf/observatory.py): the
+        # observatory decides itself whether its gate is on
+        _observatory().on_call(kernel, t0, dt, delta > 0, args)
         return out
 
     def wrap(self, kernel: str, fn):
@@ -139,7 +185,8 @@ class CompileLedger:
     # -- transfer capture -----------------------------------------------------
 
     def note_h2d(self, phase: str, nbytes: int) -> None:
-        self.h2d[phase] = self.h2d.get(phase, 0) + int(nbytes)
+        with self._lock:
+            self.h2d[phase] = self.h2d.get(phase, 0) + int(nbytes)
 
     def note_h2d_tree(self, phase: str, tree) -> None:
         """Account every array leaf of a NamedTuple/iterable (the upload
@@ -155,22 +202,42 @@ class CompileLedger:
     # -- reporting ------------------------------------------------------------
 
     def snapshot(self) -> dict:
+        with self._lock:
+            recs = {k: r.to_dict() for k, r in sorted(self.kernels.items())}
+            h2d = dict(sorted(self.h2d.items()))
+            compiles = sum(r.compiles for r in self.kernels.values())
+            compile_s = sum(r.compile_seconds
+                            for r in self.kernels.values())
+            run_s = sum(r.run_seconds for r in self.kernels.values())
+            retraces = sum(r.retraces for r in self.kernels.values())
         return {
-            "kernels": {k: r.to_dict()
-                        for k, r in sorted(self.kernels.items())},
-            "h2dBytes": dict(sorted(self.h2d.items())),
-            "totalCompiles": sum(r.compiles for r in self.kernels.values()),
-            "totalCompileSeconds": round(
-                sum(r.compile_seconds for r in self.kernels.values()), 3),
-            "totalRetraces": sum(r.retraces for r in self.kernels.values()),
+            "kernels": recs,
+            "h2dBytes": h2d,
+            "totalCompiles": compiles,
+            "totalCompileSeconds": round(compile_s, 3),
+            "totalRunSeconds": round(run_s, 6),
+            "totalRetraces": retraces,
         }
 
     def reset(self) -> None:
         """Test hook: forget everything (the jit caches themselves are
         untouched, so a reset ledger on a warm process records zero
         compiles — exactly the warm-run invariant)."""
-        self.kernels.clear()
-        self.h2d.clear()
+        with self._lock:
+            self.kernels.clear()
+            self.h2d.clear()
 
 
 GLOBAL = CompileLedger()
+
+# resolved lazily (observatory imports KERNELS from this module, so a
+# top-level import back would be circular); cached after the first call
+_OBS = None
+
+
+def _observatory():
+    global _OBS
+    if _OBS is None:
+        from .observatory import GLOBAL as _g
+        _OBS = _g
+    return _OBS

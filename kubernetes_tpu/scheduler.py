@@ -254,6 +254,10 @@ class _PendingDrain:
     # gate): dispatched right after the drain over the post-drain carry,
     # resolved to a snapshot dict when this drain commits
     probe: object = None
+    # per-kernel dispatch seconds captured inside this drain's
+    # device_dispatch span (perf/observatory.py device lane); {} with the
+    # KernelObservatory gate off
+    kernels: dict = field(default_factory=dict)
 
     def ready(self) -> bool:
         return all(r.result.is_ready() for r in self.records
@@ -525,6 +529,20 @@ class Scheduler:
         from .analysis.rails import GLOBAL as _rails
         self.rails = _rails
         self.rails.enable(self.feature_gates.enabled("SanitizerRails"))
+        # kernel observatory (perf/observatory.py, `KernelObservatory`
+        # gate): per-dispatch run-time attribution fed by the compile
+        # ledger's measured_call. Process-global like the rails/ledger —
+        # the most recently constructed Scheduler's gate wins.
+        from .perf.observatory import GLOBAL as _observatory
+        self.observatory = _observatory
+        self.observatory.enable(
+            self.feature_gates.enabled("KernelObservatory"))
+        # sharded-lane profile (parallel/sharding.py profile_shard_lanes):
+        # the first sharded dispatch stashes its inputs; the profile runs
+        # ONCE after that drain commits (and on demand via
+        # profile_shard_lanes(force=True) or /debug/kernels?lanes=refresh)
+        self._shard_profile_args = None
+        self._shard_profile_done = False
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -1237,7 +1255,31 @@ class Scheduler:
             self.wait_pending()
         elif len(self.dispatcher):
             self.dispatcher.flush()
+        if (self._shard_profile_args is not None
+                and not self._shard_profile_done
+                and not self._pending):
+            # one-shot sharded-lane profile (perf/observatory.py), off the
+            # dispatch path: the first sharded drain armed it, the quiesced
+            # pipeline runs it — per-lane seconds, imbalance and the comms
+            # share behind scheduler_shard_* and /debug/kernels
+            self.profile_shard_lanes()
         return self.scheduled_count - start
+
+    def profile_shard_lanes(self, force: bool = False):
+        """Run the sharded-lane profile on the latest sharded dispatch's
+        inputs (parallel/sharding.py profile_shard_lanes). Auto-runs once
+        after the first sharded drain; `force=True` re-profiles (the
+        /debug/kernels?lanes=refresh hook). Returns the profile dict, or
+        None when no sharded dispatch has happened yet."""
+        if self._shard_profile_args is None:
+            return None
+        if self._shard_profile_done and not force:
+            return self.observatory.shard_profile() or None
+        self._shard_profile_done = True
+        from .parallel.sharding import profile_shard_lanes
+        prof = profile_shard_lanes(*self._shard_profile_args)
+        self.observatory.set_shard_profile(prof)
+        return prof
 
     def wait_pending(self) -> None:
         """Commit every in-flight drain and flush the dispatcher — the
@@ -1659,6 +1701,10 @@ class Scheduler:
                 segment_batch, n, self._gd_dev, self._gd_fam,
                 names=self.state.node_names)
         try:
+            # kernel observatory: capture every measured_call dispatched
+            # inside the device span as a device-lane event — the span's
+            # wall decomposes into named kernel dispatches
+            self.observatory.begin_drain()
             with self.tracer.span("device_dispatch", pods=n,
                                   groups=groups_needed, drain=did,
                                   batch_bucket=len(segment_batch.valid)) as ds:
@@ -1679,6 +1725,7 @@ class Scheduler:
                         int(segment_batch.tidx[0]))
                 ds.set(runs=",".join(r.kind for r in records))
         except Exception as e:
+            self.observatory.end_drain()
             # a sanitizer rail tripping is a finding, not a device fault:
             # degrading to the host oracle would mask exactly the bug the
             # rails exist to surface
@@ -1700,6 +1747,14 @@ class Scheduler:
         ph["device_dispatch"] = _time.perf_counter() - t0
         self.metrics.drain_phase.observe(
             max(_time.perf_counter() - t0, 0.0), "device")
+        # close the device-lane capture: per-kernel seconds ride the
+        # flight record, and the events become lane="device" child spans
+        # of the dispatch span (one host+device Chrome-trace timeline)
+        lane_events = self.observatory.end_drain()
+        kernels = self.observatory.lane_seconds(lane_events)
+        if lane_events and hasattr(ds, "children"):
+            ds.children.extend(
+                self.observatory.lane_spans(lane_events, drain_id=did))
         self._device_carry = carry
         self.device_batches += 1
         self.metrics.device_batch_size.observe(n)
@@ -1719,7 +1774,7 @@ class Scheduler:
             na=na, n=n, groups_needed=groups_needed, records=records,
             dispatched_at=t0, ovl=ovl, nom=nom, phases=ph, drain_id=did,
             gang=gang, facts=self.builder.row_facts, audit=audit_rec,
-            probe=probe))
+            probe=probe, kernels=kernels))
         return 0
 
     @contextmanager
@@ -2637,7 +2692,8 @@ class Scheduler:
             fallback="" if pd.records else "host_greedy",
             events={"Scheduled": bound,
                     "FailedScheduling": len(failures)},
-            drain_id=pd.drain_id, hot_frames=hot, probe=probe_snap)
+            drain_id=pd.drain_id, hot_frames=hot, probe=probe_snap,
+            kernels=dict(pd.kernels))
         if pd.audit is not None:
             # hand the committed decisions to the shadow-audit worker;
             # the replay + diff run off the hot path
@@ -2842,8 +2898,15 @@ class Scheduler:
         xs = PodXs(valid=valid, sig=sig, tidx=tidx, nom_idx=nom_idx)
         if self.mesh is not None:
             from .parallel.sharding import run_batch_sharded
-            return run_batch_sharded(cfg, self.mesh, na, carry, xs, table,
-                                     groups=self._gd_dev, fam=self._gd_fam)
+            c2, a = run_batch_sharded(cfg, self.mesh, na, carry, xs, table,
+                                      groups=self._gd_dev, fam=self._gd_fam)
+            if self.observatory.enabled and not self._shard_profile_done:
+                # arm the one-shot lane profile with this dispatch's inputs
+                # (run_batch_sharded does not donate the carry, and c2 keeps
+                # the POST-dispatch state alive for the probe)
+                self._shard_profile_args = (cfg, self.mesh, na, c2, xs,
+                                            table, self._gd_dev, self._gd_fam)
+            return c2, a
         return run_batch(cfg, na, carry, xs, table, groups=self._gd_dev,
                          fam=self._gd_fam, overlay=ovl)
 

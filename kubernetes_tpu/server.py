@@ -30,8 +30,11 @@ in-process model:
   with timestamps + the per-segment e2e decomposition),
   /debug/cluster (the latest resolved cluster_probe snapshot:
   utilization percentiles, fragmentation/stranded indices, domain
-  imbalance) and /debug/timeline?seconds=N (the per-second aggregate
-  telemetry ring over all SLIs + probe outputs).
+  imbalance), /debug/timeline?seconds=N (the per-second aggregate
+  telemetry ring over all SLIs + probe outputs) and
+  /debug/kernels?plans=N&lanes=refresh (the kernel observatory:
+  per-kernel run-wall histograms keyed by plan/shape signature, compile
+  splits, the sharded-lane profile — ?lanes=refresh re-probes).
 - Leader election moved to `kubernetes_tpu/ha/` (ISSUE 12): the Lease
   object lives in the API server (backend/apiserver.py, with generation
   fencing tokens), `LeaderElector` in ha/lease.py (renew deadlines,
@@ -140,6 +143,20 @@ class SchedulerServer:
                     from .perf.ledger import GLOBAL as ledger
                     self._send(200, json.dumps(ledger.snapshot(), indent=2),
                                "application/json")
+                elif self.path.startswith("/debug/kernels"):
+                    obs = outer.scheduler.observatory
+                    if not obs.enabled:
+                        self._send(404, "kernel observatory off "
+                                        "(KernelObservatory gate)")
+                        return
+                    q = self._query()
+                    if q.get("lanes") == "refresh":
+                        # re-run the sharded-lane probe on the stashed
+                        # dispatch inputs (no-op on unsharded schedulers)
+                        outer.scheduler.profile_shard_lanes(force=True)
+                    self._send(200, json.dumps(obs.snapshot(
+                        top_plans=int(q.get("plans", "5"))),
+                        indent=2), "application/json")
                 elif self.path.startswith("/debug/audit"):
                     audit = getattr(outer.scheduler, "audit", None)
                     if audit is None:

@@ -192,7 +192,17 @@ NOOP_TRACER = NoopTracer()
 # Chrome-trace / Perfetto export
 
 
+# tid of the merged device-kernel lane: spans tagged lane="device" (the
+# kernel observatory's per-dispatch events, attached as children of the
+# drain's device_dispatch span) render as their own Perfetto track under
+# the same process, so the host timeline and its device decomposition
+# read as ONE trace
+DEVICE_LANE_TID = 2
+
+
 def _span_events(sp: Span, out: list, pid: int, tid: int) -> None:
+    if sp.attributes.get("lane") == "device":
+        tid = DEVICE_LANE_TID
     out.append({"ph": "X", "cat": "scheduler", "name": sp.name,
                 "ts": sp.start * 1e6,            # µs, monotonic base
                 "dur": max(sp.duration_s, 0.0) * 1e6,
@@ -208,12 +218,16 @@ def to_chrome_trace(spans: list[Span], process_name: str = "kube-scheduler-tpu"
                     ) -> dict:
     """Span trees → Chrome-trace JSON object (trace_event format, loadable
     at chrome://tracing / ui.perfetto.dev). Every span becomes one complete
-    ("X") event; timestamps keep the tracer's monotonic base."""
+    ("X") event; timestamps keep the tracer's monotonic base. Device-lane
+    spans (kernel observatory dispatches) land on their own thread track
+    (DEVICE_LANE_TID) nested timewise inside their drain's device span."""
     events: list[dict] = [
         {"ph": "M", "name": "process_name", "pid": 1, "tid": 1,
          "args": {"name": process_name}},
         {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
          "args": {"name": "host-loop"}},
+        {"ph": "M", "name": "thread_name", "pid": 1,
+         "tid": DEVICE_LANE_TID, "args": {"name": "device-lanes"}},
     ]
     for sp in spans:
         _span_events(sp, events, 1, 1)

@@ -222,6 +222,77 @@ class TestE2EGate:
         assert "SENTINEL: FAIL" in bad.stdout
 
 
+class TestKernelGate:
+    """Per-kernel device-time gate (ISSUE 14): one JIT entry's p99
+    growing >30% trips the sentinel even when throughput holds; kernels
+    absent on either side (older BENCH files, undisplayed kernels) and
+    sub-bucket jitter are skipped."""
+
+    @staticmethod
+    def _wl(kernels):
+        return {"SchedulingBasic_x": {"pods_per_s": 100.0,
+                                      "kernels": kernels}}
+
+    def test_kernel_p99_growth_beyond_gate_fails(self):
+        base = self._wl({"run_batch": {"seconds": 1.0, "p99_ms": 10.0}})
+        new = self._wl({"run_batch": {"seconds": 1.4, "p99_ms": 14.0}})
+        failures, _ = bench_compare.compare(base, new)
+        assert any("KERNEL P99 REGRESSION" in f and "run_batch" in f
+                   for f in failures)
+
+    def test_kernel_p99_within_gate_passes(self):
+        base = self._wl({"run_batch": {"p99_ms": 10.0}})
+        new = self._wl({"run_batch": {"p99_ms": 12.0}})   # +20% < 30%
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_kernel_skipped_when_absent_on_either_side(self):
+        base = self._wl({})
+        new = self._wl({"run_wave": {"p99_ms": 99.0}})
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+        failures, _ = bench_compare.compare(new, self._wl({}))
+        assert not failures
+
+    def test_sub_bucket_jitter_never_gates(self):
+        # +100% relative but only 0.02ms absolute: log2 bucket noise
+        base = self._wl({"scatter_rows": {"p99_ms": 0.02}})
+        new = self._wl({"scatter_rows": {"p99_ms": 0.04}})
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_cli_synthetic_kernel_regression_flips_exit_code(
+            self, tmp_path):
+        """End-to-end self-test: scale ONE kernel's p99 ×1.5 in a copied
+        summary — the sentinel must exit 2; the unscaled pair passes."""
+        base = {"summary": {"SchedulingBasic_X": {
+            "pods_per_s": 1000.0, "p50": 900, "p99": 1100,
+            "kernels": {"run_uniform": {"calls": 50, "seconds": 2.0,
+                                        "p50_ms": 20.0, "p99_ms": 40.0},
+                        "run_batch": {"calls": 5, "seconds": 0.1,
+                                      "p50_ms": 10.0, "p99_ms": 20.0}}}}}
+        bad_doc = copy.deepcopy(base)
+        bad_doc["summary"]["SchedulingBasic_X"]["kernels"][
+            "run_uniform"]["p99_ms"] = 60.0
+        bp = tmp_path / "base.json"
+        gp = tmp_path / "good.json"
+        rp = tmp_path / "regressed.json"
+        bp.write_text(json.dumps(base))
+        gp.write_text(json.dumps(base))
+        rp.write_text(json.dumps(bad_doc))
+        ok = subprocess.run(
+            [sys.executable, TOOL, "--baseline", str(bp), "--new",
+             str(gp)], capture_output=True, text=True)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        bad = subprocess.run(
+            [sys.executable, TOOL, "--baseline", str(bp), "--new",
+             str(rp)], capture_output=True, text=True)
+        assert bad.returncode == 2
+        assert "KERNEL P99 REGRESSION" in bad.stdout
+        assert "run_uniform" in bad.stdout
+        assert "SENTINEL: FAIL" in bad.stdout
+
+
 class TestSLOGate:
     """--slo (ISSUE 10): burn-rate breaches and shadow-oracle divergence
     recorded in a bench summary fail the sentinel."""

@@ -379,6 +379,62 @@ class TestExpositionLint:
                series["scheduler_cluster_domain_imbalance"]}
         assert dom == set(CLUSTER_DOM_STATS)
 
+    def test_issue14_families_covered_by_lint(self):
+        """ISSUE 14 satellite: the kernel-observatory families are
+        registered AND pre-seeded with the EXACT label sets — every
+        ledger kernel on the kernel-labeled pair, one TPU host's worth
+        of lanes on the shard gauge — so the generic lint exercises
+        them before the first dispatch."""
+        from kubernetes_tpu.metrics import SHARD_SEED_LANES
+        from kubernetes_tpu.perf.ledger import KERNELS
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_kernel_device_seconds"] == "counter"
+        assert types["scheduler_kernel_dispatch_total"] == "counter"
+        assert types["scheduler_shard_lane_seconds"] == "gauge"
+        assert types["scheduler_shard_imbalance_ratio"] == "gauge"
+        for fam in ("scheduler_kernel_device_seconds",
+                    "scheduler_kernel_dispatch_total"):
+            kernels = {lbl["kernel"] for lbl, _v in series[fam]}
+            assert kernels == set(KERNELS), fam
+        lanes = {lbl["lane"] for lbl, _v in
+                 series["scheduler_shard_lane_seconds"]}
+        assert lanes == set(SHARD_SEED_LANES)
+        assert set(SHARD_SEED_LANES) == {str(i) for i in range(8)}
+        # the unlabeled imbalance gauge carries exactly one sample
+        (lbl, val), = series["scheduler_shard_imbalance_ratio"]
+        assert lbl == {} and val == 0.0
+
+    def test_issue14_observatory_mirror_syncs_at_exposition(self):
+        """The exposition mirrors the process-global observatory the
+        same way it mirrors the compile ledger: absolute assignment of
+        dispatch counts and warm seconds per kernel."""
+        from kubernetes_tpu.perf.observatory import GLOBAL as obs
+        obs.reset()
+        try:
+            obs.on_call("run_batch", 0.0, 0.050, False, ())
+            obs.on_call("run_batch", 0.0, 0.030, False, ())
+            obs.on_call("run_batch", 0.0, 2.000, True, ())  # compile
+            obs.set_shard_profile({"laneSeconds": [0.5, 0.25],
+                                   "imbalanceRatio": 1.33,
+                                   "nDevices": 2})
+            m = SchedulerMetrics()
+            series, _h, _t = _parse_exposition(m.exposition())
+            vals = {lbl["kernel"]: v for lbl, v in
+                    series["scheduler_kernel_dispatch_total"]}
+            assert vals["run_batch"] == 3.0
+            secs = {lbl["kernel"]: v for lbl, v in
+                    series["scheduler_kernel_device_seconds"]}
+            # warm walls only: the compiling call's 2s stays out
+            assert abs(secs["run_batch"] - 0.080) < 1e-9
+            lanes = {lbl["lane"]: v for lbl, v in
+                     series["scheduler_shard_lane_seconds"]}
+            assert lanes["0"] == 0.5 and lanes["1"] == 0.25
+            (_lbl, ratio), = series["scheduler_shard_imbalance_ratio"]
+            assert abs(ratio - 1.33) < 1e-9
+        finally:
+            obs.reset()
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

@@ -23,6 +23,11 @@ and fails (exit 2) on:
   * queue→bind e2e p99 latency growth >25% (the e2e_p99_ms extra from
     the sli_duration histogram, recorded since r13 — same
     skip-when-absent rule);
+  * per-kernel device-time p99 growth >30% (the kernel observatory's
+    `kernels` summary block, recorded since r14): one JIT entry
+    regressing inside the device phase gates even when the workload's
+    aggregate throughput hides it. Skipped for kernels absent on either
+    side, and for sub-bucket jitter (<0.05 ms absolute growth);
   * with --slo: any burn-rate breach recorded in the candidate's per-
     workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
     nonzero shadow-oracle divergence — a bench run whose decisions
@@ -65,6 +70,17 @@ MAX_E2E_P99_GROWTH = 0.25
 # the columnar ingest engine vacated. Skipped when either side predates
 # the field.
 MAX_HOST_SHARE_GROWTH = 0.10
+# per-kernel device-time gate (ISSUE 14): the kernel observatory's
+# `kernels` summary block records per-JIT-entry warm-dispatch p99 since
+# r14. A single kernel's p99 growing past this fraction fails even when
+# aggregate throughput hides it (one kernel regressing inside a phase
+# another kernel sped up). Skipped when either side lacks the kernel —
+# older BENCH files and workloads that never dispatch it.
+MAX_KERNEL_P99_GROWTH = 0.30
+# per-kernel jitter floor: sub-ms kernels round-trip through log2
+# histogram buckets (~sqrt(2) quantile resolution), so growth below this
+# many ms never gates
+MIN_KERNEL_P99_MS = 0.05
 
 # per-workload noise thresholds (throughput drop), keyed by case-name
 # prefix: the group/preemption workloads' measured passes jitter ±20%
@@ -220,6 +236,23 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
             if growth > MAX_HOST_SHARE_GROWTH:
                 failures.append(f"HOST PHASE SHARE REGRESSION {line}")
             report.append(line)
+        b_k = b.get("kernels") or {}
+        n_k = n.get("kernels") or {}
+        for kernel in sorted(set(b_k) & set(n_k)):
+            b_kp = float(b_k[kernel].get("p99_ms") or 0.0)
+            n_kp = float(n_k[kernel].get("p99_ms") or 0.0)
+            if b_kp <= 0 or n_kp <= 0:
+                continue
+            growth = n_kp / b_kp - 1.0
+            if growth > MAX_KERNEL_P99_GROWTH \
+                    and n_kp - b_kp > MIN_KERNEL_P99_MS:
+                failures.append(
+                    f"KERNEL P99 REGRESSION {w}/{kernel}: "
+                    f"{b_kp:.2f} -> {n_kp:.2f} ms "
+                    f"({growth:+.1%}, gate +{MAX_KERNEL_P99_GROWTH:.0%})")
+                report.append(
+                    f"{w}/{kernel}: device p99 {b_kp:.2f} -> "
+                    f"{n_kp:.2f} ms ({growth:+.1%})")
     for w in sorted(set(base) - set(new)):
         report.append(f"{w}: only in baseline (skipped)")
     for w in sorted(set(new) - set(base)):

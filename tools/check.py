@@ -8,7 +8,9 @@
 
 Exit codes: 0 = no unwaived findings; 1 = findings; 2 = configuration
 error (a declared JIT entry point no longer reaches a jitted function —
-the lint silently lost device-path coverage).
+the lint silently lost device-path coverage — or is missing from the
+kernel observatory's ENTRY_KERNELS map, so its dispatches would go
+unmeasured).
 
 The same analysis runs in tier-1 via tests/test_jaxsan.py, so CI fails
 on any unwaived finding; this CLI is the local/fix-up loop. Waiver
@@ -47,6 +49,27 @@ def run_check(root: str = _REPO, package: str = "kubernetes_tpu",
     return findings, an
 
 
+def observatory_gaps(entry_points=None) -> list:
+    """Entries the kernel observatory cannot attribute (ISSUE 14): every
+    jaxsan ENTRY_POINT function must map to a ledger kernel via
+    perf/observatory.py ENTRY_KERNELS — a new JIT entry cannot land
+    unmeasured. Returns ["mod.fn (reason)", ...]; empty = covered."""
+    from kubernetes_tpu.analysis.jaxsan import ENTRY_POINTS
+    from kubernetes_tpu.perf.ledger import KERNELS
+    from kubernetes_tpu.perf.observatory import ENTRY_KERNELS
+
+    gaps: list[str] = []
+    for mod, names in (entry_points or ENTRY_POINTS).items():
+        for name in names:
+            kernel = ENTRY_KERNELS.get(name)
+            if kernel is None:
+                gaps.append(f"{mod}.{name} (not in ENTRY_KERNELS)")
+            elif kernel not in KERNELS:
+                gaps.append(f"{mod}.{name} (maps to unknown kernel "
+                            f"{kernel!r})")
+    return gaps
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=_REPO)
@@ -72,12 +95,17 @@ def main(argv=None) -> int:
     findings, an = run_check(args.root, args.package, entry_points)
     live = [f for f in findings if not f.waived]
     waived = [f for f in findings if f.waived]
+    # the observatory-coverage gate guards the REPO's declared entry
+    # points; an ad-hoc --entries override lints someone else's tree,
+    # whose functions have no business in ENTRY_KERNELS
+    obs_gaps = [] if entry_points is not None else observatory_gaps()
 
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_dict() for f in live],
             "waived": [f.to_dict() for f in waived],
             "missingEntries": an.missing_entries,
+            "observatoryGaps": obs_gaps,
             "modules": len(an.modules),
             "tracedFunctions": sum(1 for fi in an.fns.values()
                                    if fi.traced),
@@ -96,6 +124,11 @@ def main(argv=None) -> int:
     if an.missing_entries:
         print("jaxsan: CONFIG ERROR — entries without jit coverage: "
               + ", ".join(an.missing_entries), file=sys.stderr)
+        return 2
+    if obs_gaps:
+        print("jaxsan: CONFIG ERROR — entries invisible to the kernel "
+              "observatory (perf/observatory.py ENTRY_KERNELS): "
+              + ", ".join(obs_gaps), file=sys.stderr)
         return 2
     return 1 if live else 0
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Kernel sweep: the autotuner's measurement table (ISSUE 14, ROADMAP 6).
+
+Sweeps the scheduler's device-shape knobs one at a time over a fixed
+synthetic workload and records, per sweep point, the kernel
+observatory's per-JIT-entry device-time delta plus end-to-end
+throughput — the measurement substrate a future autotuner searches
+instead of re-deriving. Every point runs a FRESH APIServer + Scheduler
+(the knobs change compiled shapes; sharing a process-wide jit cache
+across points is fine, sharing a scheduler is not).
+
+Knobs (see KNOBS for the sweep lattices):
+
+  wave_min_span   below this span length a group drain takes the
+                  per-pod scan instead of a wave dispatch
+                  (Scheduler.wave_min_span)
+  plan_max_sigs   signature-count ceiling of a compiled DrainPlan; a
+                  mix beyond it degrades (DrainCompiler.max_sigs,
+                  default compiler/plan.py PLAN_MAX_SIGS)
+  batch_size      the drain size, and through pow2_at_least the
+                  run_uniform top-L tier (Scheduler._uniform_shape)
+  scatter_shift   dirty-row scatter threshold: scatter when
+                  dirty ≤ max(N >> shift, 32), else full upload
+                  (state/tensorize.py ClusterState.scatter_shift)
+
+Usage:
+
+  python tools/kernel_sweep.py                       # full sweep → stdout
+  python tools/kernel_sweep.py --out sweep.json
+  python tools/kernel_sweep.py --knobs wave_min_span,plan_max_sigs
+  python tools/kernel_sweep.py --nodes 500 --pods 1000
+  python tools/kernel_sweep.py --self-test           # tiny 2-point sweep
+
+Output: one JSON object keyed by backend →
+{backend, nodes, pods, knobs: {name: {default, points: [{value,
+pods_per_s, wall_s, kernels: {kernel: {calls, seconds, p50_ms,
+p99_ms}}}]}}}. CPU numbers rank RELATIVE cost only; re-run on the TPU
+backend for absolute tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+# knob name → (sweep lattice, how to apply the value). `ctor` knobs pass
+# through the Scheduler constructor; `post` knobs mutate the fresh
+# instance before the first drain (all are consulted per drain).
+KNOBS = {
+    "wave_min_span": {
+        "values": (8, 24, 64, 128),
+        "default": 24,
+        "apply": lambda sched, v: setattr(sched, "wave_min_span", int(v)),
+    },
+    "plan_max_sigs": {
+        "values": (8, 16, 32, 64),
+        "default": 32,
+        "apply": lambda sched, v: setattr(sched.compiler, "max_sigs",
+                                          int(v)),
+    },
+    "batch_size": {
+        "values": (1024, 4096, 8192),
+        "default": 8192,
+        "ctor": True,
+    },
+    "scatter_shift": {
+        "values": (1, 3, 6),
+        "default": 3,
+        "apply": lambda sched, v: setattr(sched.state, "scatter_shift",
+                                          int(v)),
+    },
+}
+
+
+def _build(nodes: int, **ctor_kw):
+    from kubernetes_tpu.backend.apiserver import APIServer
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import make_node
+
+    api = APIServer()
+    sched = Scheduler(api, **ctor_kw)
+    for i in range(nodes):
+        api.create_node(
+            make_node(f"n{i}")
+            .capacity({"cpu": "16", "memory": "32Gi", "pods": 110})
+            .zone(f"z{i % 4}")
+            .label("kubernetes.io/hostname", f"n{i}").obj())
+    return api, sched
+
+
+def _feed(api, pods: int, spread_frac: float = 0.25) -> None:
+    """Mixed workload: mostly plain pods (the uniform fast path) plus a
+    spread slice (group seeding → wave/scan, the wave_min_span
+    consumer)."""
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    n_spread = int(pods * spread_frac)
+    for i in range(pods):
+        w = make_pod(f"p{i}").req({"cpu": "100m", "memory": "64Mi"})
+        if i < n_spread:
+            w = w.label("app", "sweep").spread_constraint(
+                1, "topology.kubernetes.io/zone", "ScheduleAnyway",
+                {"app": "sweep"})
+        api.create_pod(w.obj())
+
+
+def run_point(knob: str, value, nodes: int, pods: int) -> dict:
+    spec = KNOBS[knob]
+    ctor_kw = {knob: value} if spec.get("ctor") else {}
+    api, sched = _build(nodes, **ctor_kw)
+    if "apply" in spec:
+        spec["apply"](sched, value)
+    obs = sched.observatory
+    chk = obs.checkpoint()
+    _feed(api, pods)
+    t0 = time.perf_counter()
+    bound = sched.schedule_pending()
+    wall = time.perf_counter() - t0
+    return {
+        "value": value,
+        "bound": int(bound),
+        "wall_s": round(wall, 4),
+        "pods_per_s": round(bound / wall, 1) if wall > 0 else 0.0,
+        "kernels": obs.delta_since(chk),
+    }
+
+
+def run_sweep(knobs, nodes: int, pods: int, points_per_knob: int = 0,
+              verbose: bool = False) -> dict:
+    import jax
+
+    out = {"backend": jax.default_backend(), "nodes": nodes, "pods": pods,
+           "knobs": {}}
+    for knob in knobs:
+        spec = KNOBS[knob]
+        values = spec["values"]
+        if points_per_knob:
+            values = (values[0], values[-1])[:points_per_knob]
+        points = []
+        for v in values:
+            if verbose:
+                print(f"  sweep {knob}={v} ...", file=sys.stderr)
+            points.append(run_point(knob, v, nodes, pods))
+        out["knobs"][knob] = {"default": spec["default"], "points": points}
+    return out
+
+
+def self_test() -> int:
+    """Tiny 2-point sweep over every knob; validates the JSON contract
+    (tier-1: tests/test_observatory.py runs this)."""
+    table = run_sweep(list(KNOBS), nodes=32, pods=48, points_per_knob=2)
+    json.dumps(table)   # must be serializable
+    assert table["backend"]
+    for knob, spec in table["knobs"].items():
+        pts = spec["points"]
+        assert len(pts) == 2, (knob, pts)
+        for p in pts:
+            assert p["bound"] == 48, (knob, p)
+            assert p["pods_per_s"] > 0, (knob, p)
+            assert isinstance(p["kernels"], dict)
+            # the drain must have dispatched SOMETHING measurable
+            assert sum(k.get("dispatches", 0)
+                       for k in p["kernels"].values()) > 0, (knob, p)
+    print("kernel_sweep self-test: OK "
+          f"({len(table['knobs'])} knobs x 2 points, "
+          f"backend={table['backend']})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default="", help="write JSON here (default "
+                                              "stdout)")
+    ap.add_argument("--knobs", default="",
+                    help="comma-separated knob subset "
+                         f"(default all: {','.join(KNOBS)})")
+    ap.add_argument("--nodes", type=int, default=200)
+    ap.add_argument("--pods", type=int, default=400)
+    ap.add_argument("--self-test", action="store_true",
+                    help="tiny 2-point sweep; exit 0 iff the JSON "
+                         "contract holds")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+
+    knobs = [k for k in args.knobs.split(",") if k] or list(KNOBS)
+    unknown = [k for k in knobs if k not in KNOBS]
+    if unknown:
+        print(f"kernel_sweep: unknown knob(s) {unknown} "
+              f"(known: {sorted(KNOBS)})", file=sys.stderr)
+        return 3
+    table = run_sweep(knobs, args.nodes, args.pods, verbose=True)
+    text = json.dumps(table, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"kernel_sweep: wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
