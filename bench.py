@@ -89,6 +89,7 @@ sys.path.insert(0, REPO)
 # the env var alone is not enough (same dance as tests/conftest.py)
 import jax
 jax.config.update("jax_platforms", "cpu")
+from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
 from kubernetes_tpu.backend.apiserver import APIServer
 from kubernetes_tpu.parallel.sharding import make_mesh
 from kubernetes_tpu.scheduler import Scheduler
@@ -98,19 +99,37 @@ mesh = make_mesh(8)
 
 def run():
     api = APIServer()
-    sched = Scheduler(api, batch_size=2048, mesh=mesh)
+    sched = Scheduler(api, batch_size=BATCH, mesh=mesh)
     for i in range(NODES):
         api.create_node(make_node(f"n{i}").capacity(
             {"cpu": 32, "memory": "64Gi", "pods": 110})
             .zone(f"z{i % 16}").obj())
     sched.prime()
+    # defer the one-shot lane profile: it re-dispatches the scan-shaped
+    # program to decompose it, which belongs AFTER the throughput clock
+    # stops, not inside the measured window
+    sched.shard_profile_auto = False
     samples = [(time.perf_counter(), 0)]
-    created = 0
+    created = gidx = 0
     while created < PODS:
-        for i in range(256):
-            api.create_pod(make_pod(f"pod-{created + i}").req(
-                {"cpu": "900m", "memory": "1Gi"}).obj())
-        created += 256
+        take = min(CHUNK, PODS - created)
+        if GANG:
+            # all-or-nothing gangs of 8 (run_gang_sharded's device path)
+            for _ in range(take // 8):
+                wl = "gang-%d" % gidx; gidx += 1
+                api.create_workload(Workload(
+                    metadata=ObjectMeta(name=wl),
+                    pod_groups=[PodGroup(name="workers", min_count=8)]))
+                for _ in range(8):
+                    api.create_pod(make_pod(f"pod-{created}").req(
+                        {"cpu": "900m", "memory": "1Gi"})
+                        .workload(wl).obj())
+                    created += 1
+        else:
+            for i in range(take):
+                api.create_pod(make_pod(f"pod-{created + i}").req(
+                    {"cpu": "900m", "memory": "1Gi"}).obj())
+            created += take
         sched.schedule_pending(wait=False)
         samples.append((time.perf_counter(), sched.scheduled_count))
     sched.schedule_pending()
@@ -137,6 +156,10 @@ def run():
         "e2e_p50_ms": round(m.sli_duration.quantile(0.50) * 1e3, 3),
         "e2e_p99_ms": round(m.sli_duration.quantile(0.99) * 1e3, 3),
         "slo": sched.slo.snapshot(compact=True),
+        # sharded-lane decomposition of this pass (ISSUE 16): per-lane
+        # seconds, imbalance ratio and comms share — bench_compare's
+        # sharded-lane regression gate reads this off the median pass
+        "lanes": sched.profile_shard_lanes() or {},
     }
 
 run()           # warm pass: compiles the node-axis-sharded program
@@ -148,22 +171,30 @@ print(json.dumps(out))
 '''
 
 
-def sharded_case(nodes: int, pods: int, runs: int) -> dict:
-    """Run the ShardedBasic workload on the 8-virtual-device CPU mesh in
-    a subprocess (the real chip is single-device; the driver's MULTICHIP
+def sharded_case(nodes: int, pods: int, runs: int, gang: bool = False,
+                 chunk: int = 256, batch: int = 2048,
+                 timeout: int = 900) -> dict:
+    """Run a Sharded* workload on the 8-virtual-device CPU mesh in a
+    subprocess (the real chip is single-device; the driver's MULTICHIP
     dryrun validates compilation the same way). Returns a full summary
-    entry — ROADMAP item 2's starting point, recorded in the BENCH trail
-    and gated by tools/bench_compare.py instead of folklore."""
+    entry — ROADMAP item 1's scoreboard, recorded in the BENCH trail
+    and gated by tools/bench_compare.py instead of folklore. `gang`
+    feeds all-or-nothing gangs of 8 (run_gang_sharded) instead of plain
+    pods; `chunk`/`batch` size the creation wave and the drain span —
+    the 50k-node tier sets both to the full pod count so ONE drain
+    carries 10^5 pods through the closed-form sharded uniform tier."""
     import subprocess
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
     code = ("REPO = %r\nNODES = %d\nPODS = %d\nRUNS = %d\n"
+            "GANG = %d\nCHUNK = %d\nBATCH = %d\n"
             % (os.path.dirname(os.path.abspath(__file__)), nodes, pods,
-               runs)) + _SHARDED_CASE
+               runs, int(gang), chunk, batch)) + _SHARDED_CASE
     try:
         out = subprocess.run([sys.executable, "-c", code], env=env,
-                             capture_output=True, text=True, timeout=900)
+                             capture_output=True, text=True,
+                             timeout=timeout)
         if out.returncode != 0 or not out.stdout.strip():
             return {"error": f"probe exited {out.returncode}",
                     "stderr_tail": out.stderr.strip()[-400:]}
@@ -318,7 +349,7 @@ def main() -> None:
                   file=sys.stderr)
 
     if not case_filter or "ShardedBasic" in case_filter:
-        # ShardedBasic (ISSUE 10 satellite / ROADMAP item 2): the
+        # ShardedBasic (ISSUE 10 satellite / ROADMAP item 1): the
         # node-axis-sharded program's throughput as a first-class,
         # sentinel-gated workload — 8-virtual-device CPU mesh in a
         # subprocess (XLA's device-count flag must precede jax import)
@@ -328,6 +359,31 @@ def main() -> None:
             results[f"ShardedBasic_{nodes}Nodes"] = entry
         else:
             results[f"ShardedBasic_{nodes}Nodes_FAILED"] = entry
+
+    if not case_filter or "ShardedGang" in case_filter:
+        # ShardedGang (ISSUE 16): all-or-nothing gangs dispatched
+        # through run_gang_sharded — the gang toolchain's mesh port,
+        # bench-gated like every other sharded kernel
+        nodes, pods, runs = (500, 512, 1) if small else (5000, 2048, 2)
+        entry = sharded_case(nodes, pods, runs, gang=True)
+        if "error" not in entry:
+            results[f"ShardedGang_{nodes}Nodes"] = entry
+        else:
+            results[f"ShardedGang_{nodes}Nodes_FAILED"] = entry
+
+    if (not small and not case_filter) or "Sharded50k" in case_filter:
+        # the 50k-node tier (ISSUE 16): 10^5 pods through ONE drain of
+        # the closed-form sharded uniform tier at 50k nodes — the scale
+        # the paper's ≥50k pods/s target assumes, previously untouched
+        # by the suite. One measured pass: the tier exists to prove the
+        # shape compiles and completes, percentile noise is the 5k
+        # cases' job
+        entry = sharded_case(50000, 100000, 1, chunk=100000,
+                             batch=100000, timeout=3000)
+        if "error" not in entry:
+            results["ShardedBasic_50000Nodes"] = entry
+        else:
+            results["ShardedBasic_50000Nodes_FAILED"] = entry
 
     if not case_filter or "HAFailover" in case_filter:
         # warm-spare takeover vs cold start (ISSUE 12 / ROADMAP item 5):
@@ -385,6 +441,10 @@ def main() -> None:
             # per JIT entry — what bench_compare's per-kernel p99 gate
             # reads, and the named decomposition of device_s above
             "kernels": entry.get("kernels", {}),
+            # sharded-lane profile of the median pass (ISSUE 16): comms
+            # share + imbalance ratio, the decomposition bench_compare's
+            # sharded-lane gate regresses on ({} for unsharded cases)
+            "lanes": entry.get("lanes", {}),
         }
 
     head_key = next(iter(results))

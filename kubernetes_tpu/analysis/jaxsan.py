@@ -60,7 +60,10 @@ ENTRY_POINTS = {
         "dry_run_select_victims", "scatter_rows", "explain_row",
         "cluster_probe"),
     "kubernetes_tpu.ops.gang": ("run_gang",),
-    "kubernetes_tpu.parallel.sharding": ("run_batch_sharded",),
+    "kubernetes_tpu.parallel.sharding": (
+        "run_batch_sharded", "run_uniform_sharded", "run_plan_sharded",
+        "run_gang_sharded", "scatter_rows_sharded",
+        "cluster_probe_sharded"),
 }
 
 # public entries that DONATE an argument's buffers to the compiled

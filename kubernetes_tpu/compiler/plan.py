@@ -30,10 +30,14 @@ compile ledger then proves a fixed retrace point over a steady workload
 — same traffic shape, zero fresh executables.
 
 Fallback matrix (what still routes to "scan"): nominated-pod overlays
-and per-pod self-exclusion, the sharded mesh, invalid rows, spans below
-`wave_min_span`, and mixes beyond PLAN_MAX_SIGS distinct signatures.
-Host-greedy remains the no-device tier for group drains whose plan is
-scan-only (`DrainPlan.scan_only` — gate off or short spans).
+and per-pod self-exclusion, invalid rows, spans below `wave_min_span`,
+and mixes beyond PLAN_MAX_SIGS distinct signatures. Host-greedy remains
+the no-device tier for group drains whose plan is scan-only
+(`DrainPlan.scan_only` — gate off or short spans). The sharded mesh is
+a first-class backend (ISSUE 16): uniform/wavescan/gang spans dispatch
+through their mesh twins in parallel/sharding.py; only the
+same-signature merge wave keeps its single-device kernel, so on a mesh
+those spans compile to the plan program ("wavescan") instead.
 """
 
 from __future__ import annotations
@@ -118,8 +122,7 @@ class DrainCompiler:
             # tier choice (closed-form vs scan) is data-dependent and
             # made at dispatch (ops/gang.py)
             return DrainPlan(spans=[(0, n, ("gang", int(gang_needed)))])
-        wave_on = (not mesh
-                   and self.gates.enabled("SpeculativeWavePlacement"))
+        wave_on = self.gates.enabled("SpeculativeWavePlacement")
         batching_on = self.gates.enabled("OpportunisticBatching")
         key = (self.builder.reset_count, self.builder.table_used,
                groups_needed, overlay, nominated, mesh, strategy,
@@ -149,14 +152,15 @@ class DrainCompiler:
 
         spans = None
         if groups_needed and not overlay and not nominated:
-            wave = self._classify_wave(batch, n, wave_on, wave_min_span)
+            wave = self._classify_wave(batch, n, wave_on, wave_min_span,
+                                       mesh=mesh)
             if wave is not None:
                 spans = [(0, n, wave)]
         if spans is None:
             # uniform/scan classification (the lean tiers). Nominated
             # per-pod self-exclusion is outside the closed form; overlays
             # ride the scan's fit overlay.
-            fast_ok = (not mesh and not nominated and batching_on
+            fast_ok = (not nominated and batching_on
                        and not groups_needed
                        and strategy == "LeastAllocated"
                        and not prefer_taints)
@@ -216,12 +220,13 @@ class DrainCompiler:
         return runs
 
     def _classify_wave(self, batch, n: int, wave_on: bool,
-                       wave_min_span: int):
+                       wave_min_span: int, mesh: bool = False):
         """Whole-drain program for a group drain, or None (scan-only →
         host greedy / reference scan). Same-signature port-free drains
-        ride the merge wave; ANY other mix up to PLAN_MAX_SIGS distinct
-        signatures — host-port rows included — compiles to one plan
-        program."""
+        ride the merge wave (single-device only — on a mesh they compile
+        to the plan program instead); ANY other mix up to PLAN_MAX_SIGS
+        distinct signatures — host-port rows included — compiles to one
+        plan program."""
         if not wave_on or n < wave_min_span:
             return None
         if not batch.valid[:n].all():
@@ -229,7 +234,7 @@ class DrainCompiler:
         sig = batch.sig[:n]
         has_ports = bool((sig == 0).any())
         uniq = list(dict.fromkeys(batch.tidx[:n].tolist()))
-        if len(uniq) == 1 and not has_ports:
+        if len(uniq) == 1 and not has_ports and not mesh:
             mode, anti = self._wave_same_mode(int(uniq[0]))
             if mode is not None:
                 return ("wave", int(uniq[0]), anti, mode == "merge")

@@ -56,6 +56,11 @@ class DeviceDryRunContext:
     state: object
     builder: object
     snapshot: object
+    # jax.sharding.Mesh when the owning scheduler runs node-sharded
+    # (ISSUE 16): the dry-run then gathers the candidate rows host-side
+    # into a compact single-device NodeArrays block instead of minting a
+    # second full-matrix device copy next to the sharded one
+    mesh: object = None
 
 
 @dataclass
@@ -81,6 +86,10 @@ class _DryRunPlan:
     # nomination overlay actually touches (a tiny gathered kernel), so the
     # full-candidate kernel runs once per wave, not once per preemptor
     base_packed: object = None
+    # mesh mode only: the candidate rows gathered host-side into a
+    # single-device NodeArrays[Cp] block; cand_idx is then positions into
+    # THIS block (arange), not global node rows
+    cand_na: object = None
 
 
 class Evaluator:
@@ -359,7 +368,9 @@ class Evaluator:
                 vic_match=spread.vic_match[sub_j])
         prow = pod_row_from_table(ctx.builder.table, u)
         packed = np.asarray(dry_run_select_victims(
-            ctx.state.device_arrays(), prow, plan.cand_idx[sub_j],
+            plan.cand_na if plan.cand_na is not None
+            else ctx.state.device_arrays(),
+            prow, plan.cand_idx[sub_j],
             plan.victim_req[sub_j], plan.victim_valid[sub_j],
             ovl_used, ovl_npods, spread))
         return {int(c): packed[i] for i, c in enumerate(sub)}
@@ -453,17 +464,30 @@ class Evaluator:
         # then pays only a tiny overlay-subset kernel
         import jax.numpy as jnp
         from ..ops.program import dry_run_select_victims, pod_row_from_table
+        cand_na = None
+        kernel_idx = jnp.asarray(cand_idx)
+        if self.device_ctx.mesh is not None:
+            # mesh mode (ISSUE 16): gather the candidate rows out of the
+            # host staging arrays into a compact single-device block —
+            # the kernel is row-local over `cand`, so positions into the
+            # gathered block are exact, and the mesh-sharded resident
+            # copy is never touched (nor its dirty-row tracking cleared)
+            a = ctx.state.ensure_arrays()
+            cand_na = type(a)(*(jnp.asarray(x[cand_idx]) for x in a))
+            kernel_idx = jnp.arange(c_pad, dtype=jnp.int32)
         plan = _DryRunPlan(
-            key=key, cands=cands, cand_idx=jnp.asarray(cand_idx),
+            key=key, cands=cands, cand_idx=kernel_idx,
             cand_pos={ni.name: c for c, (ni, _o, _n) in enumerate(cands)},
             victim_req=jnp.asarray(victim_req),
             victim_valid=jnp.asarray(victim_valid),
             spread=(None if spread is None
                     else type(spread)(*(jnp.asarray(x) for x in spread))),
             constraints=constraints)
+        plan.cand_na = cand_na
         prow = pod_row_from_table(ctx.builder.table, u)
         plan.base_packed = np.asarray(dry_run_select_victims(
-            ctx.state.device_arrays(), prow, plan.cand_idx,
+            cand_na if cand_na is not None else ctx.state.device_arrays(),
+            prow, plan.cand_idx,
             plan.victim_req, plan.victim_valid,
             np.zeros((c_pad, R), np.int64), np.zeros((c_pad,), np.int32),
             plan.spread))

@@ -1182,33 +1182,49 @@ def scatter_new_rows(gd_dev: GroupsDev, gc_dev: GroupCarry,
 # instead of one [U, SC, N] update per placement.
 
 
-def _dom_share(tv, dom, w):
+def _dom_share(tv, dom, w, axis=None, n_seg=None):
     """Σ_m w[m] over nodes m sharing n's topology value (tv ≠ 0 both
     sides) — the "same-topology-value broadcast" of group_update, batched
     over placements via the dense dom ids. tv/dom: int [..., N]; w: int
-    [..., N]; returns w's dtype [..., N]."""
+    [..., N]; returns w's dtype [..., N].
+
+    Sharded (`axis` set): the node dim is the LOCAL shard but `dom` holds
+    GLOBAL dense domain ids, so the segment accumulator is widened to
+    `n_seg` (a global bound) and all-reduced over `axis` before the
+    gather-back — integer adds, so bit-identical to the single-device
+    fold in any reduction order."""
     import jax
     import jax.numpy as jnp
 
     lead = tv.shape[:-1]
     n = tv.shape[-1]
+    width = n if n_seg is None else n_seg
     tv2 = tv.reshape(-1, n)
     dom2 = dom.reshape(-1, n)
     w2 = w.reshape(-1, n)
 
     def one(t, d, x):
-        seg = jnp.zeros((n,), x.dtype).at[d].add(jnp.where(t != 0, x, 0))
+        seg = jnp.zeros((width,), x.dtype).at[d].add(jnp.where(t != 0, x, 0))
+        if axis is not None:
+            seg = jax.lax.psum(seg, axis)
         return jnp.where(t != 0, seg[d], 0)
 
     return jax.vmap(one)(tv2, dom2, w2).reshape(*lead, n)
 
 
 def wave_fold(gd: GroupsDev, gc: GroupCarry, wt, cnt_sn,
-              fam: Optional[GroupFamilies] = None) -> GroupCarry:
+              fam: Optional[GroupFamilies] = None,
+              axis=None, n_seg=None) -> GroupCarry:
     """GroupCarry after a wave: `wt` i32 [S] are the wave's table rows and
     `cnt_sn` i32 [S, N] the accepted placement counts of each wave row per
     node. Exactly equals folding the placements through group_update one
-    by one, in any order (additivity; node labels static)."""
+    by one, in any order (additivity; node labels static).
+
+    Sharded (`axis` set, `n_seg` = global node bound): node-last inputs
+    are local shards; domain shares are all-reduced inside `_dom_share`
+    and the replicated `a_total` scalar sum is psum'd, so the per-node
+    carry shards fold exactly as the single-device path does."""
+    import jax
     import jax.numpy as jnp
 
     fam = fam or ALL_FAMILIES
@@ -1225,14 +1241,14 @@ def wave_fold(gd: GroupsDev, gc: GroupCarry, wt, cnt_sn,
         w_ucn = jnp.einsum("suc,sn->ucn", gd.m_spr_f[wt].astype(jnp.int32),
                            cnt32)
         add = _dom_share(gd.spr_f_tv, gd.spr_f_dom,
-                         w_ucn * gd.spr_f_elig)
+                         w_ucn * gd.spr_f_elig, axis, n_seg)
         spr_f_cnt = gc.spr_f_cnt + add
 
     if fam.spr_s:
         w_ucn = jnp.einsum("suc,sn->ucn", gd.m_spr_s[wt].astype(jnp.int32),
                            cnt32)
         topo = _dom_share(gd.spr_s_tv, gd.spr_s_dom,
-                          w_ucn * gd.spr_s_elig)
+                          w_ucn * gd.spr_s_elig, axis, n_seg)
         # hostname constraints count the chosen node's own pods, no
         # eligibility gate (group_update's is_host branch)
         spr_s_cnt = gc.spr_s_cnt + jnp.where(
@@ -1244,35 +1260,39 @@ def wave_fold(gd: GroupsDev, gc: GroupCarry, wt, cnt_sn,
         raa_dom_w = gd.ipa_raa_dom[wt]
         shared_st = _dom_share(
             raa_tv_w, raa_dom_w,
-            jnp.broadcast_to(cnt32[:, None, :], raa_tv_w.shape))
+            jnp.broadcast_to(cnt32[:, None, :], raa_tv_w.shape),
+            axis, n_seg)
         ipa_veto = gc.ipa_veto + jnp.einsum(
             "sut,stn->un", gd.m_ipa_exist[wt].astype(jnp.int32), shared_st)
         # incoming-anti counts: shared along the CONSUMER's term topology
         w_utn = jnp.einsum("sut,sn->utn", gd.m_ipa_aa[wt].astype(jnp.int32),
                            cnt32)
         ipa_aa_cnt = gc.ipa_aa_cnt + _dom_share(
-            gd.ipa_raa_tv, gd.ipa_raa_dom, w_utn)
+            gd.ipa_raa_tv, gd.ipa_raa_dom, w_utn, axis, n_seg)
 
     if fam.ipa_req:
         w_un = jnp.einsum("su,sn->un", gd.m_ipa_a[wt].astype(jnp.int32),
                           cnt32)
         ipa_a_cnt = gc.ipa_a_cnt + _dom_share(
             gd.ipa_ra_tv, gd.ipa_ra_dom,
-            w_un[:, None, :] * gd.ipa_ra_active[:, :, None])
+            w_un[:, None, :] * gd.ipa_ra_active[:, :, None], axis, n_seg)
         # a_total: each placement adds (# active consumer terms whose
         # topology key exists on the placed node) when it matches all of
         # the consumer's terms (group_update's tvb_a != 0 gate)
         k_un = jnp.sum(gd.ipa_ra_active[:, :, None]
                        & (gd.ipa_ra_tv != 0), axis=1)     # [U, N]
-        ipa_a_total = gc.ipa_a_total + jnp.einsum(
+        a_add = jnp.einsum(
             "un,un->u", w_un.astype(jnp.int64), k_un.astype(jnp.int64))
+        if axis is not None:
+            a_add = jax.lax.psum(a_add, axis)
+        ipa_a_total = gc.ipa_a_total + a_add
 
     if fam.ipa_score:
         # consumer-side preferred terms matching the placed pod
         wc_utn = jnp.einsum("sut,sn->utn", gd.w_stc[wt],
                             cnt_sn.astype(jnp.int64))
         cons_add = jnp.sum(_dom_share(gd.ipa_stc_tv, gd.ipa_stc_dom,
-                                      wc_utn), axis=1)    # [U, N]
+                                      wc_utn, axis, n_seg), axis=1)    # [U, N]
         # placed-side terms: share counts along the placed row's term
         # topology, then weight per consumer
         stp_tv_w = gd.ipa_stp_tv[wt]                       # [S, PT, N]
@@ -1280,7 +1300,8 @@ def wave_fold(gd: GroupsDev, gc: GroupCarry, wt, cnt_sn,
         shared_p = _dom_share(
             stp_tv_w, stp_dom_w,
             jnp.broadcast_to(cnt_sn.astype(jnp.int64)[:, None, :],
-                             stp_tv_w.shape))
+                             stp_tv_w.shape),
+            axis, n_seg)
         plcd_add = jnp.einsum("sut,stn->un", gd.w_stp[wt], shared_p)
         ipa_score = gc.ipa_score + cons_add + plcd_add
 

@@ -800,15 +800,18 @@ _PROBE_QS = (0.5, 0.9, 0.99)
 PROBE_TIGHT = 0.95
 
 
-@functools.partial(jax.jit, static_argnames=("ndom",))
-def _cluster_probe_jit(na: NodeArrays, carry: Carry, dom, ndom: int):
+def _probe_math(cap_in, valid, used_in, npods, dom, ndom: int):
+    """The probe reduction on plain arrays (cap i64 [N, R], valid bool
+    [N], used i64 [N, R], npods i32 [N], dom i32 [N]) — shared between
+    the single-device jit below and the mesh twin
+    (parallel/sharding.py cluster_probe_sharded), which all-gathers its
+    shards and runs these exact ops so the outputs stay bit-identical."""
     f32, i64 = jnp.float32, jnp.int64
-    valid = na.valid
     # a (node, resource) cell participates when the node is valid and
     # advertises capacity for the resource
-    part = valid[:, None] & (na.cap > 0)                        # bool [N, R]
-    used = jnp.where(part, carry.used, 0).astype(i64)           # i64 [N, R]
-    cap = jnp.where(part, na.cap, 0).astype(i64)                # i64 [N, R]
+    part = valid[:, None] & (cap_in > 0)                        # bool [N, R]
+    used = jnp.where(part, used_in, 0).astype(i64)              # i64 [N, R]
+    cap = jnp.where(part, cap_in, 0).astype(i64)                # i64 [N, R]
     util = jnp.where(part,
                      used.astype(f32) / jnp.maximum(cap, 1).astype(f32),
                      -1.0).astype(f32)                          # f32 [N, R]
@@ -863,7 +866,7 @@ def _cluster_probe_jit(na: NodeArrays, carry: Carry, dom, ndom: int):
     # scatter-adds; spread = max - min over populated domains
     dclip = jnp.clip(dom.astype(jnp.int32), 0, ndom - 1)
     dom_pods = jnp.zeros((ndom,), i64).at[dclip].add(
-        jnp.where(valid, carry.npods, 0).astype(i64))
+        jnp.where(valid, npods, 0).astype(i64))
     dom_nodes = jnp.zeros((ndom,), i64).at[dclip].add(valid.astype(i64))
     has = dom_nodes > 0
     load = jnp.where(has,
@@ -879,6 +882,12 @@ def _cluster_probe_jit(na: NodeArrays, carry: Carry, dom, ndom: int):
         jnp.where(any_dom, dmax - dmin, 0.0).astype(f32),
     ])                                                          # f32 [4]
     return per_res, dom_stats, jnp.sum(valid).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("ndom",))
+def _cluster_probe_jit(na: NodeArrays, carry: Carry, dom, ndom: int):
+    return _probe_math(na.cap, na.valid, carry.used, carry.npods, dom,
+                       ndom)
 
 
 def cluster_probe(na: NodeArrays, carry: Carry, dom, ndom: int):

@@ -92,8 +92,10 @@ class KernelRecord:
 # dashboards see the series before the first dispatch
 KERNELS = ("run_batch", "run_uniform", "run_wave", "run_wave_scan",
            "run_plan", "wave_statics", "diagnose", "dry_run",
-           "run_batch_sharded", "run_gang", "scatter_rows", "explain_row",
-           "cluster_probe")
+           "run_batch_sharded", "run_uniform_sharded", "run_plan_sharded",
+           "run_gang_sharded", "scatter_rows_sharded",
+           "cluster_probe_sharded", "run_gang",
+           "scatter_rows", "explain_row", "cluster_probe")
 
 # h2d phase labels, aligned with scheduler_drain_phase_seconds{phase}
 # where the transfer is paid (device_readback is the d2h direction of the

@@ -69,6 +69,11 @@ ENTRY_KERNELS = {
     "cluster_probe": "cluster_probe",
     "run_gang": "run_gang",
     "run_batch_sharded": "run_batch_sharded",
+    "run_uniform_sharded": "run_uniform_sharded",
+    "run_plan_sharded": "run_plan_sharded",
+    "run_gang_sharded": "run_gang_sharded",
+    "scatter_rows_sharded": "scatter_rows_sharded",
+    "cluster_probe_sharded": "cluster_probe_sharded",
 }
 
 

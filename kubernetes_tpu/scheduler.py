@@ -313,11 +313,12 @@ class Scheduler:
         explicitly passed arguments win over the config's values.
 
         `mesh` (a jax.sharding.Mesh) makes multi-chip first-class: every
-        device segment runs the node-axis-sharded program
-        (parallel/sharding.py run_batch_sharded) with XLA collectives over
-        ICI; the closed-form uniform path (single-device only) is gated
-        off. Decisions are bit-identical to single-device scheduling
-        (tests/test_sharding.py + the scheduler-level mesh test)."""
+        drain-plan span — scan buckets, the closed-form uniform tier,
+        speculative waves, gang dispatch and the batched preemption
+        dry-run — runs the node-axis-sharded program (parallel/sharding.py)
+        with XLA collectives over ICI. Decisions are bit-identical to
+        single-device scheduling (tests/test_sharding.py +
+        tests/test_sharded_mesh_parity.py)."""
         self.client = client
         self.clock = clock
         queue_backoffs = {}
@@ -540,9 +541,13 @@ class Scheduler:
         # sharded-lane profile (parallel/sharding.py profile_shard_lanes):
         # the first sharded dispatch stashes its inputs; the profile runs
         # ONCE after that drain commits (and on demand via
-        # profile_shard_lanes(force=True) or /debug/kernels?lanes=refresh)
+        # profile_shard_lanes(force=True) or /debug/kernels?lanes=refresh).
+        # shard_profile_auto=False defers the auto-run — the probe
+        # re-dispatches the scan-shaped program, so a throughput harness
+        # (bench.py) measures first and profiles after the clock stops
         self._shard_profile_args = None
         self._shard_profile_done = False
+        self.shard_profile_auto = True
 
         self.workload_manager = WorkloadManager(clock=clock)
         # pods parked at Permit (WaitOnPermit): uid -> _WaitingPodRec
@@ -613,14 +618,15 @@ class Scheduler:
             dp.extenders = tuple(prof.extenders)
             # batched device dry-run (SURVEY §7 step 8): the Evaluator's
             # candidate sweep runs as one gathered kernel against the
-            # tensorized state; single-device only (the gathered node rows
-            # live on one chip) and gated for config parity
-            if (mesh is None
-                    and self.feature_gates.enabled("BatchedPreemptionDryRun")):
+            # tensorized state; gated for config parity. On a mesh the
+            # candidate rows are gathered host-side into a compact
+            # single-device block (ISSUE 16) so the dry-run never mints a
+            # second full-matrix device copy next to the sharded one
+            if self.feature_gates.enabled("BatchedPreemptionDryRun"):
                 from .framework.preemption import DeviceDryRunContext
                 dp.device_ctx = DeviceDryRunContext(
                     state=self.state, builder=self.builder,
-                    snapshot=self.snapshot)
+                    snapshot=self.snapshot, mesh=mesh)
             dp.set_framework(fwk)
 
         self._register_event_handlers()
@@ -1257,6 +1263,7 @@ class Scheduler:
             self.dispatcher.flush()
         if (self._shard_profile_args is not None
                 and not self._shard_profile_done
+                and self.shard_profile_auto
                 and not self._pending):
             # one-shot sharded-lane profile (perf/observatory.py), off the
             # dispatch path: the first sharded drain armed it, the quiesced
@@ -1376,11 +1383,12 @@ class Scheduler:
         """Partition a profile stretch into whole-gang drains and the
         rest. A gang is extracted when the drain holds at least its
         remaining quorum of members and the group is device-eligible
-        (gates on, single device, no parked members, no volumes/claims —
-        the hook chain the atomic commit bypasses must be vacuous).
+        (gates on, no parked members, no volumes/claims — the hook chain
+        the atomic commit bypasses must be vacuous; mesh drains dispatch
+        through run_gang_sharded, ISSUE 16).
         Ineligible gangs stay in the generic flow: per-pod placement with
         the reference's Permit-barrier dance at commit."""
-        if (not self.gang_device_enabled or self.mesh is not None
+        if (not self.gang_device_enabled
                 or self.queue.nominator.nominated_pods
                 or not any(q.pod.spec.workload_ref for q in qpis)):
             return [], qpis
@@ -1766,7 +1774,15 @@ class Scheduler:
             # async copy window and resolves at commit
             with self.tracer.span("cluster_probe", drain=did):
                 dom = self._gang_domains(na, need=True)
-                probe = cluster_probe(na, carry, dom, self._gang_ndom)
+                if self.mesh is not None:
+                    # the mesh twin: feeding node-sharded inputs to the
+                    # single-device probe jit makes GSPMD reshard around
+                    # the cross-node sort — ~10× the whole probe's cost
+                    from .parallel.sharding import cluster_probe_sharded
+                    probe = cluster_probe_sharded(self.mesh, na, carry,
+                                                  dom, self._gang_ndom)
+                else:
+                    probe = cluster_probe(na, carry, dom, self._gang_ndom)
         self.journey.record_bulk([q.pod.uid for q in qpis], _EV_DRAIN,
                                  self.clock(), detail="device", drain=did)
         self._pending.append(_PendingDrain(
@@ -1918,18 +1934,19 @@ class Scheduler:
 
     def _node_arrays(self):
         """Device (or mesh-placed) node arrays, cached until the staging
-        generation moves (adopt_carry and every staging write bump it; the
-        single-device cache inside ClusterState has its own flag — the two
-        caches never share invalidation state)."""
+        generation moves (adopt_carry and every staging write bump it).
+        The resident copies live in ClusterState — device_arrays /
+        device_arrays_sharded — and a scheduler only ever uses one
+        flavor, so they share the dirty-row diff tracking."""
         if self.mesh is None:
             return self.state.device_arrays()
         if (self._na_sharded is None
                 or self._na_sharded_gen != self.state.staging_gen):
-            from .parallel.sharding import shard_node_arrays
             self.state.ensure_arrays()
             self._na_sharded_gen = self.state.staging_gen
-            self._na_sharded = shard_node_arrays(
-                self.mesh, self.state.arrays)
+            # generation-diff upload (ISSUE 16): small dirty sets ride
+            # scatter_rows_sharded instead of a full-matrix re-shard
+            self._na_sharded = self.state.device_arrays_sharded(self.mesh)
         return self._na_sharded
 
     def _cluster_has_prefer_taints(self) -> bool:
@@ -1944,8 +1961,7 @@ class Scheduler:
     # -- speculative wave placement (group drains) ----------------------------
 
     def _wave_enabled(self) -> bool:
-        return (self.mesh is None
-                and self.feature_gates.enabled("SpeculativeWavePlacement"))
+        return self.feature_gates.enabled("SpeculativeWavePlacement")
 
     def _device_plan(self, batch, n: int, profile: Profile):
         """The drain compiler's plan for this group drain under the
@@ -2031,6 +2047,14 @@ class Scheduler:
         has_groups = self._gd_dev is not None
         fam = self._gd_fam if has_groups else GroupFamilies(
             False, False, False, False, False)
+        if self.mesh is not None:
+            from .parallel.sharding import run_plan_sharded
+            carry2, packed = run_plan_sharded(
+                cfg, self.mesh, na, carry, xs, table,
+                jnp.asarray(np.array(wt_list, np.int32)), self._gd_dev,
+                statics, fam, norm_live, has_groups=has_groups,
+                has_ports=has_ports)
+            return carry2, packed, bucket
         carry2, packed = run_plan(
             cfg, na, carry, xs, table,
             jnp.asarray(np.array(wt_list, np.int32)), self._gd_dev,
@@ -2045,8 +2069,14 @@ class Scheduler:
         contiguity column: the node's interned zone label, or a unique
         per-node domain when unlabeled (contiguity then has no surface to
         prefer). Cached until node state moves; identity ids when the
-        contiguity weight is off (the kernel never reads them)."""
-        key = (self.state.staging_gen, na.used.shape[0])
+        contiguity weight is off (the kernel never reads them).
+
+        Keyed on statics_gen, not staging_gen: zone labels are static
+        columns, and the per-commit aggregate bumps that dominate
+        steady-state drains must not force the 5k-entry host rebuild
+        (the cluster probe reads this EVERY drain — a staging_gen key
+        made it one of the largest host costs of a sharded drain)."""
+        key = (self.state.statics_gen, na.used.shape[0])
         if self._gang_dom is not None and self._gang_dom_key == key:
             return self._gang_dom
         N = na.used.shape[0]
@@ -2095,10 +2125,17 @@ class Scheduler:
                 and self.feature_gates.enabled("OpportunisticBatching")
                 and not self._cluster_has_prefer_taints()
                 and not self.builder.table.pref_weight[uniq[0]].any()):
-            c2, packed = run_gang(cfg, na, carry, self._xone(batch, i),
-                                  table, needed=np.int32(needed),
-                                  uniform=True, n_actual=np.int32(m),
-                                  L=L, K=K, J=J)
+            if self.mesh is not None:
+                from .parallel.sharding import run_gang_sharded
+                c2, packed = run_gang_sharded(
+                    cfg, self.mesh, na, carry, self._xone(batch, i),
+                    table, needed=np.int32(needed), uniform=True,
+                    n_actual=np.int32(m), L=L, K=K, J=J)
+            else:
+                c2, packed = run_gang(cfg, na, carry, self._xone(batch, i),
+                                      table, needed=np.int32(needed),
+                                      uniform=True, n_actual=np.int32(m),
+                                      L=L, K=K, J=J)
             return c2, packed, L, True
         bucket = pow2_at_least(m)
         S = pow2_at_least(len(uniq), 1)
@@ -2121,11 +2158,19 @@ class Scheduler:
         # plan program — its per-dispatch cost collapses to the fit
         # columns + the member scan (ROADMAP item 3's remaining headroom)
         statics = self.compiler.surfaces.stacked(na, table, tuple(wt_list))
-        c2, packed = run_gang(
-            cfg, na, carry, xs, table,
-            wt=jnp.asarray(np.array(wt_list, np.int32)),
-            needed=np.int32(needed), dom=dom, statics=statics,
-            w_contig=w_contig)
+        if self.mesh is not None:
+            from .parallel.sharding import run_gang_sharded
+            c2, packed = run_gang_sharded(
+                cfg, self.mesh, na, carry, xs, table,
+                wt=jnp.asarray(np.array(wt_list, np.int32)),
+                needed=np.int32(needed), dom=dom, statics=statics,
+                w_contig=w_contig)
+        else:
+            c2, packed = run_gang(
+                cfg, na, carry, xs, table,
+                wt=jnp.asarray(np.array(wt_list, np.int32)),
+                needed=np.int32(needed), dom=dom, statics=statics,
+                w_contig=w_contig)
         return c2, packed, bucket, False
 
     def _dispatch_runs(self, profile: Profile, na, carry, batch, table,
@@ -2182,9 +2227,16 @@ class Scheduler:
             tag = kind[0]
             if tag == "uniform":
                 L, K, J = self._uniform_shape(na)
-                c2, packed = run_uniform(
-                    cfg, na, carry, self._xone(batch, i), table,
-                    np.int32(j - i), L, K, J, overlay=ovl)
+                if self.mesh is not None:
+                    # overlays never reach the mesh (_overlay_eligible)
+                    from .parallel.sharding import run_uniform_sharded
+                    c2, packed = run_uniform_sharded(
+                        cfg, self.mesh, na, carry, self._xone(batch, i),
+                        table, np.int32(j - i), L, K, J)
+                else:
+                    c2, packed = run_uniform(
+                        cfg, na, carry, self._xone(batch, i), table,
+                        np.int32(j - i), L, K, J, overlay=ovl)
                 records.append(_RunRec("uniform", i, j, carry, packed,
                                        L, J, True, span=kind))
             elif tag == "wave":
@@ -2214,7 +2266,38 @@ class Scheduler:
         for rec in records:
             if hasattr(rec.result, "copy_to_host_async"):
                 rec.result.copy_to_host_async()
+        if (self.mesh is not None and records
+                and self.observatory.enabled and not self._shard_profile_done
+                and self._shard_profile_args is None):
+            # arm the one-shot lane profile even when no span rode the
+            # scan (the mesh kernels displaced it, ISSUE 16): the probe
+            # times the scan-shaped program on a twin of the first span
+            self._arm_shard_profile(cfg, na, carry, batch,
+                                    records[0].i, records[0].j, table)
         return carry, records
+
+    def _arm_shard_profile(self, cfg: ScoreConfig, na, carry, batch,
+                           i: int, j: int, table) -> None:
+        """Arm the one-shot sharded-lane profile (perf/observatory.py)
+        with a scan-shaped PodXs twin of pods [i:j) — profile_shard_lanes
+        times run_batch_sharded's program, whatever kernel the span
+        itself rode, so the compute/comms/imbalance decomposition stays
+        comparable across dispatch tiers. The twin is capped at 1024
+        pods: the probe samples the per-step lane split, and an
+        uncapped twin of a 10^5-pod uniform span would re-dispatch a
+        10^5-step scan just to measure it."""
+        j = min(j, i + 1024)
+        bucket = pow2_at_least(j - i)
+        m = j - i
+        valid = np.zeros((bucket,), bool)
+        valid[:m] = batch.valid[i:j]
+        sig = np.full((bucket,), batch.sig[j - 1], np.int32)
+        sig[:m] = batch.sig[i:j]
+        tidx = np.full((bucket,), batch.tidx[j - 1], np.int32)
+        tidx[:m] = batch.tidx[i:j]
+        xs = PodXs(valid=valid, sig=sig, tidx=tidx)
+        self._shard_profile_args = (cfg, self.mesh, na, carry, xs, table,
+                                    self._gd_dev, self._gd_fam)
 
     # -- device-tier degradation (circuit breaker) ----------------------------
 
@@ -2862,9 +2945,16 @@ class Scheduler:
         J = j_failed
         while J < L + 1:
             J = min(8 * J, L + 1)
-            c2, packed = run_uniform(cfg, na, carry, self._xone(batch, i),
-                                     table, np.int32(j - i), L, K, J,
-                                     overlay=ovl)
+            if self.mesh is not None:
+                from .parallel.sharding import run_uniform_sharded
+                c2, packed = run_uniform_sharded(
+                    cfg, self.mesh, na, carry, self._xone(batch, i),
+                    table, np.int32(j - i), L, K, J)
+            else:
+                c2, packed = run_uniform(cfg, na, carry,
+                                         self._xone(batch, i), table,
+                                         np.int32(j - i), L, K, J,
+                                         overlay=ovl)
             r = np.asarray(packed)
             if r[L] and r[L + 1]:
                 out[i:j] = r[:j - i]

@@ -109,6 +109,11 @@ class ClusterState:
     _free: list[int] = field(default_factory=list)
     arrays: Optional[NodeArrays] = None  # numpy staging
     _device: Optional[NodeArrays] = None  # jax device copy (lazy)
+    # mesh-placed copy (lazy; ISSUE 16). A scheduler uses exactly ONE
+    # placement flavor — single-device or node-sharded — so the two
+    # resident copies share the consume-on-read dirty flag and the dirty
+    # row set without fighting over them.
+    _device_sharded: Optional[NodeArrays] = None
     _device_dirty: bool = True
     # monotonic generation of the STAGING arrays: bumped on every mutation
     # (snapshot writes, growth, adopt_carry) so external caches — e.g. the
@@ -452,6 +457,47 @@ class ClusterState:
             self._dirty_rows = set()
         return self._device
 
+    def device_arrays_sharded(self, mesh) -> NodeArrays:
+        """Mesh-placed copies with the SAME generation-diff upload policy
+        as `device_arrays` (ISSUE 16): when only a small set of rows moved
+        since the last refresh, ship just those rows through the
+        `scatter_rows_sharded` JIT entry — the H2D bytes are the small
+        replicated row block and each shard keeps only its own rows —
+        instead of re-sharding the full matrices. Mesh drains previously
+        paid the full-matrix upload on every staging change; this carries
+        the PR-9 columnar-ingest win onto the mesh."""
+        if self._device_sharded is None or self._device_dirty:
+            a = self.ensure_arrays()
+            from ..parallel.sharding import (scatter_rows_sharded,
+                                             shard_node_arrays)
+            dirty = self._dirty_rows
+            N = a.used.shape[0]
+            dev = self._device_sharded
+            if (dev is not None and dirty
+                    and dev.used.shape == a.used.shape
+                    and dev.label_key.shape == a.label_key.shape
+                    and dev.image_id.shape == a.image_id.shape
+                    and len(dirty) <= max(N >> self.scatter_shift, 32)):
+                idx = np.fromiter(dirty, np.int64, len(dirty))
+                idx.sort()
+                D = pow2_at_least(len(idx))
+                pidx = np.full((D,), idx[0], np.int64)
+                pidx[:len(idx)] = idx
+                rows = NodeArrays(*(x[pidx] for x in a))
+                self._device_sharded = scatter_rows_sharded(
+                    mesh, dev, pidx.astype(np.int32), rows)
+                self.rows_scattered_total += len(idx)
+                if self.metrics is not None:
+                    self.metrics.ingest_rows_scattered.inc(by=len(idx))
+            else:
+                self._device_sharded = shard_node_arrays(mesh, a)
+                self.full_uploads_total += 1
+                if self.metrics is not None:
+                    self.metrics.ingest_full_uploads.inc()
+            self._device_dirty = False
+            self._dirty_rows = set()
+        return self._device_sharded
+
     def adopt_carry(self, used, nonzero_used, npods, ports,
                     touched: Optional[dict[str, int]] = None) -> None:
         """After a batch, the scan's carry IS the new truth for the mutable
@@ -472,6 +518,11 @@ class ClusterState:
             self.row_gen.update(touched)
         if self._device is not None:
             self._device = self._device._replace(
+                used=used, nonzero_used=nonzero_used, npods=npods, ports=ports)
+        if self._device_sharded is not None:
+            # a mesh drain's carry arrays are already mesh-placed: adopt
+            # them in place, no re-upload
+            self._device_sharded = self._device_sharded._replace(
                 used=used, nonzero_used=nonzero_used, npods=npods, ports=ports)
 
     # -- divergence check (cache debugger analog) ----------------------------

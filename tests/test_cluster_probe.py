@@ -226,8 +226,9 @@ class TestClusterProbeRails:
 
 
 class TestProbeRegistration:
-    def test_thirteen_kernels_ledgered_and_sanitized(self):
+    def test_all_kernels_ledgered_and_sanitized(self):
         from kubernetes_tpu.analysis.jaxsan import ENTRY_POINTS
         from kubernetes_tpu.perf.ledger import KERNELS
-        assert "cluster_probe" in KERNELS and len(KERNELS) == 13
+        assert "cluster_probe" in KERNELS and len(KERNELS) == 18
+        assert "cluster_probe_sharded" in KERNELS
         assert "cluster_probe" in ENTRY_POINTS["kubernetes_tpu.ops.program"]

@@ -1,0 +1,455 @@
+"""Sharded-mesh drain toolchain == single-device toolchain (ISSUE 16).
+
+PR 8's drain compiler gave the single-device backend tiered execution
+(closed-form uniform, speculative waves, gang dispatch, batched
+preemption dry-run); the node-sharded mesh ran everything through the
+scan. This file is the acceptance gate for porting those tiers onto the
+mesh: for every drain kind the mesh scheduler must produce bind
+decisions BIT-IDENTICAL to the single-device scheduler — same pods on
+the same nodes, same rejections, same nominations — while actually
+dispatching the sharded kernels (asserted through the compile ledger,
+so a silent fallback to `run_batch_sharded` can't make the parity
+vacuous). The seeded fuzz sweeps mixed workloads across all kinds, and
+the shadow-oracle audit at 100% sampling closes the loop: the host
+oracle replays every mesh drain with zero divergence.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+if not hasattr(jax, "shard_map"):
+    try:
+        from jax.experimental.shard_map import shard_map as _probe  # noqa: F401
+    except ImportError:
+        pytest.skip("no shard_map API in this jax build",
+                    allow_module_level=True)
+
+from kubernetes_tpu.api.types import ObjectMeta, PodGroup, Workload
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.parallel.sharding import make_mesh
+from kubernetes_tpu.perf.ledger import GLOBAL as LEDGER
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 4,
+                                reason="not enough virtual devices")
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _kcalls(name):
+    rec = LEDGER.kernels.get(name)
+    return rec.calls if rec is not None else 0
+
+
+def _sched(api, mesh, batch_size=64):
+    clock = Clock()
+    s = Scheduler(api, batch_size=batch_size, clock=clock, mesh=mesh)
+    s.dispatcher.sleep = lambda _s: None
+    s._clock = clock
+    return s
+
+
+def _nodes(api, n, zones=3, rng=None, soft_taints=True):
+    """Deliberately heterogeneous across the node axis (same shape as
+    tests/test_sharding.py build_state): capacities, zones, escalating
+    PreferNoSchedule taints and an ssd label band all vary by node index,
+    so shard-local normalization or a shard-local top-K would change
+    decisions. `soft_taints=False` keeps the cluster closed-form
+    eligible (prefer-taints bar the uniform tier entirely)."""
+    rng = rng or np.random.RandomState(7)
+    for i in range(n):
+        w = (make_node(f"n{i}")
+             .capacity({"cpu": int(rng.randint(4, 16)),
+                        "memory": f"{rng.randint(8, 32)}Gi", "pods": 110})
+             .zone(f"z{i % zones}")
+             .label("kubernetes.io/hostname", f"n{i}"))
+        if soft_taints:
+            for t in range(i * 3 // max(n, 1)):
+                w = w.taint(f"soft{t}", "x", "PreferNoSchedule")
+        if i % 4 == 1:
+            w = w.label("disk", "ssd")
+        api.create_node(w.obj())
+
+
+def _binds(api):
+    inner = getattr(api, "inner", api)
+    return {p.metadata.name: (p.spec.node_name,
+                              p.status.nominated_node_name)
+            for p in inner.pods.values()}
+
+
+def _settle(api, sched, rounds=4):
+    total = sched.schedule_pending()
+    for _ in range(rounds):
+        sched._clock.t += 400.0
+        sched.flush_queues()
+        total += sched.schedule_pending()
+    return total
+
+
+# ---------------------------------------------------------------------------
+# per-tier parity, each asserting its sharded kernel actually dispatched
+
+
+class TestTierParity:
+    def test_uniform_tier_parity(self):
+        """One signature × 32 pods ≥ uniform_min: the closed-form uniform
+        tier — previously single-device-only — must run its sharded twin
+        and bind identically. Heterogeneous capacities make the
+        shard-local top-K union argument load-bearing (per-shard maxima
+        differ from the global ranking)."""
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            _nodes(api, 24, soft_taints=False)
+            for i in range(32):
+                api.create_pod(
+                    make_pod(f"p{i}")
+                    .req({"cpu": "500m", "memory": "512Mi"})
+                    .obj())
+            before = _kcalls("run_uniform_sharded")
+            assert sched.schedule_pending() == 32
+            if mesh is not None:
+                assert _kcalls("run_uniform_sharded") > before
+            assert sched.reconcile() == []
+            return _binds(api)
+
+        assert run(None) == run(make_mesh(4))
+
+    def test_wavescan_tier_parity(self):
+        """Interleaved signatures over a ≥ wave_min_span window: the
+        speculative wave (wavescan flavor — the merge wave stays
+        single-device) must plan on the mesh and bind identically."""
+        def run(mesh, grouped):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            _nodes(api, 16)
+            for i in range(32):
+                w = make_pod(f"p{i}").req(
+                    {"cpu": f"{250 * (1 + i % 4)}m", "memory": "512Mi"})
+                if grouped and i % 4 == 0:
+                    w = w.label("app", "s").spread_constraint(
+                        2, ZONE, "DoNotSchedule", {"app": "s"})
+                api.create_pod(w.obj())
+            before = _kcalls("run_plan_sharded")
+            bound = sched.schedule_pending()
+            if mesh is not None:
+                assert _kcalls("run_plan_sharded") > before
+            assert sched.reconcile() == []
+            return bound, _binds(api)
+
+        for grouped in (False, True):
+            single = run(None, grouped)
+            sharded = run(make_mesh(4), grouped)
+            assert single == sharded, f"grouped={grouped}"
+            assert single[0] == 32
+
+    def test_wavescan_ports_parity(self):
+        """Host-port pods thread the port-conflict surface through the
+        sharded wave: first-come wins, duplicates stay pending — same
+        verdicts as single-device."""
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            _nodes(api, 8)
+            for i in range(28):
+                w = make_pod(f"p{i}").req(
+                    {"cpu": f"{250 * (1 + i % 3)}m", "memory": "256Mi"})
+                if i % 3 == 0:
+                    w = w.host_port(8000 + i % 2)
+                api.create_pod(w.obj())
+            sched.schedule_pending()
+            assert sched.reconcile() == []
+            return _binds(api)
+
+        assert run(None) == run(make_mesh(4))
+
+    def test_gang_uniform_tier_parity(self):
+        """A same-signature gang takes the closed-form gang tier; the
+        whole-gang accept verdict and every member placement must match
+        single-device, in one sharded dispatch."""
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            _nodes(api, 8)
+            api.create_workload(Workload(
+                metadata=ObjectMeta(name="train"),
+                pod_groups=[PodGroup(name="workers", min_count=12)]))
+            for i in range(12):
+                api.create_pod(make_pod(f"train-{i}")
+                               .req({"cpu": "1", "memory": "1Gi"})
+                               .workload("train").obj())
+            before = _kcalls("run_gang_sharded")
+            bound = sched.schedule_pending()
+            if mesh is not None:
+                assert _kcalls("run_gang_sharded") > before
+            assert sched.reconcile() == []
+            return bound, _binds(api)
+
+        single = run(None)
+        assert single == run(make_mesh(4))
+        assert single[0] == 12
+
+    def test_gang_scan_tier_parity_with_contiguity(self):
+        """Mixed-signature gang members force the gang scan tier; a
+        nonzero contiguity weight engages the replicated domain counter
+        (the psum-broadcast domcnt) — placements must still match."""
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            sched.gang_contiguity_weight = 3
+            _nodes(api, 12, zones=3)
+            api.create_workload(Workload(
+                metadata=ObjectMeta(name="mix"),
+                pod_groups=[PodGroup(name="workers", min_count=8)]))
+            for i in range(8):
+                api.create_pod(make_pod(f"mix-{i}")
+                               .req({"cpu": f"{1 + i % 3}", "memory": "1Gi"})
+                               .workload("mix").obj())
+            before = _kcalls("run_gang_sharded")
+            bound = sched.schedule_pending()
+            if mesh is not None:
+                assert _kcalls("run_gang_sharded") > before
+            assert sched.reconcile() == []
+            return bound, _binds(api)
+
+        single = run(None)
+        assert single == run(make_mesh(4))
+        assert single[0] == 8
+
+    def test_gang_reject_atomic_on_mesh(self):
+        """An infeasible gang rejected by the sharded tier binds nothing,
+        parks nothing and holds nothing — the single-device atomicity
+        contract, unchanged by the mesh."""
+        api = APIServer()
+        sched = _sched(api, make_mesh(4))
+        for i in range(2):
+            api.create_node(make_node(f"n{i}").capacity(
+                {"cpu": 1, "memory": "16Gi", "pods": 110}).obj())
+        api.create_workload(Workload(
+            metadata=ObjectMeta(name="big"),
+            pod_groups=[PodGroup(name="workers", min_count=3)]))
+        for i in range(3):
+            api.create_pod(make_pod(f"big-{i}")
+                           .req({"cpu": "1", "memory": "1Gi"})
+                           .workload("big").obj())
+        assert sched.schedule_pending() == 0
+        assert api.binding_count == 0
+        assert not sched._waiting_pods
+        assert not sched.cache.assumed_pods
+
+    def test_preemption_dry_run_parity(self):
+        """A saturated cluster + a high-priority preemptor: the batched
+        dry-run gathers candidate rows host-side under a mesh (the kernel
+        is row-local) — victim choice and nomination must match the
+        single-device batched path."""
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            for i in range(4):
+                api.create_node(make_node(f"n{i}")
+                                .capacity({"cpu": 4, "memory": "16Gi",
+                                           "pods": 110})
+                                .zone(f"z{i % 2}").obj())
+            uid = 0
+            for i in range(4):
+                for pr in (0, 5, 10):
+                    p = (make_pod(f"v{uid}").req({"cpu": "1",
+                                                  "memory": "1Gi"})
+                         .priority(pr).label("app", "a").obj())
+                    api.create_pod(p)
+                    api.bind(p, f"n{i}")
+                    uid += 1
+            api.create_pod(make_pod("preemptor")
+                           .req({"cpu": "2", "memory": "2Gi"})
+                           .priority(100).obj())
+            before = _kcalls("dry_run")
+            _settle(api, sched)
+            assert _kcalls("dry_run") > before
+            return _binds(api)
+
+        assert run(None) == run(make_mesh(4))
+
+
+class TestShardedScatter:
+    def test_dirty_row_scatter_exact_at_shard_boundaries(self):
+        """Regression: out-of-shard dirty indices used to clip in-range
+        and collide with real writes at each shard's boundary rows — XLA
+        scatter picks an arbitrary duplicate winner, silently dropping
+        updates. Scatter every boundary row plus pad duplicates; the
+        sharded copy must equal the host staging exactly."""
+        from kubernetes_tpu.backend.cache import Cache, Snapshot
+        from kubernetes_tpu.parallel.sharding import (scatter_rows_sharded,
+                                                      shard_node_arrays)
+        from kubernetes_tpu.state.tensorize import ClusterState, NodeArrays
+
+        cache = Cache()
+        for i in range(16):
+            cache.add_node(make_node(f"n{i}").capacity(
+                {"cpu": 8, "memory": "16Gi", "pods": 110}).obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        state = ClusterState()
+        state.apply_snapshot(snap, full=True)
+        a = state.ensure_arrays()
+        mesh = make_mesh(4)
+        dev = shard_node_arrays(mesh, a)
+        # mutate the host rows the scatter must carry over: every shard
+        # boundary (first/last row of each 4-row shard)
+        idx = np.array([0, 3, 4, 7, 8, 11, 12, 15], np.int64)
+        for r in idx:
+            a.used[r] = r + 1
+            a.npods[r] = 2 * r + 1
+        D = 16  # pow2 pad, repeating idx[0] (the production pad rule)
+        pidx = np.full((D,), idx[0], np.int64)
+        pidx[:len(idx)] = idx
+        rows = NodeArrays(*(x[pidx] for x in a))
+        out = scatter_rows_sharded(mesh, dev, pidx.astype(np.int32), rows)
+        np.testing.assert_array_equal(np.asarray(out.used), a.used)
+        np.testing.assert_array_equal(np.asarray(out.npods), a.npods)
+        np.testing.assert_array_equal(np.asarray(out.cap), a.cap)
+
+
+class TestShardedClusterProbe:
+    def test_probe_bit_parity_mesh_vs_single(self):
+        """cluster_probe_sharded all-gathers the node shards and runs
+        the identical reduction: every output element must equal the
+        single-device probe bit-for-bit (which test_cluster_probe.py in
+        turn holds against a numpy oracle)."""
+        from kubernetes_tpu.backend.cache import Cache, Snapshot
+        from kubernetes_tpu.ops.program import cluster_probe, initial_carry
+        from kubernetes_tpu.parallel.sharding import (cluster_probe_sharded,
+                                                      shard_node_arrays)
+        from kubernetes_tpu.state.tensorize import ClusterState, NodeArrays
+
+        rng = np.random.RandomState(29)
+        cache = Cache()
+        for i in range(16):
+            cache.add_node(make_node(f"n{i}").capacity(
+                {"cpu": int(rng.randint(4, 32)),
+                 "memory": f"{int(rng.randint(8, 64))}Gi",
+                 "pods": 110}).obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        state = ClusterState()
+        state.apply_snapshot(snap, full=True)
+        a = state.ensure_arrays()
+        # non-trivial carry: random usage on a third of the rows
+        for r in range(0, 16, 3):
+            a.used[r, 0] = min(int(a.cap[r, 0]), r + 1)
+            a.npods[r] = r
+        import jax.numpy as jnp
+        dev_single = NodeArrays(*(jnp.asarray(x) for x in a))
+        carry = initial_carry(dev_single)
+        mesh = make_mesh(4)
+        dev = shard_node_arrays(mesh, a)
+        scarry = initial_carry(dev)
+        dom = np.asarray(rng.randint(0, 3, size=a.cap.shape[0]), np.int32)
+        single = cluster_probe(dev_single, carry, jnp.asarray(dom), 3)
+        sharded = cluster_probe_sharded(mesh, dev, scarry,
+                                        jnp.asarray(dom), 3)
+        for got, want in zip(sharded, single):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+        assert _kcalls("cluster_probe_sharded") > 0
+
+
+# ---------------------------------------------------------------------------
+# seeded fuzz across drain kinds
+
+
+def _fuzz_workload(api, rng):
+    """A mixed drain: a uniform run, interleaved wave signatures, spread
+    groups, a gang and a preemptor — every tier in one queue."""
+    _nodes(api, rng.randint(8, 20), zones=rng.randint(2, 4),
+           rng=np.random.RandomState(rng.randint(0, 1000)))
+    n_uni = rng.randint(16, 24)
+    for i in range(n_uni):
+        api.create_pod(make_pod(f"u{i}")
+                       .req({"cpu": "250m", "memory": "256Mi"}).obj())
+    for i in range(rng.randint(24, 32)):
+        w = make_pod(f"w{i}").req(
+            {"cpu": f"{250 * (1 + i % rng.randint(2, 5))}m",
+             "memory": "256Mi"})
+        if i % 5 == 0:
+            w = w.label("app", "s").spread_constraint(
+                rng.randint(1, 3), ZONE, "DoNotSchedule", {"app": "s"})
+        if i % 7 == 0:
+            w = w.preferred_node_affinity_in("disk", ["ssd"],
+                                             weight=rng.randint(1, 10))
+        api.create_pod(w.obj())
+    if rng.random() < 0.7:
+        size = rng.randint(3, 8)
+        api.create_workload(Workload(
+            metadata=ObjectMeta(name="g"),
+            pod_groups=[PodGroup(name="workers",
+                                 min_count=rng.randint(2, size + 1))]))
+        for i in range(size):
+            api.create_pod(make_pod(f"g-{i}")
+                           .req({"cpu": "500m", "memory": "512Mi"})
+                           .workload("g").obj())
+    if rng.random() < 0.5:
+        api.create_pod(make_pod("pre")
+                       .req({"cpu": "2", "memory": "2Gi"})
+                       .priority(100).obj())
+
+
+class TestSeededFuzzParity:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_mixed_drain_kinds_bit_identical(self, seed):
+        def run(mesh):
+            api = APIServer()
+            sched = _sched(api, mesh)
+            _fuzz_workload(api, random.Random(seed))
+            _settle(api, sched)
+            assert sched.reconcile() == []
+            return _binds(api)
+
+        assert run(None) == run(make_mesh(4)), f"seed={seed}"
+
+
+# ---------------------------------------------------------------------------
+# the independent referee: host-oracle replay of every mesh drain
+
+
+class TestShadowOracleOnMesh:
+    def test_zero_divergence_at_full_sampling(self):
+        """Every non-gang mesh drain (uniform, wavescan, scan, preemption
+        overlays excluded by capture rules) replayed synchronously by the
+        host oracle: zero divergence across assignment, reason and
+        verdict — the ISSUE 16 acceptance line."""
+        api = APIServer()
+        sched = _sched(api, make_mesh(4))
+        assert sched.audit is not None
+        sched.audit.sample_rate = 1.0
+        sched.audit.synchronous = True
+        _nodes(api, 16)
+        for i in range(32):  # uniform drain
+            api.create_pod(make_pod(f"u{i}")
+                           .req({"cpu": "250m", "memory": "256Mi"}).obj())
+        assert sched.schedule_pending() == 32
+        for i in range(28):  # wavescan drain
+            w = make_pod(f"w{i}").req(
+                {"cpu": f"{250 * (1 + i % 4)}m", "memory": "256Mi"})
+            if i % 4 == 0:
+                w = w.label("app", "s").spread_constraint(
+                    2, ZONE, "DoNotSchedule", {"app": "s"})
+            api.create_pod(w.obj())
+        assert sched.schedule_pending() == 28
+        m = sched.metrics
+        assert m.shadow_audit_drains.value("clean") >= 2
+        assert m.shadow_audit_drains.value("divergent") == 0
+        for kind in ("assignment", "reason", "verdict"):
+            assert m.oracle_divergence.value(kind) == 0

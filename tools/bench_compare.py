@@ -28,6 +28,11 @@ and fails (exit 2) on:
     regressing inside the device phase gates even when the workload's
     aggregate throughput hides it. Skipped for kernels absent on either
     side, and for sub-bucket jitter (<0.05 ms absolute growth);
+  * sharded-lane growth >30% (the `lanes` summary block from
+    profile_shard_lanes, recorded for the Sharded* cases since r10):
+    comms share or lane-time imbalance regressing means the mesh port is
+    sliding back toward collective-bound dispatch. Skipped when either
+    side lacks the profile;
   * with --slo: any burn-rate breach recorded in the candidate's per-
     workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
     nonzero shadow-oracle divergence — a bench run whose decisions
@@ -81,6 +86,12 @@ MAX_KERNEL_P99_GROWTH = 0.30
 # histogram buckets (~sqrt(2) quantile resolution), so growth below this
 # many ms never gates
 MIN_KERNEL_P99_MS = 0.05
+# sharded-lane gate (ISSUE 16): profile_shard_lanes' decomposition rides
+# the summary `lanes` block of the Sharded* cases since r10. Comms share
+# or lane-time imbalance growing past this fraction means the mesh port
+# is sliding back toward collective-bound dispatch even when throughput
+# noise hides it. Skipped when either side lacks the profile.
+MAX_LANE_GROWTH = 0.30
 
 # per-workload noise thresholds (throughput drop), keyed by case-name
 # prefix: the group/preemption workloads' measured passes jitter ±20%
@@ -100,9 +111,11 @@ NOISE = {
     # other group workloads
     "GangTraining": 0.30,
     "CoLocatedInference": 0.30,
-    # the 8-virtual-device CPU mesh case (r09+): subprocess scheduling
-    # over XLA host-platform shards jitters with machine load
+    # the 8-virtual-device CPU mesh cases (ShardedBasic r09+, ShardedGang
+    # and the 50k tier r10+): subprocess scheduling over XLA
+    # host-platform shards jitters with machine load
     "ShardedBasic": 0.30,
+    "ShardedGang": 0.30,
 }
 
 SKIP_PREFIXES = ("Sharded_",)
@@ -235,6 +248,20 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
                     f"({growth:+.1%}, gate +{MAX_HOST_SHARE_GROWTH:.0%})")
             if growth > MAX_HOST_SHARE_GROWTH:
                 failures.append(f"HOST PHASE SHARE REGRESSION {line}")
+            report.append(line)
+        b_l = b.get("lanes") or {}
+        n_l = n.get("lanes") or {}
+        for field, label in (("commsShare", "comms share"),
+                             ("imbalanceRatio", "lane imbalance")):
+            b_v = float(b_l.get(field) or 0.0)
+            n_v = float(n_l.get(field) or 0.0)
+            if b_v <= 0 or n_v <= 0:
+                continue
+            growth = n_v / b_v - 1.0
+            line = (f"{w}: {label} {b_v:.4f} -> {n_v:.4f} "
+                    f"({growth:+.1%}, gate +{MAX_LANE_GROWTH:.0%})")
+            if growth > MAX_LANE_GROWTH:
+                failures.append(f"SHARDED LANE REGRESSION {line}")
             report.append(line)
         b_k = b.get("kernels") or {}
         n_k = n.get("kernels") or {}

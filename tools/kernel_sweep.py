@@ -22,6 +22,10 @@ Knobs (see KNOBS for the sweep lattices):
   scatter_shift   dirty-row scatter threshold: scatter when
                   dirty ≤ max(N >> shift, 32), else full upload
                   (state/tensorize.py ClusterState.scatter_shift)
+  mesh_lanes      node-axis shard count: 0 = single device, else a
+                  1-D mesh over that many devices — every drain runs
+                  the sharded toolchain (parallel/sharding.py); lane
+                  counts the host can't satisfy degrade to 0
 
 Usage:
 
@@ -50,9 +54,30 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
+def _mesh_ctor(value):
+    """Scheduler ctor kwargs for a `mesh_lanes` sweep point: a 1-D
+    node-axis mesh over `value` devices, or single-device when the value
+    is 0, the host lacks the devices, or the jax build has no shard_map
+    (the point still measures — it just ranks the unsharded baseline)."""
+    v = int(value)
+    if v < 2:
+        return {}
+    import jax
+    if len(jax.devices()) < v:
+        return {}
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map  # noqa: F401
+        except ImportError:
+            return {}
+    from kubernetes_tpu.parallel.sharding import make_mesh
+    return {"mesh": make_mesh(v)}
+
+
 # knob name → (sweep lattice, how to apply the value). `ctor` knobs pass
-# through the Scheduler constructor; `post` knobs mutate the fresh
-# instance before the first drain (all are consulted per drain).
+# through the Scheduler constructor (`ctor_map` computes the kwargs from
+# the value); `apply` knobs mutate the fresh instance before the first
+# drain (all are consulted per drain).
 KNOBS = {
     "wave_min_span": {
         "values": (8, 24, 64, 128),
@@ -75,6 +100,11 @@ KNOBS = {
         "default": 3,
         "apply": lambda sched, v: setattr(sched.state, "scatter_shift",
                                           int(v)),
+    },
+    "mesh_lanes": {
+        "values": (0, 2, 4),
+        "default": 0,
+        "ctor_map": _mesh_ctor,
     },
 }
 
@@ -113,7 +143,12 @@ def _feed(api, pods: int, spread_frac: float = 0.25) -> None:
 
 def run_point(knob: str, value, nodes: int, pods: int) -> dict:
     spec = KNOBS[knob]
-    ctor_kw = {knob: value} if spec.get("ctor") else {}
+    if spec.get("ctor_map"):
+        ctor_kw = spec["ctor_map"](value)
+    elif spec.get("ctor"):
+        ctor_kw = {knob: value}
+    else:
+        ctor_kw = {}
     api, sched = _build(nodes, **ctor_kw)
     if "apply" in spec:
         spec["apply"](sched, value)
