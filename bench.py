@@ -262,6 +262,91 @@ def ha_failover_case(nodes: int) -> dict:
     }
 
 
+def multi_shard_case(nodes: int, pods: int) -> dict:
+    """Sharded control plane (ISSUE 17): N=4 fenced scheduler instances
+    over ONE cluster, each draining its namespace slice under its own
+    shard lease, with a forced mid-run steal. Reports AGGREGATE pods/s
+    (it lands in the summary block) plus the handoff latency extras and
+    the `shard` proof block — zero double-binds, zero shadow-oracle
+    divergence — that tools/bench_compare.py gates under --slo."""
+    import time as _t
+    from kubernetes_tpu.backend.apiserver import APIServer
+    from kubernetes_tpu.ha.shards import ShardManager, ShardScheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    n_shards = 4
+    t = {"now": 0.0}
+    clock = lambda: t["now"]                                  # noqa: E731
+    api = APIServer()
+    for i in range(nodes):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": 32, "memory": "64Gi", "pods": 110})
+            .zone(f"z{i % 16}").obj())
+    insts = []
+    for i in range(n_shards):
+        inst = ShardScheduler(api, identity=f"bench-shard-{i}",
+                              clock=clock, batch_size=256)
+        if inst.scheduler.audit is not None:
+            inst.scheduler.audit.sample_rate = 1.0
+        inst.scheduler.dispatcher.sleep = lambda _s: None
+        insts.append(inst)
+    mgr = ShardManager(api, instances=insts, clock=clock)
+    mgr.wire_ledgers()
+    mgr.split(n_shards, owners={i: insts[i] for i in range(n_shards)},
+              assignments={f"default-scheduler/ns-{i}": i
+                           for i in range(n_shards)})
+    for i in range(pods):
+        api.create_pod(make_pod(f"ms-pod-{i}", namespace=f"ns-{i % n_shards}")
+                       .req({"cpu": "900m", "memory": "1Gi"}).obj())
+
+    rebalance_dts = []
+    t0 = _t.perf_counter()
+    for round_no in range(200):
+        for inst in insts:
+            inst.tick()
+            inst.scheduler.schedule_pending()
+            t["now"] += 5.0
+            inst.scheduler.flush_queues()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if round_no == 0 and bound < pods:
+            # mid-run handoff: shard 3's slice steals over to instance 0
+            rebalance_dts.append(mgr.steal(3, insts[0]))
+        if bound >= pods:
+            break
+    wall_s = _t.perf_counter() - t0
+
+    bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+    divergence = 0
+    for inst in insts:
+        if inst.scheduler.audit is not None:
+            inst.scheduler.audit.flush()
+        m = inst.scheduler.metrics
+        divergence += sum(int(m.oracle_divergence.value(kind))
+                          for kind in ("assignment", "reason", "verdict"))
+    rebalance_dts.sort()
+    return {
+        "value": round(bound / wall_s, 1) if wall_s else 0.0,
+        "pods": bound, "nodes": nodes, "shards": n_shards,
+        "steals": mgr.steals,
+        "rebalance_p50_ms": round(
+            rebalance_dts[len(rebalance_dts) // 2] * 1e3, 2)
+        if rebalance_dts else 0.0,
+        "rebalance_max_ms": round(rebalance_dts[-1] * 1e3, 2)
+        if rebalance_dts else 0.0,
+        "cross_shard_conflicts": sum(i.conflicts for i in insts),
+        # the chaos-matrix proof, bench-shaped: bench_compare --slo
+        # fails on ANY double-bind or shadow-oracle divergence
+        "shard": {
+            "double_binds": api.binding_count - bound,
+            "divergence": divergence,
+            "ledgers_verified": all(
+                i.audit_ledger() is not None
+                and i.audit_ledger().verify()
+                and i.audit_ledger().verify_handoffs() for i in insts),
+        },
+    }
+
+
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -395,6 +480,19 @@ def main() -> None:
             results[f"HAFailover_{nodes}Nodes_FAILED"] = {
                 "error": str(e)[:200]}
 
+    if not case_filter or "MultiShardBasic" in case_filter:
+        # the sharded control plane (ISSUE 17 / ROADMAP item 4): 4
+        # fenced instances over one cluster with a mid-run steal; lands
+        # in the summary (aggregate pods/s) and carries the `shard`
+        # zero-double-bind/zero-divergence block for the --slo gate
+        nodes, pods = (500, 512) if small else (5000, 4096)
+        try:
+            results[f"MultiShardBasic_{nodes}Nodes"] = \
+                multi_shard_case(nodes, pods)
+        except Exception as e:   # the probe must not sink the headline
+            results[f"MultiShardBasic_{nodes}Nodes_FAILED"] = {
+                "error": str(e)[:200]}
+
     if not results:
         raise SystemExit(f"--cases {args.cases!r} matched no case")
 
@@ -445,6 +543,10 @@ def main() -> None:
             # share + imbalance ratio, the decomposition bench_compare's
             # sharded-lane gate regresses on ({} for unsharded cases)
             "lanes": entry.get("lanes", {}),
+            # sharded-control-plane proof block (ISSUE 17): double-bind
+            # and divergence counts bench_compare's --slo gate fails on
+            # ({} for single-instance cases)
+            "shard": entry.get("shard", {}),
         }
 
     head_key = next(iter(results))

@@ -12,6 +12,7 @@ interface shape stays.
 from __future__ import annotations
 
 import dataclasses
+import zlib as _zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -88,6 +89,29 @@ class Lease:
 
 
 @dataclass
+class ShardMap:
+    """The control plane's shard topology: which scheduler shard owns
+    which profile/namespace slice of the pod stream. Stored as ONE
+    versioned API object (optimistic concurrency on `version`, writes
+    fenced by the writer's lease generation) so every instance converges
+    on the same answer to "whose pod is this?" — the assignment map IS
+    the cross-shard routing table. Keys are `scheduler_name/namespace`;
+    unknown keys fall back to a stable hash so new tenants land
+    deterministically on the same shard from every instance."""
+
+    num_shards: int = 1
+    assignments: dict[str, int] = field(default_factory=dict)
+    version: int = 0
+
+    def shard_for(self, key: str) -> int:
+        sid = self.assignments.get(key)
+        if sid is not None and 0 <= sid < self.num_shards:
+            return sid
+        # process-independent fallback (hash() is salted per process)
+        return _zlib.crc32(key.encode("utf-8")) % max(1, self.num_shards)
+
+
+@dataclass
 class WatchHandlers:
     """The informer event-handler triple (client-go ResourceEventHandler).
     `on_add_bulk` is an optional batch form consumed by create_pods —
@@ -117,6 +141,7 @@ class APIServer:
     resource_slices: dict[str, ResourceSlice] = field(default_factory=dict)
     resource_claims: dict[str, ResourceClaim] = field(default_factory=dict)
     leases: dict[str, Lease] = field(default_factory=dict)
+    shard_map: Optional[ShardMap] = None
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
     workload_handlers: list[WatchHandlers] = field(default_factory=list)
@@ -179,19 +204,65 @@ class APIServer:
         lease.holder_identity = ""
         lease.renew_time = 0.0
 
-    def check_fence(self, fence_token: Optional[int],
-                    name: str = LEASE_NAME) -> None:
+    def check_fence(self, fence_token, name: str = LEASE_NAME) -> None:
         """Reject a write stamped with a stale lease generation. `None`
         passes (unfenced legacy writes); a token only fails once a NEWER
-        holder has acquired, so single-leader operation never pays."""
+        holder has acquired, so single-leader operation never pays.
+
+        Three token forms (the sharded control plane spans leases):
+          * int — legacy, checked against the `name` lease;
+          * (lease_name, generation) — one explicit lease;
+          * tuple of such pairs — a bulk batch spanning shard leases;
+            EVERY pair must be current or the whole write is fenced.
+        """
         if fence_token is None:
             return
-        lease = self.leases.get(name)
-        if lease is not None and fence_token != lease.generation:
-            self.fenced_rejections += 1
-            raise FencedWrite(
-                f"write fenced: token {fence_token} != lease generation "
-                f"{lease.generation} (holder {lease.holder_identity!r})")
+        if isinstance(fence_token, int):
+            pairs = ((name, fence_token),)
+        elif fence_token and isinstance(fence_token[0], str):
+            pairs = (fence_token,)
+        else:
+            pairs = tuple(fence_token)
+        for lname, gen in pairs:
+            lease = self.leases.get(lname)
+            if lease is not None and gen != lease.generation:
+                self.fenced_rejections += 1
+                raise FencedWrite(
+                    f"write fenced: token {gen} != lease {lname!r} "
+                    f"generation {lease.generation} "
+                    f"(holder {lease.holder_identity!r})")
+
+    # -- shard assignment map (sharded control plane) -------------------------
+
+    def get_shard_map(self) -> "ShardMap":
+        """Snapshot of the cluster's shard assignment map (a fresh copy —
+        callers mutate a draft, then race it back through put_shard_map's
+        optimistic-concurrency check). An absent map reads as the trivial
+        single-shard map at version 0."""
+        cur = self.shard_map
+        if cur is None:
+            return ShardMap()
+        return ShardMap(num_shards=cur.num_shards,
+                        assignments=dict(cur.assignments),
+                        version=cur.version)
+
+    def put_shard_map(self, new: "ShardMap", expect_version: int,
+                      fence_token=None) -> "ShardMap":
+        """Compare-and-swap the shard map. The stored version must equal
+        expect_version (Conflict otherwise — re-read and retry), and the
+        write is fenced like any other: a deposed shard leader cannot
+        rewrite the topology. The accepted map is stored at
+        expect_version + 1."""
+        self.check_fence(fence_token)
+        cur_version = 0 if self.shard_map is None else self.shard_map.version
+        if cur_version != expect_version:
+            raise Conflict(
+                f"shard map version {cur_version} != expected "
+                f"{expect_version}")
+        self.shard_map = ShardMap(num_shards=max(1, new.num_shards),
+                                  assignments=dict(new.assignments),
+                                  version=expect_version + 1)
+        return self.get_shard_map()
 
     # -- watch registration (LIST+WATCH: informer semantics) ------------------
     # client-go informers LIST current state before watching; a handler
@@ -279,13 +350,15 @@ class APIServer:
     def bind(self, pod: Pod, node_name: str,
              fence_token: Optional[int] = None) -> None:
         """POST pods/<name>/binding (reference default_binder.go:51 →
-        registry/core/pod/storage BindingREST: sets spec.nodeName, fails on
-        conflict if already bound to a different node)."""
+        registry/core/pod/storage BindingREST: sets spec.nodeName, fails
+        on conflict if already bound — EVEN to the same node, so two
+        schedulers racing to identical placements still surface the
+        race instead of silently double-counting the bind)."""
         self.check_fence(fence_token)
         current = self.pods.get(pod.uid)
         if current is None:
             raise NotFound(pod.uid)
-        if current.spec.node_name and current.spec.node_name != node_name:
+        if current.spec.node_name:
             raise Conflict(
                 f"pod {pod.uid} is already assigned to node {current.spec.node_name}")
         if node_name not in self.nodes:
@@ -329,7 +402,9 @@ class APIServer:
             if current is None:
                 failures.append((pod, NotFound(uid)))
                 continue
-            if current.spec.node_name and current.spec.node_name != node_name:
+            if current.spec.node_name:
+                # already bound — even to the SAME node: a racing
+                # scheduler's identical placement is still its loss
                 failures.append((pod, Conflict(
                     f"pod {uid} is already assigned to node "
                     f"{current.spec.node_name}")))

@@ -36,7 +36,36 @@ from typing import Callable, Optional
 
 from ..api.types import Pod
 from ..obs.journey import EV_BIND_FLUSH as _EV_BIND_FLUSH
-from .apiserver import Conflict, FencedWrite, is_retriable
+from .apiserver import LEASE_NAME, Conflict, FencedWrite, is_retriable
+
+
+def _fence_pairs(token) -> tuple:
+    """Normalize a fence token (int / (lease, gen) pair / tuple of pairs —
+    the three forms APIServer.check_fence accepts) to a tuple of pairs."""
+    if isinstance(token, int):
+        return ((LEASE_NAME, token),)
+    if token and isinstance(token[0], str):
+        return (token,)
+    return tuple(token)
+
+
+def _fence_min(a, b):
+    """Merge two fence tokens conservatively: per lease, keep the OLDEST
+    generation seen (generations are monotonic, so the oldest token is the
+    strictest — a batch spanning a depose boundary fails entirely). Two
+    ints stay an int (the single-lease legacy form); any other mix
+    normalizes to a sorted tuple of (lease, generation) pairs."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if isinstance(a, int) and isinstance(b, int):
+        return min(a, b)
+    merged: dict = {}
+    for name, gen in _fence_pairs(a) + _fence_pairs(b):
+        if name not in merged or gen < merged[name]:
+            merged[name] = gen
+    return tuple(sorted(merged.items()))
 
 
 def backoff_delay(attempt: int, base: float, cap: float,
@@ -70,10 +99,11 @@ class APICall:
     condition: Optional[dict] = None
     # None = leave unchanged; "" = clear (preemption demotion)
     nominated_node_name: Optional[str] = None
-    # fencing token (lease generation) stamped at ENQUEUE time: a call
-    # enqueued before the leader was deposed keeps its stale token, so
-    # the API server rejects it even if the flush happens much later
-    fence_token: Optional[int] = None
+    # fencing token stamped at ENQUEUE time: a call enqueued before the
+    # leader was deposed keeps its stale token, so the API server rejects
+    # it even if the flush happens much later. Any check_fence form: int
+    # (single-lease legacy) or (lease, generation) pair(s).
+    fence_token: Optional[object] = None
 
 
 @dataclass
@@ -104,19 +134,27 @@ class APIDispatcher:
     # fencing-token provider (ha/fencing.py wires the elector's current
     # lease generation): consulted at enqueue time, None = unfenced
     fence: Optional[Callable[[], Optional[int]]] = None
-    # the OLDEST token among bulk binds enqueued since the last flush:
-    # generations are monotonic, so fencing the whole bulk batch at the
-    # oldest token is conservative — a batch spanning a depose boundary
-    # fails entirely and every member requeues through on_bind_error
-    _bind_fence: Optional[int] = None   # guarded_by: _lock
+    # per-pod fencing provider (sharded control plane): one instance may
+    # hold MULTIPLE shard leases, so the right token depends on which pod
+    # is being written. Takes precedence over `fence` when set; returns
+    # any check_fence token form (usually a (lease, generation) pair).
+    fence_for: Optional[Callable[[Pod], Optional[object]]] = None
+    # the OLDEST token per lease among bulk binds enqueued since the last
+    # flush: generations are monotonic, so fencing the whole bulk batch at
+    # the oldest token is conservative — a batch spanning a depose
+    # boundary fails entirely and every member requeues via on_bind_error
+    _bind_fence: Optional[object] = None   # guarded_by: _lock
     executed: int = 0
     errors: int = 0
     retries: int = 0
     fenced: int = 0
 
     def _stamp(self, call: APICall) -> APICall:
-        if call.fence_token is None and self.fence is not None:
-            call.fence_token = self.fence()
+        if call.fence_token is None:
+            if self.fence_for is not None:
+                call.fence_token = self.fence_for(call.pod)
+            elif self.fence is not None:
+                call.fence_token = self.fence()
         return call
 
     def add(self, call: APICall) -> None:
@@ -158,11 +196,15 @@ class APIDispatcher:
         if self.journey is not None and pairs:
             self.journey.bind_enqueued([pair[0].uid for pair in pairs],
                                        self.journey.clock())
-        token = self.fence() if self.fence is not None else None
+        if self.fence_for is not None:
+            token = None
+            for pair in pairs:
+                token = _fence_min(token, self.fence_for(pair[0]))
+        else:
+            token = self.fence() if self.fence is not None else None
         with self._lock:
-            if token is not None and (self._bind_fence is None
-                                      or token < self._bind_fence):
-                self._bind_fence = token
+            if token is not None:
+                self._bind_fence = _fence_min(self._bind_fence, token)
             if self._queue:
                 # a bind supersedes a pending patch — but never a DELETE,
                 # which outranks it (same relevance ordering as add()). The

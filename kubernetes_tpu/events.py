@@ -226,6 +226,9 @@ class FlightRecord:
     # host-path drain. Sums to ≤ phases["device_dispatch"] — the named
     # decomposition of the device phase wall.
     kernels: dict = field(default_factory=dict)
+    # shard ids the committing instance owned at commit time (sharded
+    # control plane, ha/shards.py); () = unsharded operation
+    shard: tuple = ()
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -245,7 +248,8 @@ class FlightRecord:
                 "audit": dict(self.audit),
                 "probe": dict(self.probe),
                 "kernels": {k: round(v, 6)
-                            for k, v in self.kernels.items()}}
+                            for k, v in self.kernels.items()},
+                "shard": list(self.shard)}
 
 
 class FlightRecorder:

@@ -1,6 +1,6 @@
-"""Active/standby high availability (ROADMAP item 5).
+"""High availability + the sharded control plane (ROADMAP items 4/5).
 
-Three cooperating parts, mirroring how the reference deploys
+Four cooperating parts, mirroring how the reference deploys
 kube-scheduler replicas behind client-go `tools/leaderelection`:
 
 - `ha.lease`: `LeaseLock` + `LeaderElector` — lease-based election over
@@ -13,16 +13,25 @@ kube-scheduler replicas behind client-go `tools/leaderelection`:
 - `ha.standby`: a hot spare that tails the drain ledger + watch events to
   keep cache, device arrays and JIT caches warm, and takes over with a
   delta resync instead of a cold LIST + tensorize + compile warm-up.
+- `ha.shards`: N fenced scheduler instances over ONE cluster — per-shard
+  leases, a fenced/versioned shard assignment map, and warm lease-handoff
+  rebalance (split/merge/steal) built on the standby's dual-stream seam.
 """
 
 from .fencing import fence_dispatcher, unfence_dispatcher
 from .lease import LeaderElector, LeaseLock
+from .shards import (ShardManager, ShardScheduler, shard_key,
+                     shard_lease_name)
 from .standby import StandbyScheduler
 
 __all__ = [
     "LeaderElector",
     "LeaseLock",
+    "ShardManager",
+    "ShardScheduler",
     "StandbyScheduler",
     "fence_dispatcher",
+    "shard_key",
+    "shard_lease_name",
     "unfence_dispatcher",
 ]

@@ -110,9 +110,11 @@ class LeaderElector:
                  on_started_leading: Optional[Callable[[], None]] = None,
                  on_stopped_leading: Optional[Callable[[], None]] = None,
                  metrics=None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 lease_name: str = LEASE_NAME):
         self.client = client
         self.identity = identity
+        self.lease_name = lease_name
         self.lease_duration_s = lease_duration_s
         # reference defaults: LeaseDuration 15s / RenewDeadline 10s /
         # RetryPeriod 2s — keep the 2:3 ratio for custom durations
@@ -123,7 +125,7 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.metrics = metrics
-        self.lock = LeaseLock(client, identity,
+        self.lock = LeaseLock(client, identity, name=lease_name,
                               lease_duration_s=lease_duration_s)
         self._leading = False
         self._last_renew = 0.0      # last SUCCESSFUL acquire/renew

@@ -1,0 +1,427 @@
+"""Sharded control plane: N fenced schedulers over ONE cluster.
+
+PR 11's active/standby HA built two seams this module composes into a
+horizontally scaled control plane:
+
+- **per-shard leases** (`ha/lease.py` electors against
+  `kube-scheduler-shard-<i>` Lease objects): which INSTANCE owns a shard
+  is exactly who holds its lease, and the lease's fencing generation —
+  bumped on every holder change — is the cross-shard ordering primitive.
+  Every write an instance dispatches for a shard's pods is stamped with
+  a `(lease_name, generation)` pair (dispatcher `fence_for`), so an
+  instance that loses a shard lease mid-flush provably cannot
+  double-bind: its late writes arrive with a stale generation and the
+  API server rejects them terminally (`FencedWrite`), unwinding the
+  assumes through `on_bind_error` — the PR-11 zombie proof, now N-way.
+
+- **the standby dual-stream** (watch + drain-ledger tail): every
+  instance registers the normal informer handlers, so peers' pods ride
+  its watch stream into the workload/cache state but PARK instead of
+  queueing (`Scheduler.shard_filter` / `_shard_parked`). A shard
+  rebalance or steal is therefore a lease handoff plus
+  `shard_evict()`/`shard_adopt()` — a warm splice, not a cold LIST —
+  and the successor anchors the predecessor's audit-chain position via
+  `DrainLedger.record_handoff`, so every per-shard ledger verifies
+  across every handoff.
+
+WHICH pods belong to WHICH shard is the `ShardMap`: one fenced,
+versioned API object keyed by `scheduler_name/namespace` with a stable
+hash fallback, CAS'd through `APIServer.put_shard_map` so topology
+changes (split 1→N, merge N→1) are themselves fenced writes. Cross-shard
+bind races on overlapping nodes surface as `Conflict` (the pod-level
+"already assigned" guard in `bind_all`) or `FencedWrite` and unwind
+cleanly — both counted as `scheduler_cross_shard_conflicts_total`.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional
+
+from ..backend.apiserver import Conflict, FencedWrite, ShardMap
+from ..scheduler import Scheduler
+from .lease import LeaderElector
+
+SHARD_LEASE_PREFIX = "kube-scheduler-shard-"
+
+
+def shard_lease_name(shard_id: int) -> str:
+    """Lease object name for one shard's ownership election."""
+    return f"{SHARD_LEASE_PREFIX}{shard_id}"
+
+
+def shard_key(pod) -> str:
+    """The ShardMap routing key: profile/namespace — the multi-tenant
+    axis (ROADMAP item 4), so one tenant's burst saturates one shard."""
+    return f"{pod.spec.scheduler_name}/{pod.namespace}"
+
+
+class ShardScheduler:
+    """One control-plane instance in the sharded fleet: an inner (active)
+    Scheduler plus one elector per shard lease it contends for. An
+    instance may hold SEVERAL shard leases at once (a merge collapses
+    ownership of all shards onto one instance), which is why the
+    dispatcher fences per pod (`fence_for`), not per instance."""
+
+    def __init__(self, client, identity: str,
+                 lease_duration_s: float = 15.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 scheduler: Optional[Scheduler] = None,
+                 **scheduler_kwargs):
+        if scheduler is None and clock is not None:
+            # the fleet's manual clock drives the inner scheduler too
+            scheduler_kwargs.setdefault("clock", clock)
+        self.scheduler = (scheduler if scheduler is not None
+                          else Scheduler(client, **scheduler_kwargs))
+        self.client = client
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        self.clock = clock if clock is not None else self.scheduler.clock
+        self.electors: dict[int, LeaderElector] = {}
+        self._map: Optional[ShardMap] = None
+        # peer drain-ledger tails (wired by ShardManager.wire_ledgers):
+        # identity -> DrainLedger; the dual-stream's second leg
+        self.peer_ledgers: dict[str, object] = {}
+        self.cursors: dict[str, int] = {}
+        self.conflicts = 0            # cross-shard bind unwinds seen
+        sched = self.scheduler
+        sched.shard_filter = self._owns_pod
+        sched.dispatcher.fence_for = self._fence_for
+        # route bind unwinds through the shard-aware wrapper: the
+        # scheduler's forget/requeue runs first (the assume MUST unwind),
+        # then a pod this shard no longer owns re-parks instead of
+        # re-queueing — otherwise the loser of a cross-shard race would
+        # keep re-scheduling the winner's pod
+        self._chain_bind_error = sched.dispatcher.on_bind_error
+        sched.dispatcher.on_bind_error = self._on_bind_error
+
+    # -- ownership ------------------------------------------------------------
+
+    def refresh_map(self) -> ShardMap:
+        self._map = self.client.get_shard_map()
+        return self._map
+
+    def _shard_of(self, pod) -> int:
+        m = self._map if self._map is not None else self.refresh_map()
+        return m.shard_for(shard_key(pod))
+
+    def _owns_pod(self, pod) -> bool:
+        e = self.electors.get(self._shard_of(pod))
+        return e is not None and e.is_leader()
+
+    def _fence_for(self, pod):
+        """The (lease, generation) pair for the pod's shard. An instance
+        that does NOT hold the shard's lease stamps generation -1 — any
+        such write is fenced the moment the lease exists at all."""
+        sid = self._shard_of(pod)
+        e = self.electors.get(sid)
+        gen = e.fence_token() if e is not None else None
+        return (shard_lease_name(sid), gen if gen is not None else -1)
+
+    def elector_for(self, sid: int) -> LeaderElector:
+        e = self.electors.get(sid)
+        if e is None:
+            e = LeaderElector(self.client, self.identity,
+                              lease_duration_s=self.lease_duration_s,
+                              clock=self.clock,
+                              metrics=self.scheduler.metrics,
+                              lease_name=shard_lease_name(sid))
+            self.electors[sid] = e
+        return e
+
+    def holds(self, sid: int) -> bool:
+        e = self.electors.get(sid)
+        return e is not None and e.is_leader()
+
+    def held(self) -> tuple:
+        return tuple(sorted(sid for sid, e in self.electors.items()
+                            if e.is_leader()))
+
+    def tick(self) -> tuple:
+        """One election round on every contended shard lease; returns the
+        shard ids currently held. A lost lease demotes only that SLICE —
+        the instance stays active for the shards it still holds."""
+        for e in self.electors.values():
+            e.tick()
+        self.scheduler.shard_ids = held = self.held()
+        return held
+
+    def rebalance(self) -> tuple:
+        """React to a topology/lease change: re-read the map, park what
+        this instance no longer owns, adopt what it now does. Safe to
+        call redundantly (both halves are no-ops at a fixed point)."""
+        self.refresh_map()
+        evicted = self.scheduler.shard_evict()
+        adopted = self.scheduler.shard_adopt()
+        self.scheduler.shard_ids = self.held()
+        return evicted, adopted
+
+    # -- warmth (the dual-stream's ledger leg) --------------------------------
+
+    def sync(self) -> int:
+        """Consume peer drain-ledger tails: per-peer cursors + the lag
+        gauge stay current, so a steal annexes an up-to-date chain
+        position and the operator can see how warm each peer is."""
+        consumed = 0
+        worst = 0
+        for ident, ledger in self.peer_ledgers.items():
+            cur = self.cursors.get(ident, 0)
+            for rec in ledger.tail(cur):
+                cur = rec.seq
+                consumed += 1
+            self.cursors[ident] = cur
+            worst = max(worst, ledger.lag(cur))
+        if self.peer_ledgers:
+            self.scheduler.metrics.ha_ledger_tail_lag.set(float(worst))
+        return consumed
+
+    def audit_ledger(self):
+        a = self.scheduler.audit
+        return None if a is None else a.ledger
+
+    # -- cross-shard conflict unwind ------------------------------------------
+
+    def _on_bind_error(self, pod, node_name: str, err: Exception) -> None:
+        m = self.scheduler.metrics
+        lost = False
+        if isinstance(err, FencedWrite):
+            # the server PROVED our generation stale: the lease moved,
+            # so ownership is gone whatever the elector still believes
+            # (a zombie learns it was deposed from the fence, first)
+            self.conflicts += 1
+            m.cross_shard_conflicts.inc("fenced")
+            self.refresh_map()
+            lost = True
+        elif isinstance(err, Conflict):
+            # pod already assigned: a peer won the race — re-read the
+            # map before deciding; our cached copy may predate the move
+            self.conflicts += 1
+            m.cross_shard_conflicts.inc("conflict")
+            self.refresh_map()
+        if self._chain_bind_error is not None:
+            self._chain_bind_error(pod, node_name, err)
+        if lost or not self._owns_pod(pod):
+            # the unwind requeued it; a peer's pod re-parks instead
+            fresh = pod.with_node_name("")
+            self.scheduler.queue.delete(fresh)
+            self.scheduler._shard_parked[fresh.uid] = fresh
+
+    # -- serving --------------------------------------------------------------
+
+    def debug(self) -> dict:
+        return {"identity": self.identity,
+                "held": list(self.held()),
+                "queued": len(self.scheduler.queue),
+                "parked": len(self.scheduler._shard_parked),
+                "crossShardConflicts": self.conflicts,
+                "fencedRejected": self.scheduler.dispatcher.fenced,
+                "ledgerCursors": dict(self.cursors)}
+
+
+class ShardManager:
+    """The shard topology lifecycle over a fleet of ShardSchedulers:
+    split (1→N), merge (N→1), steal/rebalance (lease handoff), all built
+    on ONE primitive — `transfer()` — whose ordering IS the correctness
+    argument:
+
+      1. predecessor's audit-chain position is captured;
+      2. predecessor releases (cooperative) or is force-cleared (steal)
+         and parks its queued slice (`shard_evict` drains in-flight
+         work first, so no assume ever leaks);
+      3. successor acquires → the generation BUMPS → every write the
+         predecessor still has in flight for this shard is fenced;
+      4. successor annexes the predecessor's chain position
+         (`record_handoff`) and adopts the parked slice warm.
+
+    A predecessor killed mid-flush skips step 2 — and that is fine: step
+    3 fences its stragglers and its unbound pods are still in the store
+    for the successor's watch-parked copy to adopt."""
+
+    def __init__(self, client, instances=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 metrics=None):
+        self.client = client
+        self.instances: list[ShardScheduler] = list(instances or [])
+        ref = self.instances[0] if self.instances else None
+        self.clock = clock if clock is not None else (
+            ref.clock if ref is not None else _time.monotonic)
+        self.metrics = metrics if metrics is not None else (
+            ref.scheduler.metrics if ref is not None else None)
+        self.splits = 0
+        self.merges = 0
+        self.steals = 0
+
+    # -- topology -------------------------------------------------------------
+
+    def shard_map(self) -> ShardMap:
+        return self.client.get_shard_map()
+
+    def holder_of(self, sid: int) -> Optional[ShardScheduler]:
+        lease = self.client.get_lease(shard_lease_name(sid))
+        if lease is None or not lease.holder_identity:
+            return None
+        for inst in self.instances:
+            if inst.identity == lease.holder_identity:
+                return inst
+        return None
+
+    def _writer_fence(self):
+        """A fence pair from any held shard lease in the fleet — topology
+        CAS writes are fenced too. None only at bootstrap (no leases
+        exist yet: unfenced, like any pre-HA write)."""
+        for inst in self.instances:
+            for sid, e in inst.electors.items():
+                if e.is_leader():
+                    return (shard_lease_name(sid), e.fence_token())
+        return None
+
+    def set_topology(self, num_shards: int,
+                     assignments: Optional[dict] = None) -> ShardMap:
+        """Fenced CAS of the shard map; every instance re-reads it."""
+        m = self.client.get_shard_map()
+        new = ShardMap(num_shards=num_shards,
+                       assignments=dict(m.assignments if assignments is None
+                                        else assignments))
+        out = self.client.put_shard_map(new, expect_version=m.version,
+                                        fence_token=self._writer_fence())
+        for inst in self.instances:
+            inst.refresh_map()
+        self._observe_assignments(out)
+        return out
+
+    # -- the handoff primitive ------------------------------------------------
+
+    def transfer(self, sid: int, dst: ShardScheduler,
+                 reason: str = "rebalance", force: bool = False) -> float:
+        """Move shard `sid`'s lease (and its warm queue slice) to `dst`.
+        `force=True` clears a non-cooperating holder's lease (the steal
+        path: the holder may be mid-drain or dead). Returns the handoff
+        wall seconds (also observed as shard_rebalance_seconds)."""
+        t0 = _time.perf_counter()
+        name = shard_lease_name(sid)
+        src = self.holder_of(sid)
+        if src is dst and dst.holds(sid):
+            return 0.0
+        head: Optional[str] = None
+        seq = 0
+        if src is not None:
+            led = src.audit_ledger()
+            if led is not None:
+                head, seq = led.head_hash(), led.cursor()
+            e = src.electors.get(sid)
+            if not force and e is not None:
+                e.release()
+                # cooperative handoff: park the slice (drains in-flight
+                # work first, so no assume ever leaks)
+                src.rebalance()
+            else:
+                # steal path: the holder may be mid-drain or DEAD — do
+                # not touch its internals, just clear the (possibly
+                # unexpired) lease by fiat. The generation bump below
+                # fences its stragglers, and the successor adopts from
+                # its own watch-parked copies of the slice.
+                lease = self.client.get_lease(name)
+                if lease is not None:
+                    self.client.release_lease(name, lease.holder_identity)
+        # holder change → generation bump: THE fence on src's stragglers
+        self.client.acquire_lease(name, dst.identity, self.clock(),
+                                  lease_duration_s=dst.lease_duration_s)
+        e = dst.elector_for(sid)
+        e.tick()    # observes the held lease, caches the new generation
+        if head is not None and src is not None:
+            led = dst.audit_ledger()
+            if led is not None and led is not src.audit_ledger():
+                led.record_handoff(sid, head, seq)
+        dst.rebalance()
+        dt = _time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.shard_rebalance.observe(dt)
+            self.metrics.shard_steals.inc(reason)
+        self._observe_assignments()
+        return dt
+
+    # -- lifecycle verbs ------------------------------------------------------
+
+    def split(self, num_shards: int, owners: dict,
+              assignments: Optional[dict] = None) -> None:
+        """1→N (or N→M): CAS the topology, then hand each shard in
+        `owners` (sid → instance) to its designated owner. Instances not
+        named keep warming the whole stream parked."""
+        self.set_topology(num_shards, assignments=assignments)
+        for sid in sorted(owners):
+            self.transfer(sid, owners[sid], reason="split")
+        for inst in self.instances:
+            inst.rebalance()
+        self.splits += 1
+
+    def merge(self, dst: ShardScheduler) -> None:
+        """N→1 ownership collapse: dst takes every shard lease (the key
+        space keeps its shape — collapse it too with set_topology(1))."""
+        m = self.client.get_shard_map()
+        for sid in range(m.num_shards):
+            self.transfer(sid, dst, reason="merge")
+        for inst in self.instances:
+            inst.rebalance()
+        self.merges += 1
+
+    def steal(self, sid: int, dst: ShardScheduler,
+              force: bool = True) -> float:
+        """Peer takes a (possibly loaded, possibly dead) shard mid-drain."""
+        dt = self.transfer(sid, dst, reason="steal", force=force)
+        self.steals += 1
+        return dt
+
+    # -- fleet plumbing -------------------------------------------------------
+
+    def tick_all(self) -> None:
+        for inst in self.instances:
+            inst.tick()
+
+    def sync_all(self) -> int:
+        return sum(inst.sync() for inst in self.instances)
+
+    def wire_ledgers(self) -> None:
+        """In-process dual-stream wiring: every instance tails every
+        peer's drain ledger (deployment would stream these; the seam is
+        the same DrainLedger.tail the PR-11 standby consumes)."""
+        for a in self.instances:
+            a.peer_ledgers = {}
+            for b in self.instances:
+                if b is a:
+                    continue
+                led = b.audit_ledger()
+                if led is not None:
+                    a.peer_ledgers[b.identity] = led
+
+    def _observe_assignments(self, m: Optional[ShardMap] = None) -> None:
+        if self.metrics is None:
+            return
+        m = m if m is not None else self.client.get_shard_map()
+        counts = {sid: 0 for sid in range(m.num_shards)}
+        for _key, sid in m.assignments.items():
+            if 0 <= sid < m.num_shards:
+                counts[sid] += 1
+        for sid, c in counts.items():
+            self.metrics.shard_assignments.set(float(c), str(sid))
+
+    # -- serving --------------------------------------------------------------
+
+    def debug(self) -> dict:
+        """/debug/shards payload."""
+        m = self.client.get_shard_map()
+        leases = {}
+        for sid in range(m.num_shards):
+            lease = self.client.get_lease(shard_lease_name(sid))
+            leases[str(sid)] = None if lease is None else {
+                "holder": lease.holder_identity,
+                "generation": lease.generation,
+                "transitions": lease.lease_transitions,
+                "renewTime": lease.renew_time,
+            }
+        return {"numShards": m.num_shards,
+                "mapVersion": m.version,
+                "assignments": dict(m.assignments),
+                "leases": leases,
+                "splits": self.splits, "merges": self.merges,
+                "steals": self.steals,
+                "instances": [inst.debug() for inst in self.instances]}

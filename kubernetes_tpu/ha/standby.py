@@ -114,12 +114,17 @@ class StandbyScheduler:
             self.drains_seen += consumed
         if refresh:
             # the same staged phases the leader's drain loop declares, so
-            # the transfer-guard discipline holds on the standby too
-            with sched.rails.declared("host_snapshot"):
-                sched.cache.update_snapshot(sched.snapshot)
-            with sched.rails.declared("host_tensorize"):
-                sched.state.apply_snapshot(sched.snapshot)
-                sched.state.ensure_arrays()
+            # the transfer-guard discipline holds on the standby too.
+            # The ingest lock covers the FULL rebuild: a watch event
+            # mid-re-tensorize would mutate cache/snapshot between
+            # update_snapshot and apply_snapshot, leaving the device
+            # arrays out of step with the host snapshot they claim to be
+            with sched.ingest_lock:
+                with sched.rails.declared("host_snapshot"):
+                    sched.cache.update_snapshot(sched.snapshot)
+                with sched.rails.declared("host_tensorize"):
+                    sched.state.apply_snapshot(sched.snapshot)
+                    sched.state.ensure_arrays()
         return consumed
 
     # -- takeover -------------------------------------------------------------

@@ -260,6 +260,12 @@ CLUSTER_SEED_RESOURCES = ("cpu", "memory")
 # worth of lanes); a live profile on a wider mesh adds its real set
 SHARD_SEED_LANES = tuple(str(i) for i in range(8))
 
+# sharded control plane (ha/shards.py, ISSUE 17): pre-seeded label sets
+# so dashboards see the series before the first split/steal/conflict
+SHARD_SEED_IDS = tuple(str(i) for i in range(4))
+SHARD_STEAL_REASONS = ("split", "merge", "steal", "rebalance")
+CROSS_SHARD_OUTCOMES = ("conflict", "fenced")
+
 
 class SchedulerMetrics:
     """The scheduler's series, bound to one Registry (metrics.go Register)."""
@@ -516,6 +522,30 @@ class SchedulerMetrics:
             "Dispatcher writes rejected by the API server for carrying "
             "a stale fencing token (lease generation) — a deposed "
             "leader's late flush, unwound through on_bind_error."))
+        # sharded control plane (kubernetes_tpu/ha/shards.py, ISSUE 17)
+        self.shard_assignments = r.register(Gauge(
+            n + "shard_assignments",
+            "Explicit profile/namespace keys routed to each shard by the "
+            "fenced ShardMap (keys not listed route by stable hash).",
+            ("shard",)))
+        self.shard_rebalance = r.register(Histogram(
+            n + "shard_rebalance_seconds",
+            "Wall time of one shard lease handoff (split/merge/steal): "
+            "predecessor park + generation-bump acquire + ledger annex + "
+            "warm adopt from the parked set (ha/shards.py transfer)."))
+        self.shard_steals = r.register(Counter(
+            n + "shard_steals_total",
+            "Shard lease handoffs, by reason: split (1→N topology "
+            "change), merge (N→1 collapse), steal (peer takes a loaded "
+            "or dead shard), rebalance (planned move).",
+            ("reason",)))
+        self.cross_shard_conflicts = r.register(Counter(
+            n + "cross_shard_conflicts_total",
+            "Cross-shard bind races detected at commit, by outcome: "
+            "conflict (pod already bound by a peer — the pod-level "
+            "guard) or fenced (stale shard-lease generation — the "
+            "ordering primitive). Both unwind through on_bind_error.",
+            ("outcome",)))
         self.dispatcher_inflight = r.register(Gauge(
             n + "dispatcher_inflight",
             "In-flight work of the async commit pipeline at scrape time: "
@@ -654,6 +684,13 @@ class SchedulerMetrics:
         self.ha_failover.seed()
         self.ha_ledger_tail_lag.set(0.0)
         self.fenced_writes_rejected.inc(by=0)
+        for shard in SHARD_SEED_IDS:
+            self.shard_assignments.set(0.0, shard)
+        self.shard_rebalance.seed()
+        for reason in SHARD_STEAL_REASONS:
+            self.shard_steals.inc(reason, by=0)
+        for outcome in CROSS_SHARD_OUTCOMES:
+            self.cross_shard_conflicts.inc(outcome, by=0)
         from ..obs.journey import CAUSES, EVENTS, SEGMENTS
         for segment in SEGMENTS:
             self.e2e_segment.seed(segment)

@@ -299,6 +299,11 @@ class DrainLedger:
         self.appended = 0           # guarded_by: _lock
         # prev_hash of the oldest retained record: verify() anchors here
         self._window_anchor = GENESIS  # guarded_by: _lock
+        # handoff annex (sharded control plane): each shard steal /
+        # rebalance notes the predecessor ledger's (head, cursor) here,
+        # folded into its own hash chain — see record_handoff()
+        self.handoffs: list = []       # guarded_by: _lock
+        self.handoff_head = GENESIS    # guarded_by: _lock
 
     @property
     def lock(self):
@@ -378,6 +383,41 @@ class DrainLedger:
             self.head = head
             self._window_anchor = head
             self.appended = seq
+
+    # -- shard handoff annex --------------------------------------------------
+
+    def record_handoff(self, shard_id: int, head: str, seq: int) -> dict:
+        """Anchor a predecessor shard ledger's chain position on THIS
+        (possibly non-empty) ledger. `splice()` only works on an empty
+        ledger — a cold takeover — but a shard steal lands on a live
+        successor whose own chain must not be rewritten. The annex is a
+        separate hash chain folding each handoff (shard id, predecessor
+        head, predecessor cursor), so the handoff history is
+        tamper-evident exactly like the drain chain itself."""
+        with self._lock:
+            prev = self.handoff_head
+            h = _sha(prev, f"{shard_id}|{head}|{seq}".encode("utf-8"))
+            entry = {"shard": int(shard_id), "head": head, "seq": int(seq),
+                     "prev": prev, "hash": h}
+            self.handoffs.append(entry)
+            self.handoff_head = h
+        return entry
+
+    def verify_handoffs(self) -> bool:
+        """Recompute the handoff annex chain; False = an entry was edited
+        (or inserted) after the fact."""
+        with self._lock:
+            entries = list(self.handoffs)
+            head = self.handoff_head
+        prev = GENESIS
+        for e in entries:
+            if e["prev"] != prev:
+                return False
+            if _sha(prev, f"{e['shard']}|{e['head']}|{e['seq']}"
+                    .encode("utf-8")) != e["hash"]:
+                return False
+            prev = e["hash"]
+        return prev == head
 
     def find(self, drain_id: int) -> Optional[AuditRecord]:
         with self._lock:

@@ -21,6 +21,7 @@ MoveAllToActiveOrBackoffQueue with the matching ClusterEvent.
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from collections import deque
 from contextlib import contextmanager
@@ -575,6 +576,19 @@ class Scheduler:
         # cache/queue/device state warm — schedule_pending refuses to
         # dispatch until promote() flips the role at takeover
         self.ha_role = "active"
+        # sharded control plane (ha/shards.py): when shard_filter is set,
+        # the watch stream forks — owned unbound pods queue; peers' pods
+        # PARK in _shard_parked while workload/cache state still ingests
+        # them, so a shard steal is shard_adopt() from the parked set (a
+        # warm handoff), not a cold LIST + re-tensorize
+        self.shard_filter: Optional[Callable[[Pod], bool]] = None
+        self.shard_ids: tuple = ()   # owned shard ids (flight tag/debug)
+        self._shard_parked: dict[str, Pod] = {}
+        # ingest lock: watch handlers mutate queue/cache/workload state
+        # from the API thread while sync()/resync() rebuild the same
+        # structures — both sides hold this for their full critical
+        # section (reentrant: a handler can fire inside resync's LIST)
+        self.ingest_lock = threading.RLock()
         # hand every GangScheduling plugin its Handle (this Scheduler)
         from .plugins.gangscheduling import GangScheduling
         for prof in self.profiles.values():
@@ -826,18 +840,30 @@ class Scheduler:
         err.diagnosis.unschedulable_plugins = {rec.wait_plugin or "Permit"}
         self._handle_failure(rec.qpi, err, try_preempt=False)
 
+    def _locked(self, fn):
+        """Wrap a watch handler so it holds the ingest lock: handlers fire
+        on the API thread while sync()/resync() rebuild queue/cache/device
+        state — without the lock a watch event interleaves with the
+        rebuild and lands on a structure about to be thrown away."""
+        def wrapper(*args, **kw):
+            with self.ingest_lock:
+                return fn(*args, **kw)
+        return wrapper
+
     def _register_event_handlers(self) -> None:
         """eventhandlers.go:499 addAllEventHandlers. Registration order
         matters on a live store: nodes replay before pods so bound pods
         land on real cache entries instead of imputed placeholders."""
         self.client.watch_nodes(WatchHandlers(
-            on_add=self._on_node_add, on_update=self._on_node_update,
-            on_delete=self._on_node_delete))
+            on_add=self._locked(self._on_node_add),
+            on_update=self._locked(self._on_node_update),
+            on_delete=self._locked(self._on_node_delete)))
         self.client.watch_pods(WatchHandlers(
-            on_add=self._on_pod_add, on_update=self._on_pod_update,
-            on_delete=self._on_pod_delete,
-            on_add_bulk=self._on_pod_add_bulk,
-            on_update_bulk=(self._on_pod_update_bulk
+            on_add=self._locked(self._on_pod_add),
+            on_update=self._locked(self._on_pod_update),
+            on_delete=self._locked(self._on_pod_delete),
+            on_add_bulk=self._locked(self._on_pod_add_bulk),
+            on_update_bulk=(self._locked(self._on_pod_update_bulk)
                             if self.columnar_ingest else None)))
         if hasattr(self.client, "watch_workloads"):
             self.client.watch_workloads(WatchHandlers(
@@ -868,6 +894,11 @@ class Scheduler:
     def _responsible(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.profiles
 
+    def _shard_owns(self, pod: Pod) -> bool:
+        """Does this instance's shard slice cover the pod? Unsharded
+        operation (shard_filter unset) owns everything."""
+        return self.shard_filter is None or self.shard_filter(pod)
+
     # -- event handlers (eventhandlers.go) ------------------------------------
 
     def _invalidate_device_state(self) -> None:
@@ -882,6 +913,11 @@ class Scheduler:
             self.queue.move_all_to_active_or_backoff_queue(
                 EVENT_ASSIGNED_POD_ADD, None, pod)
         elif self._responsible(pod):
+            if not self._shard_owns(pod):
+                # a peer shard's pod: stay warm (workload state above,
+                # node/cache state via the bind echo) but don't schedule
+                self._shard_parked[pod.uid] = pod
+                return
             self.queue.add(pod)
             gated = (pod.uid in self.queue.unschedulable_pods)
             self.metrics.queue_incoming_pods.inc(
@@ -912,6 +948,9 @@ class Scheduler:
         for pod in pods:
             if pod.spec.node_name or not self._responsible(pod):
                 self._on_pod_add(pod)
+            elif not self._shard_owns(pod):
+                self.workload_manager.add_pod(pod)
+                self._shard_parked[pod.uid] = pod
             elif pod.spec.workload_ref:
                 self.workload_manager.add_pod(pod)
                 gang_pods.append(pod)
@@ -962,12 +1001,20 @@ class Scheduler:
                 if not self.cache.is_assumed_pod(new):
                     self._invalidate_device_state()
                 self._bind_errors.pop(new.uid, None)
+                self._shard_parked.pop(new.uid, None)  # peer bound it
                 self.cache.add_pod(new)
                 self.queue.delete(new)
                 self._journey_confirm([new.uid])
                 self.queue.move_all_to_active_or_backoff_queue(
                     EVENT_ASSIGNED_POD_ADD, old, new)
         elif self._responsible(new):
+            if not self._shard_owns(new) and new.uid in self._shard_parked:
+                self._shard_parked[new.uid] = new  # keep the park fresh
+                return
+            if self._shard_parked.pop(new.uid, None) is not None:
+                # ownership arrived between park and this update
+                self.queue.add(new)
+                return
             self.queue.update(old, new)
             flags = pod_update_action(old, new)
             if flags:
@@ -1019,6 +1066,7 @@ class Scheduler:
         if pod.uid in self._waiting_pods:
             self._reject_waiting(pod.uid, "pod deleted")
         self._bind_errors.pop(pod.uid, None)
+        self._shard_parked.pop(pod.uid, None)
         self.journey.forget(pod.uid)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
@@ -2370,13 +2418,68 @@ class Scheduler:
         and are rejected server-side, unwinding through on_bind_error."""
         self.ha_role = "standby"
 
+    # -- shard slice lifecycle (ha/shards.py) ---------------------------------
+
+    def shard_adopt(self) -> int:
+        """Move parked pods this shard NOW owns into the queue — the warm
+        half of a shard rebalance/steal. No LIST, no re-tensorize: the
+        parked pods rode the watch stream the whole time, so adoption is
+        one bulk enqueue (gang members re-derive quorum in the same
+        pass). Returns the number of pods adopted."""
+        with self.ingest_lock:
+            owned = [p for p in self._shard_parked.values()
+                     if self._shard_owns(p)]
+            if not owned:
+                return 0
+            for p in owned:
+                self._shard_parked.pop(p.uid, None)
+            n_gated = self.queue.add_bulk(owned)
+            self.metrics.queue_incoming_pods.inc(
+                "active", "PodAdd", by=len(owned) - n_gated)
+            if n_gated:
+                self.metrics.queue_incoming_pods.inc("gated", "PodAdd",
+                                                     by=n_gated)
+            now = self.clock()
+            for ref in dict.fromkeys(p.spec.workload_ref for p in owned
+                                     if p.spec.workload_ref):
+                if ref in self.queue.gated_refs():
+                    self._gang_gated_since.setdefault(ref, now)
+                self.queue.retry_gated(ref=ref)
+            return len(owned)
+
+    def shard_evict(self) -> int:
+        """Park queued pods this shard no longer owns — the release half
+        of a rebalance/steal handoff. In-flight drains commit and the
+        dispatcher flushes FIRST, so an evicted pod is never left
+        assumed; what remains queued here simply moves to the parked set
+        (the new owner's adopt is its mirror image). Returns the number
+        of pods evicted."""
+        with self.ingest_lock:
+            self._drain_pending()
+            self.dispatcher.flush()
+            pods, _ = self.queue.pending_pods()
+            moved = 0
+            for pod in pods:
+                if pod.spec.node_name or self._shard_owns(pod):
+                    continue
+                self.queue.delete(pod)
+                self._shard_parked[pod.uid] = pod
+                moved += 1
+            return moved
+
     def resync(self) -> None:
         """Rebuild cache + queue from a fresh LIST of the API server — the
         reflector relist path (client-go Reflector.ListAndWatch after
         watch-stream loss). Call when the watch layer reports loss (e.g.
         dropped events): in-flight drains commit, the dispatcher flushes,
         parked pods are rejected, then cluster state is rebuilt from the
-        store's current truth and the device tier reseeds from scratch."""
+        store's current truth and the device tier reseeds from scratch.
+        Holds the ingest lock end to end: a watch event delivered during
+        the rebuild must not land on a structure about to be replaced."""
+        with self.ingest_lock:
+            self._resync_locked()
+
+    def _resync_locked(self) -> None:
         self._drain_pending()
         self.dispatcher.flush()
         for uid in list(self._waiting_pods):
@@ -2431,12 +2534,16 @@ class Scheduler:
         # gang gating re-derives against complete membership — a gang
         # whose quorum already arrived re-gates then ungates in the same
         # add_bulk pass instead of stranding behind PreEnqueue.
+        self._shard_parked.clear()
         for pod in self.client.pods.values():
             wm_add(pod)
             if pod.spec.node_name:
                 bound_pods.append(pod)
             elif self._responsible(pod):
-                unbound_pods.append(pod)
+                if self._shard_owns(pod):
+                    unbound_pods.append(pod)
+                else:
+                    self._shard_parked[pod.uid] = pod
         self.cache.add_pods(bound_pods)
         if unbound_pods:
             # journey: every unbound pod re-enters the queue because of
@@ -2776,7 +2883,7 @@ class Scheduler:
             events={"Scheduled": bound,
                     "FailedScheduling": len(failures)},
             drain_id=pd.drain_id, hot_frames=hot, probe=probe_snap,
-            kernels=dict(pd.kernels))
+            kernels=dict(pd.kernels), shard=tuple(self.shard_ids))
         if pd.audit is not None:
             # hand the committed decisions to the shadow-audit worker;
             # the replay + diff run off the hot path

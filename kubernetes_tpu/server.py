@@ -25,7 +25,9 @@ in-process model:
   decomposition — exact replay when the drain is in the audit ledger)
   /debug/slo (per-SLI multi-window burn rates + breaches), /debug/ha
   (HA role, lease + fencing token, ledger-tail cursor/lag, takeover
-  count and last failover seconds), /debug/pod?uid=<ns/name> (the
+  count and last failover seconds), /debug/shards (the sharded control
+  plane: topology + assignment map, per-shard lease holders/generations,
+  each instance's held/queued/parked slice), /debug/pod?uid=<ns/name> (the
   journey ledger's full causal timeline for one pod: every transition
   with timestamps + the per-segment e2e decomposition),
   /debug/cluster (the latest resolved cluster_probe snapshot:
@@ -62,13 +64,16 @@ class SchedulerServer:
 
     def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
                  elector: Optional[LeaderElector] = None,
-                 ha=None):
+                 ha=None, shard_manager=None):
         """`ha` is an optional ha.StandbyScheduler whose debug() payload
         backs /debug/ha; without one the endpoint reports the reduced
-        role/lease view assembled from `scheduler` + `elector`."""
+        role/lease view assembled from `scheduler` + `elector`.
+        `shard_manager` is an optional ha.ShardManager backing
+        /debug/shards (topology, per-shard leases, instance slices)."""
         self.scheduler = scheduler
         self.elector = elector
         self.ha = ha
+        self.shard_manager = shard_manager
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -242,6 +247,22 @@ class SchedulerServer:
                         outer.scheduler.timeline.series(
                             seconds=int(q.get("seconds", "60"))),
                         indent=2), "application/json")
+                elif self.path.startswith("/debug/shards"):
+                    if outer.shard_manager is not None:
+                        payload = outer.shard_manager.debug()
+                    else:
+                        # unsharded instance: report its own slice view
+                        sched = outer.scheduler
+                        payload = {
+                            "numShards": None,
+                            "shardIds": list(getattr(sched, "shard_ids",
+                                                     ())),
+                            "parked": len(getattr(sched, "_shard_parked",
+                                                  {})),
+                        }
+                    self._send(200, json.dumps(payload, indent=2,
+                                               default=str),
+                               "application/json")
                 elif self.path.startswith("/debug/slo"):
                     self._send(200, json.dumps(
                         outer.scheduler.slo.snapshot(), indent=2),

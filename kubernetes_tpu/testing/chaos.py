@@ -23,6 +23,13 @@ tolerates every day (and the resilient commit pipeline must absorb):
   deadline) and a clock-skew knob added to the timestamp the API server
   sees, so the election loop is chaos-covered like every other verb.
 
+- shard-aware targeting (ISSUE 17): `target_leases`/`target_identities`
+  scope the lease chaos to one shard while peers stay healthy,
+  `lease_storm()` fires a deterministic expiry/steal strike across all N
+  shard leases, and `for_identity()` returns a per-client view applying
+  asymmetric latency to one shard scheduler's verbs
+  (`identity_latency`), with per-lease/per-identity counters exported.
+
 Determinism: every injection draws from ONE `random.Random(seed)`, so a
 given (seed, workload, call sequence) replays the same fault script —
 that's what makes the chaos parity soak a correctness gate instead of a
@@ -74,6 +81,15 @@ class ChaosConfig:
     # its renew deadline deterministically)
     renew_latency_rate: float = 0.0
     renew_latency_seconds: tuple[float, float] = (0.0, 0.0)
+    # shard-aware targeting (ISSUE 17): scope the lease expire/steal
+    # chaos to these lease names and/or holder identities (empty = all),
+    # so the matrix can aim a storm at ONE shard while peers stay healthy
+    target_leases: tuple = ()
+    target_identities: tuple = ()
+    # asymmetric per-client latency: identity -> (rate, lo_s, hi_s),
+    # applied through for_identity() views — one slow shard client while
+    # the rest of the fleet sees the base fault script
+    identity_latency: dict[str, tuple] = field(default_factory=dict)
     # constant skew added to the timestamp the HOLDER's renews record
     # (fresh acquires use the candidate's true clock): a negative skew
     # models a leader whose clock lags — its renewTimes land in the
@@ -113,6 +129,9 @@ class ChaosAPIServer:
         self.lease_expirations = 0
         self.lease_steals = 0
         self.renew_latency_spikes = 0
+        # shard-aware counters (ISSUE 17)
+        self.lease_events_by_name: dict[str, int] = {}
+        self.identity_latency_total: dict[str, float] = {}
 
     def __getattr__(self, name: str):
         return getattr(self.inner, name)
@@ -254,10 +273,13 @@ class ChaosAPIServer:
         lease = self.inner.get_lease(name)
         if lease is None or not lease.holder_identity:
             return
+        if not self._targeted(name, lease.holder_identity):
+            return
         if cfg.lease_expire_rate \
                 and self.rng.random() < cfg.lease_expire_rate:
             lease.renew_time -= lease.lease_duration_s + 1.0
             self.lease_expirations += 1
+            self._count_lease_event(name)
         if renewing and cfg.lease_steal_rate \
                 and self.rng.random() < cfg.lease_steal_rate:
             # a rogue holder claimed the lease mid-renew: the elector's
@@ -268,6 +290,65 @@ class ChaosAPIServer:
             lease.lease_transitions += 1
             lease.generation += 1
             lease.holder_identity = f"chaos-thief-{self.lease_steals}"
+            self._count_lease_event(name)
+
+    # -- shard-aware targeting (ISSUE 17) -------------------------------------
+
+    def _targeted(self, name: str, identity: str) -> bool:
+        cfg = self.cfg
+        if cfg.target_leases and name not in cfg.target_leases:
+            return False
+        if cfg.target_identities and identity not in cfg.target_identities:
+            return False
+        return True
+
+    def _count_lease_event(self, name: str) -> None:
+        self.lease_events_by_name[name] = \
+            self.lease_events_by_name.get(name, 0) + 1
+
+    def lease_storm(self, names=None, steal: bool = False) -> int:
+        """Deterministically expire (or steal) leases NOW — a seeded
+        storm across all N shard leases, honoring the targeting config.
+        Returns how many leases were hit. The per-call rate knobs model
+        background weather; this is the directed lightning strike the
+        shard-lifecycle matrix schedules between phases."""
+        hit = 0
+        pool = sorted(names if names is not None else self.inner.leases)
+        for name in pool:
+            lease = self.inner.get_lease(name)
+            if lease is None or not lease.holder_identity:
+                continue
+            if not self._targeted(name, lease.holder_identity):
+                continue
+            if steal:
+                self.lease_steals += 1
+                lease.lease_transitions += 1
+                lease.generation += 1
+                lease.holder_identity = f"chaos-thief-{self.lease_steals}"
+            else:
+                lease.renew_time -= lease.lease_duration_s + 1.0
+                self.lease_expirations += 1
+            self._count_lease_event(name)
+            hit += 1
+        return hit
+
+    def _identity_latency(self, identity: str) -> None:
+        spec = self.cfg.identity_latency.get(identity)
+        if not spec:
+            return
+        rate, lo, hi = spec
+        if rate and self.rng.random() < rate:
+            d = lo + (hi - lo) * self.rng.random()
+            self.identity_latency_total[identity] = \
+                self.identity_latency_total.get(identity, 0.0) + d
+            self.injected_latency_total += d
+            self.sleep(d)
+
+    def for_identity(self, identity: str) -> "ChaosClientView":
+        """A per-client view of this facade: same seeded fault script,
+        plus the asymmetric latency configured for `identity`. Hand each
+        shard scheduler its own view to model one slow shard client."""
+        return ChaosClientView(self, identity)
 
     def _renew_spike(self) -> None:
         cfg = self.cfg
@@ -307,3 +388,32 @@ class ChaosAPIServer:
     def release_lease(self, name, identity):
         self._inject("lease_release")
         return self.inner.release_lease(name, identity)
+
+
+class ChaosClientView:
+    """One client identity's window onto a shared ChaosAPIServer: every
+    mutating verb first pays that identity's asymmetric latency (config
+    identity_latency), then runs the shared seeded fault script. Reads,
+    watch registration, and every other attribute forward untouched — a
+    scheduler constructed against a view sees the full client surface."""
+
+    _LATENCY_VERBS = frozenset((
+        "create_pod", "create_pods", "update_pod", "delete_pod",
+        "bind", "bind_all", "patch_pod_status",
+        "acquire_lease", "renew_lease", "release_lease"))
+
+    def __init__(self, chaos: ChaosAPIServer, identity: str):
+        # avoid __setattr__/__getattr__ recursion via object.__setattr__
+        object.__setattr__(self, "chaos", chaos)
+        object.__setattr__(self, "identity", identity)
+
+    def __getattr__(self, name: str):
+        attr = getattr(self.chaos, name)
+        if name in self._LATENCY_VERBS:
+            chaos, identity = self.chaos, self.identity
+
+            def with_latency(*args, **kw):
+                chaos._identity_latency(identity)
+                return attr(*args, **kw)
+            return with_latency
+        return attr

@@ -435,6 +435,35 @@ class TestExpositionLint:
         finally:
             obs.reset()
 
+    def test_issue17_families_covered_by_lint(self):
+        """ISSUE 17 satellite: the sharded-control-plane families are
+        registered AND pre-seeded with the EXACT label sets the shard
+        dashboards (and bench_metrics.prom) key on."""
+        from kubernetes_tpu.metrics import (CROSS_SHARD_OUTCOMES,
+                                            SHARD_SEED_IDS,
+                                            SHARD_STEAL_REASONS)
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_shard_assignments"] == "gauge"
+        assert types["scheduler_shard_rebalance_seconds"] == "histogram"
+        assert types["scheduler_shard_steals_total"] == "counter"
+        assert types["scheduler_cross_shard_conflicts_total"] == "counter"
+        shards = {lbl["shard"] for lbl, _v in
+                  series["scheduler_shard_assignments"]}
+        assert shards == set(SHARD_SEED_IDS)
+        assert set(SHARD_SEED_IDS) == {str(i) for i in range(4)}
+        reasons = {lbl["reason"] for lbl, _v in
+                   series["scheduler_shard_steals_total"]}
+        assert reasons == set(SHARD_STEAL_REASONS)
+        assert set(SHARD_STEAL_REASONS) == {"split", "merge", "steal",
+                                            "rebalance"}
+        outcomes = {lbl["outcome"] for lbl, _v in
+                    series["scheduler_cross_shard_conflicts_total"]}
+        assert outcomes == set(CROSS_SHARD_OUTCOMES)
+        assert set(CROSS_SHARD_OUTCOMES) == {"conflict", "fenced"}
+        # the rebalance histogram's zero-seed rides the generic lint
+        assert "scheduler_shard_rebalance_seconds_count" in series
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

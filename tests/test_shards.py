@@ -1,0 +1,751 @@
+"""Sharded control plane (ISSUE 17): N fenced schedulers over one cluster.
+
+The gates this file establishes:
+
+- the ShardMap is a fenced, versioned CAS object: topology changes lose
+  version races (`Conflict`) and stale-fenced writers (`FencedWrite`);
+  routing falls back to a process-independent hash for unmapped keys;
+- split (1→N): each shard's slice is scheduled ONLY by its lease holder,
+  peers keep the slice warm PARKED (watch-fed, never queued), and the
+  fleet's final assignment map byte-matches a single-scheduler replay
+  twin driven by the recorded commit order;
+- steal mid-drain: a victim holding an uncommitted flush is fenced by
+  the generation bump — every late bind is rejected server-side, the
+  assumes unwind, the successor binds each pod exactly once;
+- merge (N→1): ownership collapses onto one instance with the
+  predecessors' audit-chain positions annexed (`record_handoff`), and
+  every per-shard ledger verifies across every handoff;
+- the kill-at-every-phase matrix (slow): a shard leader dies at
+  host_build / device / commit / mid-flush, a peer steals the orphaned
+  shard, and the outcome is indistinguishable from a serial run — zero
+  double-binds (`binding_count` exact), zero oracle divergence at 100%
+  sampling, replay-twin parity;
+- seeded lease storms (chaos): expiry/steal strikes aimed at the shard
+  leases shake ownership repeatedly; the fleet still converges with
+  zero double-binds and intact ledgers.
+
+Plus the satellite regressions: the standby sync-vs-watch ingest race
+(ISSUE 17 bugfix), shard-aware chaos targeting, the cross-shard
+conflict fuzz, /debug/shards, and the flight-record shard tag.
+"""
+
+import os
+import random
+import threading
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import (APIServer, Conflict,
+                                              FencedWrite, ShardMap)
+from kubernetes_tpu.ha import (LeaderElector, ShardManager, ShardScheduler,
+                               StandbyScheduler, fence_dispatcher,
+                               shard_key, shard_lease_name)
+from kubernetes_tpu.obs.audit import DrainLedger
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.chaos import ChaosAPIServer, ChaosConfig
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class Killed(Exception):
+    """Simulated process death: propagates out of the scheduling loop,
+    leaving whatever the 'process' had not committed uncommitted."""
+
+
+def _no_sleep(sched):
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _audited(sched):
+    assert sched.audit is not None, "ShadowOracleAudit gate must be on"
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
+    return sched
+
+
+def _nodes(api, n=6, cpu=16, mem="32Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _specs(n, seed, prefix="p", ns="default"):
+    rng = random.Random(seed)
+    return [(f"{prefix}{i}", ns, 250 * rng.randint(1, 6),
+             512 * rng.randint(1, 4)) for i in range(n)]
+
+
+def _create(api, specs, raw=None):
+    """Create the pods; `raw` (uid → spec tuple) feeds the replay twin."""
+    for name, ns, cpu, mem in specs:
+        pod = make_pod(name, namespace=ns).req(
+            {"cpu": f"{cpu}m", "memory": f"{mem}Mi"}).obj()
+        if raw is not None:
+            raw[pod.uid] = (name, ns, cpu, mem)
+        api.create_pod(pod)
+
+
+def _assignments(api):
+    return {uid: p.spec.node_name for uid, p in api.pods.items()}
+
+
+def _shard(client, identity, clock, **kw):
+    inst = ShardScheduler(client, identity=identity, clock=clock,
+                          batch_size=32, **kw)
+    _audited(_no_sleep(inst.scheduler))
+    return inst
+
+
+def _drive(api, insts, clock, want_bound, mgr=None, max_rounds=80):
+    """Round-robin the fleet to quiescence: tick (elections), drain,
+    advance time, retry backoffs — the fleet's control loop."""
+    for _ in range(max_rounds):
+        for inst in insts:
+            inst.tick()
+            inst.scheduler.schedule_pending()
+            clock.t += 5.0
+            inst.scheduler.flush_queues()
+        if mgr is not None:
+            mgr.sync_all()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= want_bound:
+            return
+    bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+    raise AssertionError(f"fleet did not quiesce: {bound}/{want_bound}")
+
+
+class BindRecorder:
+    """Wraps the store's bind verbs to record every committed chunk
+    (uid, node) in commit order — the replay-twin's script. Installed on
+    the INNER store so chaos/killer facades route through it."""
+
+    def __init__(self, api):
+        self.chunks = []
+        self._real_all, self._real_one = api.bind_all, api.bind
+        api.bind_all = self._bind_all
+        api.bind = self._bind
+
+    def _bind_all(self, pairs, fence_token=None):
+        failures = self._real_all(pairs, fence_token=fence_token)
+        failed = {p.uid for p, _e in failures}
+        chunk = [(a.uid, a.spec.node_name) for a, _o in pairs
+                 if a.uid not in failed]
+        if chunk:
+            self.chunks.append(chunk)
+        return failures
+
+    def _bind(self, pod, node_name, fence_token=None):
+        out = self._real_one(pod, node_name, fence_token=fence_token)
+        self.chunks.append([(pod.uid, node_name)])
+        return out
+
+
+def _replay_twin(raw, chunks, n_nodes, cpu=32, mem="64Gi"):
+    """Feed the recorded commit order, chunk by chunk, to ONE fresh
+    scheduler on a fresh store: if sharding changed nothing but WHO
+    drains a pod, the twin's final assignment map is byte-identical."""
+    api = APIServer()
+    _nodes(api, n=n_nodes, cpu=cpu, mem=mem)
+    clock = Clock()
+    sched = _audited(_no_sleep(Scheduler(api, batch_size=32, clock=clock)))
+    want = 0
+    for chunk in chunks:
+        _create(api, [raw[uid] for uid, _node in chunk])
+        want += len(chunk)
+        for _ in range(60):
+            sched.schedule_pending()
+            if sum(1 for p in api.pods.values() if p.spec.node_name) >= want:
+                break
+            clock.t += 5.0
+            sched.flush_queues()
+    assert sched.reconcile() == []
+    return _assignments(api)
+
+
+def _fleet(api, clock, identities=("sched-a", "sched-b"), clients=None):
+    insts = [_shard((clients or {}).get(ident, api), ident, clock)
+             for ident in identities]
+    mgr = ShardManager(api, instances=insts, clock=clock)
+    mgr.wire_ledgers()
+    return insts, mgr
+
+
+# -- the ShardMap object -------------------------------------------------------
+
+
+def test_shard_map_cas_fencing_and_routing():
+    """The shard map is itself a fenced, versioned API object: CAS races
+    lose with Conflict, stale fences with FencedWrite; routing prefers
+    the explicit assignment and falls back to a stable hash."""
+    api = APIServer()
+    m = api.get_shard_map()
+    assert m.num_shards == 1 and m.version == 0    # absent = trivial map
+
+    out = api.put_shard_map(ShardMap(num_shards=4, assignments={
+        "default-scheduler/team-a": 0}), expect_version=0)
+    assert out.version == 1 and out.num_shards == 4
+    # version race: the CAS loser is told, not silently overwritten
+    with pytest.raises(Conflict):
+        api.put_shard_map(ShardMap(num_shards=2), expect_version=0)
+    # explicit assignment wins; unmapped keys hash deterministically
+    assert out.shard_for("default-scheduler/team-a") == 0
+    sid = out.shard_for("default-scheduler/team-z")
+    assert 0 <= sid < 4
+    assert sid == out.shard_for("default-scheduler/team-z")    # stable
+    # an out-of-range assignment (map shrank) falls back to the hash
+    stale = api.put_shard_map(ShardMap(num_shards=2, assignments={
+        "default-scheduler/team-a": 3}), expect_version=1)
+    assert 0 <= stale.shard_for("default-scheduler/team-a") < 2
+
+    # topology writes are fenced like any other write
+    api.acquire_lease(shard_lease_name(0), "sched-a", 0.0)
+    with pytest.raises(FencedWrite):
+        api.put_shard_map(ShardMap(num_shards=8), expect_version=2,
+                          fence_token=(shard_lease_name(0), 99))
+    api.put_shard_map(ShardMap(num_shards=8), expect_version=2,
+                      fence_token=(shard_lease_name(0), 1))
+
+
+def test_ledger_handoff_annex():
+    """The handoff annex is its own hash chain: entries fold from
+    genesis, verify_handoffs replays the fold, tampering breaks it."""
+    led = DrainLedger()
+    e1 = led.record_handoff(0, "abcd" * 16, 7)
+    e2 = led.record_handoff(1, "beef" * 16, 12)
+    assert e2["prev"] == e1["hash"]
+    assert led.verify_handoffs()
+    assert led.verify()                      # the drain chain is untouched
+    led.handoffs[0]["seq"] = 99              # tamper
+    assert not led.verify_handoffs()
+
+
+# -- split: fenced slices, warm parks, twin parity -----------------------------
+
+
+def test_split_two_shards_twin_parity():
+    """1→2 split: each namespace's slice binds under its own shard
+    lease, peers park (never queue) the other slice, and the fleet's
+    final map byte-matches the single-scheduler replay twin."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    rec = BindRecorder(api)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    assert a.held() == (0,) and b.held() == (1,)
+
+    raw = {}
+    _create(api, _specs(12, seed=SEED, prefix="pa", ns="ns-a"), raw)
+    _create(api, _specs(12, seed=SEED + 1, prefix="pb", ns="ns-b"), raw)
+    _drive(api, (a, b), clock, want_bound=24, mgr=mgr)
+
+    assert api.binding_count == 24           # each pod bound exactly once
+    # every pod landed under its OWN shard's fence: zero cross-shard noise
+    assert api.fenced_rejections == 0 and a.conflicts == b.conflicts == 0
+    # parks drained by the peer-bind echo, nothing leaks
+    assert not a.scheduler._shard_parked and not b.scheduler._shard_parked
+    assert a.scheduler.reconcile() == [] and b.scheduler.reconcile() == []
+    assert _replay_twin(raw, rec.chunks, n_nodes=8) == _assignments(api)
+    for inst in (a, b):
+        assert inst.audit_ledger().verify()
+    # the assignment gauge reflects the explicit map
+    assert a.scheduler.metrics.shard_assignments.value("0") == 1.0
+    assert a.scheduler.metrics.shard_assignments.value("1") == 1.0
+
+
+def test_steal_mid_drain_zombie_cannot_double_bind():
+    """THE fencing proof, N-way: a victim loses its shard lease while a
+    full drain sits uncommitted in its dispatcher. Its late flush
+    carries the stale generation — every bind is rejected server-side,
+    the assumes unwind through on_bind_error, the pods re-park, and the
+    thief binds each exactly once."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+
+    _create(api, _specs(8, seed=SEED, prefix="pb", ns="ns-b"))
+    b.tick()
+    real_flush = b.scheduler.dispatcher.flush
+    b.scheduler.dispatcher.flush = lambda *al, **kw: 0    # hold the commit
+    b.scheduler.schedule_pending()
+    assert len(b.scheduler.dispatcher) == 8
+    assert len(b.scheduler.cache.assumed_pods) == 8
+
+    mgr.steal(1, a)                          # generation bump = the fence
+    assert mgr.steals == 1
+    # the victim is a ZOMBIE: it still believes it leads until it ticks
+    assert b.holds(1)
+
+    before = api.binding_count
+    b.scheduler.dispatcher.flush = real_flush
+    b.scheduler.dispatcher.flush()           # the zombie's late flush
+    assert api.binding_count == before, "zombie committed a bind"
+    assert api.fenced_rejections > 0
+    assert not b.scheduler.cache.assumed_pods         # assumes unwound
+    assert b.conflicts == 8
+    assert b.scheduler.metrics.cross_shard_conflicts.value("fenced") == 8
+    # the unwound pods re-PARKED (not re-queued): the loser must not
+    # keep re-scheduling the winner's slice
+    assert len(b.scheduler._shard_parked) == 8
+
+    b.tick()                                 # observes the loss
+    assert not b.holds(1) and b.held() == ()
+    _drive(api, (a,), clock, want_bound=8)
+    assert api.binding_count == 8            # successor bound each ONCE
+    assert a.scheduler.reconcile() == [] and b.scheduler.reconcile() == []
+    # the steal latency and reason were observed
+    m = a.scheduler.metrics
+    assert m.shard_steals.value("steal") == 1
+    assert m.shard_rebalance.count() >= 1
+
+
+def test_merge_collapses_ownership_with_annexed_chains():
+    """N→1 merge: one instance takes every shard lease, annexes each
+    predecessor's audit-chain position, and schedules the whole cluster;
+    every ledger (and its handoff annex) verifies."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, _specs(10, seed=SEED, prefix="pa", ns="ns-a"))
+    _create(api, _specs(10, seed=SEED + 1, prefix="pb", ns="ns-b"))
+    _drive(api, (a, b), clock, want_bound=20, mgr=mgr)
+    a_head = a.audit_ledger().head_hash()
+
+    mgr.merge(b)
+    assert mgr.merges == 1
+    assert b.held() == (0, 1) and a.held() == ()
+    # b annexed a's chain position at the moment of the handoff
+    annex = b.audit_ledger().handoffs
+    assert any(e["shard"] == 0 and e["head"] == a_head for e in annex)
+    mgr.set_topology(1, assignments={})      # collapse the key space too
+    assert mgr.shard_map().num_shards == 1
+
+    _create(api, _specs(6, seed=SEED + 2, prefix="pc", ns="ns-a"))
+    _drive(api, (b,), clock, want_bound=26)
+    assert api.binding_count == 26
+    for inst in (a, b):
+        assert inst.audit_ledger().verify()
+        assert inst.audit_ledger().verify_handoffs()
+    assert b.scheduler.reconcile() == []
+
+
+# -- the shard-lifecycle kill matrix -------------------------------------------
+
+
+class MidFlushKiller:
+    """Victim-only client facade: when armed, the next bulk bind commits
+    its first half and then the 'process' dies — the half-flushed batch
+    a real crash leaves behind."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.armed = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def bind_all(self, pairs, fence_token=None):
+        if self.armed and len(pairs) > 1:
+            self.armed = False
+            self.inner.bind_all(pairs[:len(pairs) // 2],
+                                fence_token=fence_token)
+            raise Killed("died mid-flush")
+        return self.inner.bind_all(pairs, fence_token=fence_token)
+
+
+def _arm_kill(sched, phase, client=None):
+    """Wire the simulated death into the chosen drain phase."""
+    if phase == "host_build":
+        orig = sched.builder.build
+
+        def die(*a, **k):
+            sched.builder.build = orig
+            raise Killed("died in host build")
+        sched.builder.build = die
+    elif phase == "device":
+        def die(*a, **k):
+            raise Killed("died before commit")
+        sched._commit_next = die
+    elif phase == "commit":
+        orig_flush = sched.dispatcher.flush
+
+        def die_flush(*a, **k):
+            if len(sched.dispatcher):
+                raise Killed("died before the API flush")
+            return orig_flush(*a, **k)
+        sched.dispatcher.flush = die_flush
+    elif phase == "mid_flush":
+        client.armed = True
+    else:                            # pragma: no cover
+        raise AssertionError(phase)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase",
+                         ["host_build", "device", "commit", "mid_flush"])
+def test_shard_leader_kill_matrix(phase):
+    """Kill a shard leader at every drain phase, steal its orphaned
+    shard, and prove the outcome indistinguishable from a serial run:
+    replay-twin parity, binding_count exact (zero double-binds), zero
+    oracle divergence at 100% sampling, every ledger + handoff annex
+    intact."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    rec = BindRecorder(api)
+    clock = Clock()
+    victim_client = MidFlushKiller(api) if phase == "mid_flush" else api
+    (a, b), mgr = _fleet(api, clock, clients={"sched-b": victim_client})
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+
+    raw = {}
+    _create(api, _specs(20, seed=100, prefix="pa", ns="ns-a"), raw)
+    _create(api, _specs(20, seed=101, prefix="pb", ns="ns-b"), raw)
+    _drive(api, (a, b), clock, want_bound=40, mgr=mgr)
+
+    _create(api, _specs(24, seed=200, prefix="pc", ns="ns-b"), raw)
+    _arm_kill(b.scheduler, phase, client=victim_client)
+    with pytest.raises(Killed):
+        b.scheduler.schedule_pending()
+    # b is dead: it never ticks, renews or flushes again
+    clock.t += 20.0                          # its shard lease expires
+    mgr.steal(1, a)                          # peer takes the orphan
+    assert a.held() == (0, 1)
+
+    _drive(api, (a,), clock, want_bound=64)
+    assert api.binding_count == 64           # zero double-binds, ever
+    assert not a.scheduler.cache.assumed_pods
+    assert a.scheduler.reconcile() == []
+    assert _replay_twin(raw, rec.chunks, n_nodes=8) == _assignments(api)
+    for sched in (a.scheduler, b.scheduler):
+        for kind in ("assignment", "reason", "verdict"):
+            assert sched.metrics.oracle_divergence.value(kind) == 0, kind
+    for inst in (a, b):
+        assert inst.audit_ledger().verify()
+        assert inst.audit_ledger().verify_handoffs()
+    # the annex anchors b's chain position at the steal
+    assert any(e["shard"] == 1 for e in a.audit_ledger().handoffs)
+
+
+def test_seeded_lease_storm_soak():
+    """Chaos aims expiry/steal storms at the SHARD leases every few
+    rounds: ownership thrashes, zombies get fenced, and the fleet still
+    converges — zero double-binds, clean reconcile, intact ledgers."""
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    chaos = ChaosAPIServer(api, ChaosConfig(
+        seed=SEED,
+        target_leases=(shard_lease_name(0), shard_lease_name(1))))
+    (a, b), mgr = _fleet(chaos, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    raw = {}
+    _create(chaos, _specs(18, seed=SEED, prefix="pa", ns="ns-a"), raw)
+    _create(chaos, _specs(18, seed=SEED + 1, prefix="pb", ns="ns-b"), raw)
+
+    rng = random.Random(SEED)
+    storms = 0
+    for round_no in range(60):
+        for inst in (a, b):
+            inst.tick()
+            inst.scheduler.schedule_pending()
+            clock.t += 5.0
+            inst.scheduler.flush_queues()
+        if round_no % 7 == 3:                # a seeded strike
+            storms += chaos.lease_storm(steal=rng.random() < 0.5)
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= 36 and round_no > 20:
+            break
+    # storms really landed, and only on the targeted shard leases
+    assert storms > 0
+    assert set(chaos.lease_events_by_name) <= {
+        shard_lease_name(0), shard_lease_name(1)}
+
+    bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+    assert bound == 36
+    assert api.binding_count == 36           # zero double-binds
+    assert a.scheduler.reconcile() == [] and b.scheduler.reconcile() == []
+    for inst in (a, b):
+        assert inst.audit_ledger().verify()
+        assert not inst.scheduler.cache.assumed_pods
+
+
+# -- satellite: cross-shard conflict fuzz --------------------------------------
+
+
+@pytest.mark.parametrize("fuzz_seed", [SEED, SEED + 1, SEED + 2])
+def test_cross_shard_conflict_fuzz(fuzz_seed):
+    """Two shards race assume/bind for the SAME pods over the same node
+    set: the slow loser's flush lands after a topology change moved its
+    slice to the peer. The pod-level Conflict guard (and the fence, when
+    the lease moved too) unwinds it — zero double-binds, clean
+    reconcile, zero oracle divergence."""
+    rng = random.Random(fuzz_seed)
+    api = APIServer()
+    _nodes(api, n=8, cpu=32, mem="64Gi")
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-x": 0,
+                           "default-scheduler/ns-b": 1})
+    n = rng.randint(6, 12)
+    _create(api, _specs(n, seed=fuzz_seed, prefix="px", ns="ns-x"))
+
+    # a computes a full drain for ns-x but its flush stalls (slow client)
+    a.tick()
+    real_flush = a.scheduler.dispatcher.flush
+    a.scheduler.dispatcher.flush = lambda *al, **kw: 0
+    a.scheduler.schedule_pending()
+    assert len(a.scheduler.cache.assumed_pods) == n
+
+    # the slice moves to shard 1 mid-flight; b adopts and races ahead.
+    # a's shard-0 lease is UNTOUCHED, so its stale flush passes the
+    # fence — the pod-level "already assigned" guard is the line.
+    mgr.set_topology(2, assignments={"default-scheduler/ns-x": 1,
+                                     "default-scheduler/ns-b": 1})
+    b.tick()
+    b.rebalance()
+
+    flush_first = rng.random() < 0.5
+    if flush_first:                          # a's flush lands FIRST: it
+        a.scheduler.dispatcher.flush = real_flush       # wins the race
+        a.scheduler.dispatcher.flush()
+    _drive(api, (b,), clock, want_bound=n)
+    if not flush_first:                      # a's flush lands LAST
+        a.scheduler.dispatcher.flush = real_flush
+        a.scheduler.dispatcher.flush()
+
+    bound = [p for p in api.pods.values() if p.spec.node_name]
+    assert len(bound) == n
+    assert api.binding_count == n, "a cross-shard race double-bound"
+    assert not a.scheduler.cache.assumed_pods
+    assert not b.scheduler.cache.assumed_pods
+    if not flush_first:
+        # the loser saw n pod-level conflicts, all unwound + re-parked
+        assert a.conflicts == n
+        assert a.scheduler.metrics.cross_shard_conflicts.value(
+            "conflict") + a.scheduler.metrics.cross_shard_conflicts.value(
+            "fenced") >= n
+    a.rebalance()
+    assert a.scheduler.reconcile() == [] and b.scheduler.reconcile() == []
+    for sched in (a.scheduler, b.scheduler):
+        for kind in ("assignment", "reason", "verdict"):
+            assert sched.metrics.oracle_divergence.value(kind) == 0, kind
+
+
+# -- satellite: the standby sync-vs-ingest race --------------------------------
+
+
+def test_standby_sync_races_watch_ingest():
+    """Regression (ISSUE 17 bugfix): StandbyScheduler.sync()'s host
+    rebuild used to iterate workload state WHILE watch handlers mutated
+    it — a torn re-tensorize. Both sides now hold the scheduler's
+    ingest lock; a concurrent create storm during a sync loop must
+    neither raise nor corrupt the snapshot."""
+    api = APIServer()
+    _nodes(api, n=4, cpu=32, mem="64Gi")
+    clock = Clock()
+    leader = _audited(_no_sleep(Scheduler(api, batch_size=16, clock=clock)))
+    el = LeaderElector(api, "sched-a", clock=clock)
+    fence_dispatcher(leader.dispatcher, el)
+    assert el.tick() is True
+    _create(api, _specs(4, seed=SEED, prefix="warm"))
+    leader.schedule_pending()
+
+    inner = _audited(_no_sleep(Scheduler(api, batch_size=16, clock=clock)))
+    standby = StandbyScheduler(api, identity="sched-b", clock=clock,
+                               ledger=leader.audit.ledger, scheduler=inner)
+    errors = []
+    stop = threading.Event()
+
+    def feeder():
+        i = 0
+        try:
+            while not stop.is_set() and i < 400:
+                _create(api, [(f"race{i}", "default", 100, 64)])
+                i += 1
+        except Exception as exc:             # pragma: no cover
+            errors.append(exc)
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    try:
+        for _ in range(40):
+            standby.sync()                   # full rebuild, every loop
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    # post-race: one more locked sync + a full resync leave a snapshot
+    # consistent with the store (every unbound pod accounted for)
+    standby.sync()
+    inner.resync()
+    pending, _ = inner.queue.pending_pods()
+    unbound = sum(1 for p in api.pods.values() if not p.spec.node_name)
+    assert len(pending) == unbound
+
+
+# -- satellite: shard-aware chaos targeting ------------------------------------
+
+
+def test_chaos_lease_targeting_scopes_faults():
+    """target_leases narrows the expiry storm to named leases: the
+    untargeted shard's lease never ages, and the per-name counters
+    export exactly what was hit."""
+    api = APIServer()
+    chaos = ChaosAPIServer(api, ChaosConfig(
+        seed=SEED, lease_expire_rate=1.0,
+        target_leases=(shard_lease_name(0),)))
+    chaos.acquire_lease(shard_lease_name(0), "sched-a", 0.0)
+    chaos.acquire_lease(shard_lease_name(1), "sched-b", 0.0)
+    for t in range(1, 6):
+        chaos.renew_lease(shard_lease_name(0), "sched-a", float(t))
+        chaos.renew_lease(shard_lease_name(1), "sched-b", float(t))
+    assert chaos.lease_events_by_name.get(shard_lease_name(0), 0) > 0
+    assert shard_lease_name(1) not in chaos.lease_events_by_name
+
+
+def test_chaos_lease_storm_is_deterministic():
+    """lease_storm strikes every targeted lease at once; steal=True
+    swaps the holder AND bumps the generation, so every outstanding
+    fence pair for that shard goes stale in one stroke."""
+    api = APIServer()
+    chaos = ChaosAPIServer(api, ChaosConfig(seed=SEED))
+    for sid in range(3):
+        api.acquire_lease(shard_lease_name(sid), f"sched-{sid}", 100.0)
+    gens = {sid: api.get_lease(shard_lease_name(sid)).generation
+            for sid in range(3)}
+
+    struck = chaos.lease_storm(steal=True)
+    assert struck == 3
+    for sid in range(3):
+        lease = api.get_lease(shard_lease_name(sid))
+        assert lease.holder_identity.startswith("chaos-thief")
+        assert lease.generation == gens[sid] + 1
+    assert sum(chaos.lease_events_by_name.values()) == 3
+
+    # expiry flavour: holder unchanged, renewTime aged past the duration
+    api2 = APIServer()
+    chaos2 = ChaosAPIServer(api2, ChaosConfig(seed=SEED))
+    api2.acquire_lease(shard_lease_name(0), "sched-a", 100.0,
+                       lease_duration_s=15.0)
+    assert chaos2.lease_storm() == 1
+    lease = api2.get_lease(shard_lease_name(0))
+    assert lease.holder_identity == "sched-a"
+    assert lease.renew_time < 100.0 - 15.0
+
+
+def test_chaos_asymmetric_identity_latency():
+    """for_identity() views give ONE shard client a private latency
+    distribution while peers ride the base script — and the per-identity
+    totals are exported for the matrix to assert on."""
+    api = APIServer()
+    _nodes(api, n=2)
+    slept = []
+    chaos = ChaosAPIServer(api, ChaosConfig(
+        seed=SEED,
+        identity_latency={"sched-b": (1.0, 0.01, 0.01)}),
+        sleep=slept.append)
+    view_a = chaos.for_identity("sched-a")
+    view_b = chaos.for_identity("sched-b")
+
+    _create(view_a, _specs(3, seed=1, prefix="fast"))
+    assert not slept and not chaos.identity_latency_total
+
+    _create(view_b, _specs(3, seed=2, prefix="slow"))
+    assert len(slept) == 3
+    assert chaos.identity_latency_total["sched-b"] == pytest.approx(0.03)
+    assert "sched-a" not in chaos.identity_latency_total
+    # non-latency verbs pass straight through the view
+    assert view_b.get_lease("nope") is None
+
+
+# -- satellite: observability --------------------------------------------------
+
+
+def test_debug_shards_endpoint():
+    """/debug/shards serves the manager's topology + per-shard lease
+    view; without a manager it degrades to the instance's slice."""
+    import json
+    import urllib.request
+
+    from kubernetes_tpu.server import SchedulerServer
+
+    api = APIServer()
+    _nodes(api, n=2)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0})
+
+    srv = SchedulerServer(a.scheduler, shard_manager=mgr).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/shards") as r:
+            payload = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert payload["numShards"] == 2
+    assert payload["assignments"] == {"default-scheduler/ns-a": 0}
+    assert payload["leases"]["0"]["holder"] == "sched-a"
+    assert payload["leases"]["1"]["holder"] == "sched-b"
+    assert payload["leases"]["1"]["generation"] >= 1
+    assert {i["identity"] for i in payload["instances"]} \
+        == {"sched-a", "sched-b"}
+
+    srv2 = SchedulerServer(a.scheduler).start()   # no manager: fallback
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv2.port}/debug/shards") as r:
+            fallback = json.loads(r.read())
+    finally:
+        srv2.stop()
+    assert fallback["numShards"] is None
+    assert fallback["shardIds"] == [0]
+
+
+def test_flight_record_carries_shard_tag():
+    """Every drain committed while holding shard leases is tagged with
+    the owned shard ids in the flight ring (and a plain scheduler's
+    records stay untagged)."""
+    api = APIServer()
+    _nodes(api, n=4, cpu=32, mem="64Gi")
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, _specs(4, seed=SEED, prefix="pa", ns="ns-a"))
+    _drive(api, (a, b), clock, want_bound=4, mgr=mgr)
+    records = a.scheduler.flight.dump()
+    assert records and all(r["shard"] == [0] for r in records)
+
+    plain = _audited(_no_sleep(Scheduler(APIServer(), batch_size=8)))
+    _nodes(plain.client, n=2)
+    _create(plain.client, _specs(2, seed=SEED, prefix="q"))
+    plain.schedule_pending()
+    assert all(r["shard"] == [] for r in plain.flight.dump())

@@ -41,7 +41,10 @@ and fails (exit 2) on:
     gained failover time as a sixth SLI (`failover`: HA takeovers slower
     than the objective burn budget and gate here like any other breach);
     the warm-vs-cold takeover numbers themselves ride the bench extras
-    (`HAFailover_*`), which are recorded but never gated.
+    (`HAFailover_*`), which are recorded but never gated. ISSUE 17 adds
+    the `shard` block (MultiShardBasic_*): ANY double-bind or shadow-
+    oracle divergence recorded by the sharded control plane fails too —
+    the chaos matrix's zero-double-bind proof, enforced on every bench.
 
 Workloads present on only one side are reported but never fail (the case
 set grows over time); the `Sharded_` CPU-mesh probe is excluded — it is
@@ -116,6 +119,10 @@ NOISE = {
     # host-platform shards jitters with machine load
     "ShardedBasic": 0.30,
     "ShardedGang": 0.30,
+    # the sharded control plane (r17+): four instances round-robin one
+    # in-process store with a mid-run steal — wall time jitters with
+    # machine load like the other multi-process probes
+    "MultiShardBasic": 0.30,
 }
 
 SKIP_PREFIXES = ("Sharded_",)
@@ -141,6 +148,24 @@ def slo_failures(new: dict) -> list:
         if div:
             fails.append(f"ORACLE DIVERGENCE {w}: {div} shadow-audit "
                          "divergence(s) recorded")
+    # the sharded-control-plane proof block (ISSUE 17): zero double-binds
+    # and zero divergence are correctness invariants, not throughput —
+    # any nonzero count fails the sentinel outright
+    for w in sorted(new):
+        shard = new[w].get("shard")
+        if not isinstance(shard, dict) or not shard:
+            continue
+        db = int(shard.get("double_binds", 0) or 0)
+        if db:
+            fails.append(f"DOUBLE BIND {w}: {db} double-bind(s) recorded "
+                         "by the sharded control plane")
+        sdiv = int(shard.get("divergence", 0) or 0)
+        if sdiv:
+            fails.append(f"SHARD DIVERGENCE {w}: {sdiv} shadow-oracle "
+                         "divergence(s) across the shard fleet")
+        if shard.get("ledgers_verified") is False:
+            fails.append(f"LEDGER BREAK {w}: a per-shard drain ledger "
+                         "failed verification across a handoff")
     return fails
 
 
