@@ -171,6 +171,105 @@ print(json.dumps(out))
 '''
 
 
+_STREAM_SHARDED_CASE = r'''
+import json, sys, time
+sys.path.insert(0, REPO)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.parallel.sharding import make_mesh
+from kubernetes_tpu.pipeline import StreamingPipeline
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+from kubernetes_tpu.testing.workloads import chunked, poisson_arrivals
+
+mesh = make_mesh(8)
+
+def run():
+    api = APIServer()
+    sched = Scheduler(api, batch_size=BATCH, mesh=mesh)
+    for i in range(NODES):
+        api.create_node(make_node(f"n{i}").capacity(
+            {"cpu": 32, "memory": "64Gi", "pods": 110})
+            .zone(f"z{i % 16}").obj())
+    sched.prime()
+    sched.shard_profile_auto = False
+    # warm the sharded drain shapes before the paced window starts
+    for i in range(WARM):
+        api.create_pod(make_pod(f"warm-{i}").req(
+            {"cpu": "900m", "memory": "1Gi"}).obj())
+    sched.schedule_pending()
+    chk = sched.metrics.sli_duration.merged_counts()
+    pods = [make_pod(f"pod-{i}").req(
+        {"cpu": "900m", "memory": "1Gi"}).obj() for i in range(PODS)]
+    events = list(poisson_arrivals(chunked(pods, 128), qps=QPS, seed=0))
+    pipe = StreamingPipeline(sched, latency_budget_s=0.005)
+    pipe.start()
+    t0 = time.perf_counter()
+    for due, chunk in events:
+        lag = t0 + due - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        pipe.feed(chunk)
+    pipe.drain()
+    dt = time.perf_counter() - t0
+    pipe.stop()
+    st = pipe.stats()
+    m = sched.metrics
+    assert sched.scheduled_count == WARM + PODS, sched.scheduled_count
+    assert not st["errors"], st["errors"]
+    return {
+        "pods_per_s": round(PODS / dt, 1), "seconds": round(dt, 3),
+        "offered_qps": QPS,
+        "e2e_p50_ms": round(
+            m.sli_duration.quantile(0.50, since=chk) * 1e3, 3),
+        "e2e_p99_ms": round(
+            m.sli_duration.quantile(0.99, since=chk) * 1e3, 3),
+        "pipeline": st,
+    }
+
+passes = [run() for _ in range(RUNS)]
+passes.sort(key=lambda d: d["pods_per_s"])
+out = passes[len(passes) // 2]
+out["passes"] = [d["pods_per_s"] for d in passes]
+print(json.dumps(out))
+'''
+
+
+def streaming_sharded_case(nodes: int, pods: int, qps: float, runs: int,
+                           warm: int = 2048, batch: int = 2048,
+                           timeout: int = 900) -> dict:
+    """StreamingSharded (ISSUE 18): the open-loop Poisson arrival process
+    feeding the streaming drain pipeline over the node-axis-SHARDED mesh
+    backend — 8-virtual-device CPU mesh in a subprocess, same dance as
+    sharded_case. Proves the ingest/device/commit overlap composes with
+    XLA collectives over the node axis, and reports the same per-tier
+    sustained pods/s + delta e2e percentiles as StreamingBasic."""
+    import subprocess
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    code = ("REPO = %r\nNODES = %d\nPODS = %d\nQPS = %g\nRUNS = %d\n"
+            "WARM = %d\nBATCH = %d\n"
+            % (os.path.dirname(os.path.abspath(__file__)), nodes, pods,
+               qps, runs, warm, batch)) + _STREAM_SHARDED_CASE
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=timeout)
+        if out.returncode != 0 or not out.stdout.strip():
+            return {"error": f"probe exited {out.returncode}",
+                    "stderr_tail": out.stderr.strip()[-400:]}
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+        data["devices"] = 8
+        data["backend"] = "cpu-virtual-mesh"
+        data["value"] = data["pods_per_s"]
+        data["pods"] = pods
+        return data
+    except Exception as e:  # probe failure must not sink the headline
+        return {"error": str(e)[:200]}
+
+
 def sharded_case(nodes: int, pods: int, runs: int, gang: bool = False,
                  chunk: int = 256, batch: int = 2048,
                  timeout: int = 900) -> dict:
@@ -433,6 +532,68 @@ def main() -> None:
                   f"(warm pass {warm_s:.1f}s, measured {measured_s:.1f}s)",
                   file=sys.stderr)
 
+    if not case_filter or "StreamingBasic" in case_filter:
+        # streaming drain pipeline under open-loop Poisson load (ISSUE
+        # 18): each QPS tier runs BOTH the pipeline and the lock-step
+        # phase-train twin at the SAME offered load — the A/B the
+        # acceptance gate reads. Sustained pods/s is the open-loop
+        # absorption rate; e2e percentiles are per-tier DELTAS over the
+        # paced window (the warmup phase can't pollute them).
+        tiers = (["500Nodes_10kQPS"] if small else
+                 ["5000Nodes_10kQPS", "5000Nodes_20kQPS",
+                  "5000Nodes_40kQPS"])
+        for tier in tiers:
+            for mode in ("pipeline", "lockstep"):
+                wl_name = f"{tier}_{mode}"
+                t0 = time.perf_counter()
+                run_config(cfg, "StreamingBasic", wl_name)   # warm pass
+                warm_s = time.perf_counter() - t0
+                gc.collect()
+                gc.freeze()
+                passes = []
+                for _ in range(1 if small else 3):
+                    got = run_config(cfg, "StreamingBasic", wl_name,
+                                     verbose=verbose,
+                                     metrics_path="bench_metrics.prom")
+                    if not got:
+                        raise SystemExit(
+                            f"workload StreamingBasic/{wl_name} not found")
+                    passes.append(got[0][0])
+                passes.sort(key=lambda it: it.average)
+                item = passes[len(passes) // 2]
+                entry = dict(item.extras)
+                stream = entry.get("pipeline", {})
+                entry.update({
+                    "value": round(item.average, 1),
+                    "vs_baseline": round(item.average / 270.0, 2),
+                    "p50": round(item.perc50), "p95": round(item.perc95),
+                    "p99": round(item.perc99), "samples": item.samples,
+                    "pods": item.pods,
+                    "passes": [round(it.average, 1) for it in passes],
+                    "warm_pass_s": round(warm_s, 1),
+                    # per-tier e2e = the paced window's delta quantiles
+                    "e2e_p50_ms": stream.get("stream_e2e_p50_ms",
+                                             entry.get("e2e_p50_ms", 0.0)),
+                    "e2e_p99_ms": stream.get("stream_e2e_p99_ms",
+                                             entry.get("e2e_p99_ms", 0.0)),
+                })
+                results[f"StreamingBasic_{wl_name}"] = entry
+                if verbose:
+                    print(f"  StreamingBasic/{wl_name}: "
+                          f"{item.average:.1f} pods/s "
+                          f"occ={stream.get('occupancy')}",
+                          file=sys.stderr)
+
+    if not case_filter or "StreamingSharded" in case_filter:
+        # the streaming pipeline over the node-axis-sharded mesh backend
+        nodes, pods, qps, runs = ((500, 1024, 5000, 1) if small
+                                  else (5000, 8192, 20000, 2))
+        entry = streaming_sharded_case(nodes, pods, qps, runs)
+        if "error" not in entry:
+            results[f"StreamingSharded_{nodes}Nodes"] = entry
+        else:
+            results[f"StreamingSharded_{nodes}Nodes_FAILED"] = entry
+
     if not case_filter or "ShardedBasic" in case_filter:
         # ShardedBasic (ISSUE 10 satellite / ROADMAP item 1): the
         # node-axis-sharded program's throughput as a first-class,
@@ -547,6 +708,10 @@ def main() -> None:
             # and divergence counts bench_compare's --slo gate fails on
             # ({} for single-instance cases)
             "shard": entry.get("shard", {}),
+            # streaming-pipeline occupancy block (ISSUE 18): per-stage
+            # busy seconds, overlap factor (busySum/wall), backpressure
+            # and batch-close counts ({} for non-streaming cases)
+            "pipeline": entry.get("pipeline", {}),
         }
 
     head_key = next(iter(results))

@@ -117,6 +117,14 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # /debug/timeline + the config-gated JSON-lines exporter
     # (timeline_export_path) + bench --timeline-dir.
     "TelemetryTimeline": FeatureSpec(True, BETA),
+    # streaming drain pipeline (kubernetes_tpu/pipeline.py): the 3-stage
+    # ingest / device / commit overlap driver — a background ingest stage
+    # builds + dispatches the next drain while the device executes the
+    # current one and a commit worker drains the _PendingDrain queue off
+    # the critical path, with depth-capped backpressure between stages.
+    # Off = StreamingPipeline refuses to start; callers fall back to the
+    # lock-step schedule_pending() loop (same assignments, no overlap).
+    "StreamingDrainPipeline": FeatureSpec(True, ALPHA),
     # kernel observatory (perf/observatory.py): per-dispatch device-time
     # attribution — run-wall histograms keyed (kernel, plan/shape,
     # backend), the per-drain device lane in the flight recorder and

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from ..backend.cache import _PodState
 from ..framework.types import next_generation
+from ..metrics import SCHEDULED
+from ..obs.journey import EV_ASSIGN
 
 
 class CommitEngine:
@@ -145,7 +147,11 @@ class CommitEngine:
             in_flight_pop(uid, None)
             bound_pods.append((assumed, pod))
             event_refs.append((uid, node_name))
-            sli_by_attempts.setdefault(qpi.attempts or 1, []).append(
+            attempts = qpi.attempts or 1
+            slis = sli_by_attempts.get(attempts)
+            if slis is None:
+                slis = sli_by_attempts[attempts] = []
+            slis.append(
                 now - (qpi.initial_attempt_timestamp or qpi.timestamp))
             if qpi.unschedulable_plugins:
                 qpi.unschedulable_plugins = set()
@@ -154,14 +160,12 @@ class CommitEngine:
             queue.in_flight_events.clear()
         nb = len(bound_pods)
         if nb:
-            from ..obs.journey import EV_ASSIGN
             sched.journey.record_bulk(
                 [uid for uid, _node in event_refs], EV_ASSIGN, now,
                 detail=[node for _uid, node in event_refs])
             sched.dispatcher.add_binds(bound_pods)
             sched.events.scheduled_bulk(event_refs, now=now)
             sched.scheduled_count += nb
-            from ..metrics import SCHEDULED
             sched.metrics.schedule_attempts.inc(SCHEDULED, profile.name,
                                                 by=nb)
             for attempts, values in sli_by_attempts.items():

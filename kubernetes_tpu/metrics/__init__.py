@@ -163,15 +163,26 @@ class Histogram:
     def sum(self, *labels: str) -> float:
         return self._sums.get(tuple(labels), 0.0)
 
-    def quantile(self, q: float) -> float:
-        """histogram_quantile over ALL label sets merged (bench reporting):
-        the value of the bucket upper edge holding the q-th observation,
-        linearly interpolated inside the bucket like PromQL. Returns 0.0
-        with no observations; the top bucket clamps to its lower edge."""
+    def merged_counts(self) -> list[int]:
+        """All label sets' bucket counts merged — a checkpoint for
+        quantile(since=): the streaming bench reports per-tier e2e
+        quantiles as deltas so the warmup phase can't pollute them."""
         merged = [0] * (len(self.buckets) + 1)
         for counts in self._counts.values():
             for i, c in enumerate(counts):
                 merged[i] += c
+        return merged
+
+    def quantile(self, q: float, since: Optional[list[int]] = None) -> float:
+        """histogram_quantile over ALL label sets merged (bench reporting):
+        the value of the bucket upper edge holding the q-th observation,
+        linearly interpolated inside the bucket like PromQL. Returns 0.0
+        with no observations; the top bucket clamps to its lower edge.
+        `since` (a merged_counts() checkpoint) restricts the quantile to
+        observations made after the checkpoint."""
+        merged = self.merged_counts()
+        if since is not None:
+            merged = [m - s for m, s in zip(merged, since)]
         total = sum(merged)
         if total == 0:
             return 0.0
@@ -546,6 +557,25 @@ class SchedulerMetrics:
             "guard) or fenced (stale shard-lease generation — the "
             "ordering primitive). Both unwind through on_bind_error.",
             ("outcome",)))
+        # streaming drain pipeline (kubernetes_tpu/pipeline.py, ISSUE 18):
+        # per-stage busy walls + backpressure stalls, mirrored from the
+        # pipeline's own counters at exposition time (publish_metrics)
+        self.pipeline_stage_busy = r.register(Counter(
+            n + "pipeline_stage_busy_seconds",
+            "Cumulative busy wall seconds per streaming-pipeline stage: "
+            "ingest (arrival admit + batch build + plan compile + "
+            "dispatch enqueue), device (non-overlapping dispatch-to-"
+            "ready execution windows), commit (assume/bind commit + "
+            "bulk bind-echo flush). Sum across stages exceeding the "
+            "pipeline wall == measured stage overlap.",
+            ("stage",)))
+        self.pipeline_backpressure = r.register(Counter(
+            n + "pipeline_backpressure_total",
+            "Streaming-pipeline stalls, labeled by the STALLED stage: "
+            "ingest (batch close deferred: dispatch depth at cap), "
+            "device (dispatch deferred: commit backlog at cap), commit "
+            "(commit worker waited on the host lock).",
+            ("stage",)))
         self.dispatcher_inflight = r.register(Gauge(
             n + "dispatcher_inflight",
             "In-flight work of the async commit pipeline at scrape time: "
@@ -669,6 +699,10 @@ class SchedulerMetrics:
         # scheduler) takes precedence at scrape time
         for kind in ("api_calls", "drains"):
             self.dispatcher_inflight.set(0.0, kind)
+        from ..pipeline import STAGES as PIPELINE_STAGES
+        for stage in PIPELINE_STAGES:
+            self.pipeline_stage_busy.inc(stage, by=0)
+            self.pipeline_backpressure.inc(stage, by=0)
         for kind in ("assignment", "reason", "verdict"):
             self.oracle_divergence.inc(kind, by=0)
         for outcome in ("clean", "divergent", "skipped", "error"):

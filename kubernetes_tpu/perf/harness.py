@@ -288,6 +288,7 @@ class WorkloadRunner:
         self.create_batch = create_batch
         self.trace = trace
         self.last_tracer = None
+        self.last_pipeline_stats: Optional[dict] = None
         self.factory = scheduler_factory or self._default_factory
 
     def _default_factory(self, api: APIServer) -> Scheduler:
@@ -301,8 +302,20 @@ class WorkloadRunner:
         return sched
 
     def run(self, tc: TestCase, wl: Workload, verbose: bool = False) -> list[DataItem]:
+        # serve the measured window with the cyclic collector paused
+        # (utils/runtime.py): drain-chunk allocation churn otherwise
+        # triggers gen-2 collections inside the commit tail — measured
+        # as the dominant commit_s cost. Restored (with a full collect)
+        # on exit, so the surrounding process sees normal GC.
+        from ..utils.runtime import scheduling_gc_pause
+        with scheduling_gc_pause():
+            return self._run_ops(tc, wl, verbose)
+
+    def _run_ops(self, tc: TestCase, wl: Workload,
+                 verbose: bool = False) -> list[DataItem]:
         api = APIServer()
         sched = self.last_scheduler = self.factory(api)
+        self.last_pipeline_stats = None
         if self.trace:
             # capture EVERY cycle's span tree for Chrome-trace export
             # (bench --trace-dir): slow-threshold inf keeps the slow ring
@@ -364,6 +377,17 @@ class WorkloadRunner:
                 if col:
                     col.end(sched.scheduled_count)
                     items.append(col.item(f"{tc.name}/{wl.name}"))
+            elif code in ("streamPods", "streamTrace"):
+                # open-loop streaming load (ISSUE 18): pods ARRIVE on a
+                # Poisson clock at a target QPS (or a replayed gang
+                # trace) and the streaming pipeline — or the lock-step
+                # A/B — absorbs them. Open-loop: a slow scheduler never
+                # thins the offered load, the backlog builds.
+                items.extend(self._run_stream(
+                    code, op, tc, wl, params, api, sched, pod_seq,
+                    verbose))
+                if code == "streamPods":
+                    pod_seq += int(_resolve(op, "count", params))
             elif code == "gangTrace":
                 # trace-driven gang traffic (testing/workloads.py): LLM
                 # training gangs + co-located inference + gangs-preempt-
@@ -476,6 +500,10 @@ class WorkloadRunner:
             "e2e_p50_ms": round(m.sli_duration.quantile(0.50) * 1e3, 3),
             "e2e_p99_ms": round(m.sli_duration.quantile(0.99) * 1e3, 3),
         }
+        if self.last_pipeline_stats is not None:
+            # streaming-pipeline occupancy block (ISSUE 18): stage busy
+            # seconds, overlap factor, backpressure + batch-close counts
+            extras["pipeline"] = self.last_pipeline_stats
         waves = m.wave_placement_waves.value()
         if waves:
             nconf = m.wave_conflict_ratio.count()
@@ -516,6 +544,155 @@ class WorkloadRunner:
             item.op_seconds = list(op_times)
             item.extras = dict(extras)
         return items
+
+    def _run_stream(self, code: str, op: dict, tc: TestCase, wl: Workload,
+                    params: dict, api: APIServer, sched: Scheduler,
+                    pod_seq: int, verbose: bool) -> list[DataItem]:
+        """streamPods / streamTrace opcodes: stamp the arrival schedule,
+        pace it open-loop against the wall clock, and absorb it through
+        the streaming pipeline or the lock-step A/B twin."""
+        from ..testing.workloads import (GangWorkloadGenerator, chunked,
+                                         poisson_arrivals)
+        qps = float(_resolve(op, "qps", params, 10_000))
+        mode = str(_resolve(op, "mode", params, "pipeline"))
+        chunk = int(op.get("chunk", params.get("arrivalChunk", 128)))
+        seed = int(op.get("seed", params.get("seed", 0)))
+        budget_s = float(op.get("latencyBudgetMs",
+                                params.get("latencyBudgetMs", 5.0))) / 1e3
+        workload_objs: list = []
+        if code == "streamPods":
+            count = int(_resolve(op, "count", params))
+            template = op.get("podTemplate", tc.default_pod_template)
+            factory = PodFactory(template, zones=params.get("zones", 16),
+                                 gang_size=int(params.get("gangSize", 1)))
+            make = factory.make
+            chunks = chunked([make(f"pod-{pod_seq + i}", pod_seq + i)
+                              for i in range(count)], chunk)
+        else:   # streamTrace: the gang/inference trace, paced
+            gen = GangWorkloadGenerator(seed=seed)
+            specs = gen.training_gangs(
+                int(_resolve(op, "gangs", params, 0)),
+                size=(int(op.get("gangSizeMin", 8)),
+                      int(op.get("gangSizeMax", 512))),
+                cpu=op.get("gangCpu", "900m"),
+                memory=op.get("gangMemory", "1Gi"),
+                priority=int(op.get("gangPriority", 0)))
+            chunks = []
+            for kind, obj in gen.trace(
+                    specs,
+                    inference_count=int(
+                        _resolve(op, "inferencePods", params, 0)),
+                    chunk=chunk):
+                if kind == "workload":
+                    workload_objs.append(obj)
+                else:
+                    chunks.append(obj)
+        events = list(poisson_arrivals(chunks, qps=qps, seed=seed))
+        collect = op.get("collectMetrics", False)
+        col = ThroughputCollector() if collect else None
+        use_pipeline = (mode == "pipeline" and sched.feature_gates.enabled(
+            "StreamingDrainPipeline"))
+        self.last_pipeline_stats = None
+        # per-tier e2e quantiles as deltas from here: the warmup phase's
+        # compile-wait outliers must not pollute the tier's p50/p99
+        sli_chk = sched.metrics.sli_duration.merged_counts()
+        if col:
+            col.begin(sched.scheduled_count)
+        if use_pipeline:
+            from ..pipeline import StreamingPipeline
+            pipe = StreamingPipeline(
+                sched, latency_budget_s=budget_s,
+                dispatch_depth=int(op.get("dispatchDepth", 3)))
+            pipe.start()
+            try:
+                for w in workload_objs:
+                    pipe.feed_workload(w)
+                t0 = time.perf_counter()
+                for due, pods in events:
+                    lag = t0 + due - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    pipe.feed(pods)
+                    if col:
+                        col.sample(sched.scheduled_count)
+                arrival_done = time.perf_counter()
+                pipe.drain()
+            finally:
+                pipe.stop()
+            self.last_pipeline_stats = pipe.stats()
+        elif mode == "lockstep":
+            # the lock-step phase train at the same offered load: with no
+            # overlap the device is idle at every decision point, so the
+            # adaptive close policy fires on each arrival chunk and runs
+            # build -> device -> commit to the barrier before the next.
+            # This is the A/B twin the streaming gate compares against.
+            for w in workload_objs:
+                api.create_workload(w)
+            t0 = time.perf_counter()
+            for due, pods in events:
+                lag = t0 + due - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                api.create_pods(pods)
+                if sched.dispatch_once():
+                    sched.wait_pending()
+                if col:
+                    col.sample(sched.scheduled_count)
+            arrival_done = time.perf_counter()
+            deadline = time.time() + 120.0
+            while len(sched.queue) and time.time() < deadline:
+                sched.flush_queues()
+                if sched.dispatch_once():
+                    sched.wait_pending()
+                else:
+                    time.sleep(0.01)
+        else:
+            # "async": the pre-pipeline schedule_pending(wait=False) path
+            # (commit tail detached, adaptive batcher accumulating) at
+            # the same offered load
+            for w in workload_objs:
+                api.create_workload(w)
+            t0 = time.perf_counter()
+            for due, pods in events:
+                lag = t0 + due - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                api.create_pods(pods)
+                sched.schedule_pending(wait=False)
+                if col:
+                    col.sample(sched.scheduled_count)
+            arrival_done = time.perf_counter()
+            sched.schedule_pending()
+        m = sched.metrics
+        # coordinated-omission guard: how far the arrival driver finished
+        # behind the ideal Poisson schedule. The SLI e2e clock starts at
+        # enqueue, so a mode that stalls the (single-threaded) driver
+        # delays enqueues and understates its own latency — a nonzero lag
+        # flags exactly that. The pipeline's feed() returns immediately,
+        # so its lag stays ~0 and its e2e is the honest open-loop number.
+        lag_s = (max(0.0, arrival_done - (t0 + events[-1][0]))
+                 if events else 0.0)
+        stream = {
+            "mode": mode,
+            "offered_qps": qps,
+            "arrival_lag_s": round(lag_s, 3),
+            "stream_e2e_p50_ms": round(
+                m.sli_duration.quantile(0.50, since=sli_chk) * 1e3, 3),
+            "stream_e2e_p99_ms": round(
+                m.sli_duration.quantile(0.99, since=sli_chk) * 1e3, 3),
+        }
+        if self.last_pipeline_stats is None:
+            self.last_pipeline_stats = stream
+        else:
+            self.last_pipeline_stats.update(stream)
+        if col:
+            col.end(sched.scheduled_count)
+            if verbose:
+                print(f"  {code}[{mode}] qps={qps:g}: "
+                      f"scheduled={sched.scheduled_count}")
+            return [col.item(f"{tc.name}/{wl.name}")]
+        return []
+
 
 
 def run_config(path: str, case_filter: str = "", workload_filter: str = "",

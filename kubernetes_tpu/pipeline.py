@@ -1,0 +1,389 @@
+"""Streaming drain pipeline: ingest, device dispatch and commit overlapped.
+
+Every drain used to be a lock-step phase train — host_build, then device,
+then commit — with the host idle while the device executed and the device
+idle while the host committed. BENCH_r10 put commit at 65% of the
+SchedulingBasic cycle, and ROADMAP item 2 names the fix: double-buffer the
+three stages so drain N's device execution overlaps drain N+1's columnar
+ingest / plan compile and drain N-1's commit tail. The `_PendingDrain`
+queue (scheduler.py) already detaches the commit tail; this module extends
+it into a bounded 3-stage pipeline under a sustained arrival process:
+
+  arrival feed ──> [ingest worker] ──> [device (async)] ──> [commit worker]
+     feed()         dispatch_once()      _PendingDrain         commit_ready()
+                                                               dispatcher.flush()
+
+* The INGEST worker closes the accumulating batch under an adaptive
+  policy — device idle, batch full, or latency budget expired, whichever
+  first — and runs `BatchBuilder` + `DrainCompiler` signature/plan work
+  (`Scheduler.dispatch_once`) for the next drain while the device
+  executes the current one.
+* The DEVICE stage is JAX's own async dispatch: `dispatch_once` returns
+  as soon as the programs are enqueued; `_PendingDrain.ready()` polls
+  completion without blocking.
+* The COMMIT worker detects landed drains off the hot path, commits them
+  head-first (`Scheduler.commit_ready` — commit order IS dispatch order,
+  preserving the carry/ledger/shadow-oracle bind-for-bind contract), and
+  flushes the dispatcher's bulk bind-echo.
+
+Backpressure is explicit and depth-capped in both directions: commit
+backlog (un-echoed binds) caps dispatch, dispatch depth (in-flight
+drains) caps ingest. Each stall increments
+`scheduler_pipeline_backpressure_total{stage=<stalled stage>}` and each
+stage's wall time accrues to
+`scheduler_pipeline_stage_busy_seconds{stage}` — the occupancy block
+served at /debug/pipeline (sum of busy seconds > wall == measured
+overlap).
+
+Threading contract: ONE lock (`self._lock`) serializes every touch of
+the scheduler's host state (queue, cache, snapshot, dispatch, commit).
+The overlap is host/device, not host/host — the GIL would serialize
+host stages anyway; what the pipeline buys is the device never waiting
+on commit tails and the host never spinning on device readbacks. Pod
+creation MUST go through `feed()`: watch handlers run synchronously on
+the caller thread and mutate the queue/snapshot.
+
+CPython's generational GC is paused for the serving window
+(utils/runtime.py `scheduling_gc_pause`) — the commit edge's ~4 small
+allocations per pod otherwise trip young-gen scans of the scheduler's
+long-lived graph mid-drain, measured at up to 45% of commit wall. The
+commit worker runs `opportunistic_collect()` in device-idle windows
+instead: GC scheduled like any other background work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import ExitStack
+from typing import Optional
+
+from .utils.logging import klog
+from .utils.runtime import opportunistic_collect, scheduling_gc_pause
+
+# pipeline stage names — the exact label set of the
+# scheduler_pipeline_stage_busy_seconds / _backpressure_total families
+# (exposition lint asserts these; tools/check.py pipeline_stages pins the
+# stage threads to the measured_call/observatory entry discipline)
+STAGES = ("ingest", "device", "commit")
+
+
+class PipelineStopped(RuntimeError):
+    """Raised by feed() after stop() or a worker fault."""
+
+
+class StreamingPipeline:
+    """A streaming drain loop over one Scheduler (module docstring)."""
+
+    def __init__(self, sched, *,
+                 dispatch_depth: int = 3,
+                 commit_backlog_pods: int = 16384,
+                 latency_budget_s: float = 0.005,
+                 close_min_pods: int = 1,
+                 poll_s: float = 0.0002,
+                 gc_pause: bool = True):
+        if not sched.feature_gates.enabled("StreamingDrainPipeline"):
+            raise RuntimeError(
+                "StreamingDrainPipeline feature gate is disabled; use the "
+                "lock-step schedule_pending() loop")
+        self.sched = sched
+        # commit backlog depth caps dispatch; dispatch depth caps ingest
+        self.dispatch_depth = max(1, int(dispatch_depth))
+        self.commit_backlog_pods = int(commit_backlog_pods)
+        self.latency_budget_s = float(latency_budget_s)
+        self.close_min_pods = max(1, int(close_min_pods))
+        self.poll_s = float(poll_s)
+        self.gc_pause = gc_pause
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._started = False
+        self.errors: list[tuple[str, BaseException]] = []
+        # per-stage busy walls (each key written by exactly one thread)
+        self._busy = {s: 0.0 for s in STAGES}
+        self._backpressure = {s: 0 for s in STAGES}
+        self._close_reasons = {"full": 0, "idle": 0, "budget": 0,
+                               "feed": 0}
+        self._batches = 0
+        self._commits = 0
+        self._started_at = 0.0
+        self._stopped_at: Optional[float] = None
+        self._oldest_arrival: Optional[float] = None
+        # device-busy accounting: non-overlapping [dispatched, ready)
+        # windows (the device executes drains serially)
+        self._last_ready = 0.0
+        self._threads: list[threading.Thread] = []
+        self._stack = ExitStack()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "StreamingPipeline":
+        if self._started:
+            return self
+        self._started = True
+        self._started_at = time.perf_counter()
+        self._last_ready = self._started_at
+        if self.gc_pause:
+            self._stack.enter_context(scheduling_gc_pause())
+        self.sched.pipeline = self
+        for name, target in (("pipeline-ingest", self._ingest_loop),
+                             ("pipeline-commit", self._commit_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        """Signal the workers, join them, restore the GC. Does NOT drain:
+        call `drain()` first for a clean quiescent shutdown."""
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        for t in self._threads:
+            t.join(timeout=30.0)
+        self._threads.clear()
+        self._stopped_at = time.perf_counter()
+        self.sched.pipeline = self   # keep last stats reachable at /debug
+        self.publish_metrics()
+        self._stack.close()
+
+    def _check(self) -> None:
+        if self.errors:
+            raise self.errors[0][1]
+        if self._stop:
+            raise PipelineStopped("pipeline stopped")
+
+    # -- arrival feed (ingest stage, caller side) ------------------------------
+
+    def feed(self, pods: list, close: bool = False) -> None:
+        """Admit an arrival chunk: create the pods (watch handlers enqueue
+        them under the pipeline lock) and wake the ingest worker. With
+        `close=True` the batch closes and dispatches inline on the caller
+        thread — deterministic batch boundaries for the parity suites
+        (still committed asynchronously by the commit worker)."""
+        self._check()
+        with self._work:
+            t0 = time.perf_counter()
+            self.sched.client.create_pods(pods)
+            if self._oldest_arrival is None:
+                self._oldest_arrival = t0
+            self._busy["ingest"] += time.perf_counter() - t0
+            if close:
+                self._dispatch_locked("feed")
+            else:
+                self._work.notify_all()
+
+    def feed_workload(self, workload) -> None:
+        """Admit a Workload object (gang quorum source) ahead of its
+        member pods — the trace-replay opcode's workload events."""
+        self._check()
+        with self._lock:
+            self.sched.client.create_workload(workload)
+
+    # -- ingest worker: adaptive batch close + dispatch ------------------------
+
+    def _ingest_loop(self) -> None:
+        try:
+            while True:
+                with self._work:
+                    if self._stop:
+                        return
+                    sched = self.sched
+                    sched.queue.flush_backoff_completed()
+                    qlen = len(sched.queue.active_q)
+                    reason = self._close_reason(qlen)
+                    if reason is None:
+                        # nothing to close yet: wake on feed/commit or at
+                        # the latency-budget horizon, whichever first
+                        self._work.wait(timeout=self._wait_horizon(qlen))
+                        continue
+                    self._dispatch_locked(reason)
+        except BaseException as e:   # noqa: BLE001 — surfaced via errors
+            self.errors.append(("ingest", e))
+            klog.error("pipeline ingest worker died", error=repr(e))
+
+    def _close_reason(self, qlen: int) -> Optional[str]:
+        """Adaptive batch-close policy: full batch, idle device, or an
+        expired latency budget — whichever first (None = keep
+        accumulating)."""
+        if qlen < self.close_min_pods:
+            return None
+        sched = self.sched
+        if qlen >= sched.batch_size:
+            return "full"
+        if not sched._pending:
+            return "idle"
+        if (self._oldest_arrival is not None
+                and time.perf_counter() - self._oldest_arrival
+                >= self.latency_budget_s):
+            return "budget"
+        return None
+
+    def _wait_horizon(self, qlen: int) -> float:
+        if qlen and self._oldest_arrival is not None:
+            due = (self._oldest_arrival + self.latency_budget_s
+                   - time.perf_counter())
+            return max(min(due, self.latency_budget_s), self.poll_s)
+        return self.latency_budget_s or 0.05
+
+    def _dispatch_locked(self, reason: str) -> None:
+        """Dispatch one closed batch, honoring both depth caps. Caller
+        holds the lock; waits (releasing it) while a cap blocks."""
+        sched = self.sched
+        while not self._stop:
+            if len(sched._pending) >= self.dispatch_depth:
+                # dispatch depth caps ingest
+                self._backpressure["ingest"] += 1
+                self._work.wait(timeout=self.poll_s * 10)
+                continue
+            if len(sched.dispatcher) >= self.commit_backlog_pods:
+                # commit backlog caps dispatch
+                self._backpressure["device"] += 1
+                self._work.wait(timeout=self.poll_s * 10)
+                continue
+            break
+        if self._stop:
+            return
+        t0 = time.perf_counter()
+        took = sched.dispatch_once()
+        self._busy["ingest"] += time.perf_counter() - t0
+        if took:
+            self._batches += 1
+            self._close_reasons[reason] = (
+                self._close_reasons.get(reason, 0) + 1)
+        self._oldest_arrival = (
+            None if not len(sched.queue.active_q) else time.perf_counter())
+        self._work.notify_all()
+
+    # -- commit worker: off-critical-path commit + bind-echo flush -------------
+
+    def _commit_loop(self) -> None:
+        sched = self.sched
+        idle_streak = 0
+        try:
+            while not self._stop:
+                try:
+                    head = sched._pending[0]
+                except IndexError:
+                    head = None
+                if head is None:
+                    idle_streak += 1
+                    if len(sched.dispatcher):
+                        with self._lock:
+                            t0 = time.perf_counter()
+                            sched.dispatcher.flush()
+                            self._busy["commit"] += (
+                                time.perf_counter() - t0)
+                        self._work_notify()
+                    elif self.gc_pause and idle_streak == 50:
+                        # device-idle window: run the young-gen collection
+                        # the paused automatic collector isn't doing
+                        opportunistic_collect()
+                    time.sleep(self.poll_s)
+                    continue
+                if not head.ready():
+                    # device still executing: the commit stage stalls on
+                    # the device, not the other way around
+                    idle_streak = 0
+                    time.sleep(self.poll_s)
+                    continue
+                idle_streak = 0
+                t_ready = time.perf_counter()
+                # serial-device busy accounting: non-overlapping windows
+                dt = t_ready - max(head.dispatched_at, self._last_ready)
+                if dt > 0:
+                    self._busy["device"] += dt
+                self._last_ready = t_ready
+                if not self._lock.acquire(blocking=False):
+                    # ingest holds the host: commit is the stalled stage
+                    self._backpressure["commit"] += 1
+                    self._lock.acquire()
+                try:
+                    t0 = time.perf_counter()
+                    if sched._pending and sched._pending[0] is head:
+                        # commit every landed drain in one lock hold
+                        # (head-first: commit order IS dispatch order)
+                        self._commits += sched.commit_ready()
+                    sched.dispatcher.flush()
+                    self._busy["commit"] += time.perf_counter() - t0
+                finally:
+                    self._lock.release()
+                self.publish_metrics()
+                self._work_notify()
+        except BaseException as e:   # noqa: BLE001 — surfaced via errors
+            self.errors.append(("commit", e))
+            klog.error("pipeline commit worker died", error=repr(e))
+
+    def _work_notify(self) -> None:
+        with self._work:
+            self._work.notify_all()
+
+    # -- quiescence ------------------------------------------------------------
+
+    def drain(self, timeout: float = 120.0) -> None:
+        """Block until the pipeline is quiescent: active queue empty,
+        no in-flight drains, dispatcher flushed. Raises the first worker
+        fault, if any (the chaos suites catch it here)."""
+        deadline = time.monotonic() + timeout
+        sched = self.sched
+        while True:
+            if self.errors:
+                raise self.errors[0][1]
+            with self._work:
+                sched.queue.flush_backoff_completed()
+                quiescent = (not len(sched.queue.active_q)
+                             and not sched._pending
+                             and not len(sched.dispatcher))
+                self._work.notify_all()
+            if quiescent:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pipeline not quiescent after {timeout}s: "
+                    f"queue={len(sched.queue.active_q)} "
+                    f"pending={len(sched._pending)} "
+                    f"dispatcher={len(sched.dispatcher)}")
+            time.sleep(self.poll_s * 5)
+
+    # -- observability ---------------------------------------------------------
+
+    def publish_metrics(self) -> None:
+        """Mirror the pipeline's per-stage counters into the
+        scheduler_pipeline_* families — absolute assignment (the pipeline
+        owns the monotonic totals, same contract as the ledger sync)."""
+        m = self.sched.metrics
+        for stage in STAGES:
+            m.pipeline_stage_busy._values[(stage,)] = self._busy[stage]
+            m.pipeline_backpressure._values[(stage,)] = float(
+                self._backpressure[stage])
+
+    def stats(self) -> dict:
+        """The /debug/pipeline occupancy block."""
+        self.publish_metrics()
+        wall = ((self._stopped_at or time.perf_counter())
+                - self._started_at) if self._started_at else 0.0
+        busy_sum = sum(self._busy.values())
+        return {
+            "running": self._started and not self._stop,
+            "wallSeconds": round(wall, 6),
+            "busySeconds": {s: round(v, 6) for s, v in self._busy.items()},
+            "busySum": round(busy_sum, 6),
+            # >1.0 == measured stage overlap (the acceptance gate reads
+            # this: sum of per-stage busy seconds vs wall)
+            "occupancy": round(busy_sum / wall, 4) if wall > 0 else 0.0,
+            "backpressure": dict(self._backpressure),
+            "batchClose": dict(self._close_reasons),
+            "batches": self._batches,
+            "commits": self._commits,
+            "depths": {
+                "queue": len(self.sched.queue.active_q),
+                "dispatch": len(self.sched._pending),
+                "commitBacklog": len(self.sched.dispatcher),
+            },
+            "caps": {
+                "dispatchDepth": self.dispatch_depth,
+                "commitBacklogPods": self.commit_backlog_pods,
+                "latencyBudgetMs": self.latency_budget_s * 1e3,
+            },
+            "errors": [f"{stage}: {exc!r}" for stage, exc in self.errors],
+        }

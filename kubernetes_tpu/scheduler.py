@@ -475,6 +475,9 @@ class Scheduler:
         self._probe_enabled = self.feature_gates.enabled(
             "ClusterStateProbe")
         self._last_probe = None      # latest resolved snapshot (dict)
+        # streaming drain pipeline (kubernetes_tpu/pipeline.py): attached
+        # by StreamingPipeline.start(); backs /debug/pipeline
+        self.pipeline = None
         # external-mutation counter: bumped with every device-state
         # invalidation; the shadow audit compares it across a drain's
         # dispatch→commit window (reason diffs are only valid when the
@@ -1266,8 +1269,7 @@ class Scheduler:
         batches = 0
         while True:
             # commit whatever has already landed
-            while self._pending and self._pending[0].ready():
-                self._commit_next()
+            self.commit_ready()
             self.queue.flush_backoff_completed()
             if not len(self.queue.active_q):
                 if not wait or not self._pending:
@@ -1319,6 +1321,48 @@ class Scheduler:
             # share behind scheduler_shard_* and /debug/kernels
             self.profile_shard_lanes()
         return self.scheduled_count - start
+
+    def commit_ready(self, limit: int = 0) -> int:
+        """Commit in-flight drains whose device results have landed, head
+        first (commit order IS dispatch order — the carry/ledger contract).
+        Stops at the first drain still executing; `limit` caps the number
+        of commits (0 = all ready). Returns drains committed. This is the
+        commit stage's entry for the streaming pipeline's worker; the
+        lock-step loop uses it for its opportunistic head-drain."""
+        done = 0
+        while self._pending and self._pending[0].ready():
+            self._commit_next()
+            done += 1
+            if limit and done >= limit:
+                break
+        return done
+
+    def dispatch_once(self, max_pods: int = 0) -> int:
+        """Close the current batch and dispatch it as one drain WITHOUT
+        committing anything: the ingest stage's entry for the streaming
+        pipeline (kubernetes_tpu/pipeline.py), which runs BatchBuilder +
+        DrainCompiler work for the next drain while the device executes
+        the current one and leaves every commit to the pipeline's commit
+        worker. Returns the number of pods taken from the queue (0 =
+        nothing eligible). Depth capping is the CALLER's job — the
+        pipeline enforces its backpressure before calling; direct users
+        get the `max_inflight_drains` safety net."""
+        if self.ha_role == "standby":
+            return 0
+        self.queue.flush_backoff_completed()
+        if not len(self.queue.active_q):
+            return 0
+        qpis = self.queue.drain(max_pods or self.batch_size)
+        if not qpis:
+            return 0
+        with self.tracer.span("scheduling_cycle", pods=len(qpis)) as cycle:
+            before = self.scheduled_count
+            with self.tracer.span("schedule_batch"):
+                self._schedule_batch(qpis)
+            while len(self._pending) > self.max_inflight_drains:
+                self._commit_next()
+            cycle.set(bound=self.scheduled_count - before)
+        return len(qpis)
 
     def profile_shard_lanes(self, force: bool = False):
         """Run the sharded-lane profile on the latest sharded dispatch's

@@ -32,7 +32,9 @@ in-process model:
   with timestamps + the per-segment e2e decomposition),
   /debug/cluster (the latest resolved cluster_probe snapshot:
   utilization percentiles, fragmentation/stranded indices, domain
-  imbalance), /debug/timeline?seconds=N (the per-second aggregate
+  imbalance), /debug/pipeline (the streaming drain pipeline's occupancy
+  block: per-stage busy seconds, overlap ratio, backpressure stalls,
+  stage depths vs caps), /debug/timeline?seconds=N (the per-second aggregate
   telemetry ring over all SLIs + probe outputs) and
   /debug/kernels?plans=N&lanes=refresh (the kernel observatory:
   per-kernel run-wall histograms keyed by plan/shape signature, compile
@@ -233,6 +235,15 @@ class SchedulerServer:
                     code = (200 if out["transitions"]
                             or out["firstEnqueue"] is not None else 404)
                     self._send(code, json.dumps(out, indent=2),
+                               "application/json")
+                elif self.path.startswith("/debug/pipeline"):
+                    pipe = getattr(outer.scheduler, "pipeline", None)
+                    if pipe is None:
+                        self._send(404, "streaming pipeline not attached "
+                                        "(StreamingDrainPipeline gate / "
+                                        "no StreamingPipeline started)")
+                        return
+                    self._send(200, json.dumps(pipe.stats(), indent=2),
                                "application/json")
                 elif self.path.startswith("/debug/cluster"):
                     sched = outer.scheduler

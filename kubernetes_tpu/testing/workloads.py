@@ -161,3 +161,51 @@ class GangWorkloadGenerator:
             flat.extend(self.gang_pods(spec))
         for i in range(0, len(flat), chunk):
             yield ("pods", flat[i:i + chunk])
+
+
+# -- open-loop arrival processes (ISSUE 18) ------------------------------------
+#
+# The streaming pipeline (kubernetes_tpu/pipeline.py) is exercised as a
+# production scheduler sees load: pods ARRIVE on a clock, they are not
+# pre-staged in batches with quiet boundaries. The processes below stamp
+# deterministic (seeded) arrival schedules as (due_s, payload) events —
+# due_s is the offset from stream start at which the payload is fully
+# arrived. Pacing to the wall clock is the DRIVER's job (perf/harness.py
+# streamPods/streamTrace; open-loop: a late driver never thins the load,
+# the backlog just builds).
+
+
+def poisson_arrivals(chunks: Iterator[list] | list[list], qps: float,
+                     seed: int = 0) -> Iterator[tuple[float, list]]:
+    """Poisson arrival process at target rate `qps` (pods/s) over
+    pre-chunked payloads: per-POD inter-arrival gaps are exponential with
+    mean 1/qps, so a chunk of k pods is due after a Gamma(k, 1/qps) draw —
+    the exact distribution of the sum of k exponential gaps, without
+    stamping k events. Deterministic for a given (seed, chunk shape)."""
+    if qps <= 0:
+        raise ValueError(f"qps must be positive, got {qps}")
+    rng = np.random.RandomState(seed)
+    due = 0.0
+    for chunk in chunks:
+        if not chunk:
+            continue
+        due += float(rng.gamma(len(chunk), 1.0 / qps))
+        yield (due, chunk)
+
+
+def replay_arrivals(events: list[tuple[float, list]],
+                    speed: float = 1.0) -> Iterator[tuple[float, list]]:
+    """Trace replay: re-emit recorded (due_s, payload) events with their
+    original spacing, optionally time-scaled (`speed=2.0` replays a
+    recorded trace at twice its recorded rate)."""
+    if speed <= 0:
+        raise ValueError(f"speed must be positive, got {speed}")
+    for due, payload in events:
+        yield (due / speed, payload)
+
+
+def chunked(items: list, chunk: int) -> list[list]:
+    """Split a flat pod list into arrival chunks (the unit one feed()
+    admits)."""
+    step = max(1, int(chunk))
+    return [items[i:i + step] for i in range(0, len(items), step)]

@@ -293,6 +293,76 @@ class TestKernelGate:
         assert "SENTINEL: FAIL" in bad.stdout
 
 
+class TestStreamingGate:
+    """Streaming tiers (ISSUE 18): the Streaming* prefixes run the wide
+    noise gate; the overlap floor fails only when a pipeline-mode
+    workload loses occupancy the baseline held; the delta-e2e numbers
+    ride the ordinary e2e gate at the same offered load."""
+
+    def test_streaming_prefix_gets_wide_noise_gate(self):
+        assert bench_compare.throughput_gate(
+            "StreamingBasic_5000Nodes_20kQPS_pipeline") == 0.30
+        assert bench_compare.throughput_gate(
+            "StreamingSharded_5000Nodes") == 0.30
+        base = {"StreamingBasic_x_pipeline": {"pods_per_s": 100.0}}
+        new = {"StreamingBasic_x_pipeline": {"pods_per_s": 75.0}}  # -25%
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_occupancy_floor_lost_fails(self):
+        base = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 1.45}}}
+        new = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 1.05}}}
+        failures, _ = bench_compare.compare(base, new)
+        assert any("PIPELINE OVERLAP REGRESSION" in f for f in failures)
+
+    def test_occupancy_above_floor_passes_and_reports(self):
+        base = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 1.45}}}
+        new = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 1.31}}}
+        failures, report = bench_compare.compare(base, new)
+        assert not failures
+        assert any("stage occupancy" in ln for ln in report)
+
+    def test_occupancy_skipped_when_baseline_below_floor(self):
+        """A baseline recorded on a loaded machine (occupancy < 1.2)
+        cannot make every future run unreproducible."""
+        base = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 1.1}}}
+        new = {"StreamingBasic_x_pipeline": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "pipeline", "occupancy": 0.9}}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_lockstep_mode_never_gated_on_occupancy(self):
+        base = {"StreamingBasic_x_lockstep": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "lockstep", "occupancy": 1.5}}}
+        new = {"StreamingBasic_x_lockstep": {
+            "pods_per_s": 100.0,
+            "pipeline": {"mode": "lockstep", "occupancy": 0.5}}}
+        failures, _ = bench_compare.compare(base, new)
+        assert not failures
+
+    def test_streaming_e2e_rides_the_same_offered_load_gate(self):
+        """Same workload name = same qps tier: the delta-e2e p99 gates
+        like any other e2e_p99_ms field."""
+        base = {"StreamingBasic_x_pipeline": {"pods_per_s": 100.0,
+                                              "e2e_p99_ms": 40.0}}
+        new = {"StreamingBasic_x_pipeline": {"pods_per_s": 100.0,
+                                             "e2e_p99_ms": 52.0}}
+        failures, _ = bench_compare.compare(base, new)
+        assert any("E2E LATENCY REGRESSION" in f for f in failures)
+
+
 class TestSLOGate:
     """--slo (ISSUE 10): burn-rate breaches and shadow-oracle divergence
     recorded in a bench summary fail the sentinel."""

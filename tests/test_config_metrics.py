@@ -464,6 +464,25 @@ class TestExpositionLint:
         # the rebalance histogram's zero-seed rides the generic lint
         assert "scheduler_shard_rebalance_seconds_count" in series
 
+    def test_issue18_families_covered_by_lint(self):
+        """ISSUE 18 satellite: the streaming-pipeline families are
+        registered AND pre-seeded with the EXACT stage label set the
+        /debug/pipeline occupancy block and bench_metrics.prom key on —
+        ingest | device | commit, nothing else, before the pipeline
+        ever starts."""
+        from kubernetes_tpu.pipeline import STAGES
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_pipeline_stage_busy_seconds"] == "counter"
+        assert types["scheduler_pipeline_backpressure_total"] == "counter"
+        for fam in ("scheduler_pipeline_stage_busy_seconds",
+                    "scheduler_pipeline_backpressure_total"):
+            stages = {lbl["stage"] for lbl, _v in series[fam]}
+            assert stages == set(STAGES), fam
+            # zero-seeded: every series present before the first drain
+            assert all(v == 0.0 for _l, v in series[fam]), fam
+        assert set(STAGES) == {"ingest", "device", "commit"}
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

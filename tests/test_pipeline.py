@@ -1,0 +1,574 @@
+"""Streaming drain pipeline (ISSUE 18): parity, chaos, backpressure, SLI.
+
+The gates this file establishes:
+
+- deterministic parity: the SAME seeded trace through the lock-step
+  `schedule_pending()` loop and through the streaming pipeline (one
+  `feed(close=True)` per chunk pins identical batch boundaries) lands a
+  byte-identical final assignment map, with zero shadow-oracle
+  divergence at 100% sampling and a verifying drain ledger on both
+  sides;
+- free-running parity: the pipeline running its own adaptive batch
+  closes (boundaries the test does NOT control) still byte-matches a
+  replay twin driven by the recorded commit order — the
+  boundary-independent invariant from tests/test_shards.py;
+- kill-mid-pipeline chaos: a worker dies at each stage boundary
+  (host_build / device / commit / mid-flush); the fault surfaces
+  through `drain()`, a fresh scheduler over the same store recovers
+  every pod, `binding_count` stays exact (zero double-binds), and the
+  replay twin still matches;
+- explicit backpressure: dispatch depth caps ingest and commit backlog
+  caps dispatch, each stall counted on the STALLED stage's label;
+- observability: /debug/pipeline serves the occupancy block, the
+  scheduler_pipeline_* families mirror the pipeline's counters, and the
+  feature gate off means no pipeline at all;
+- the requeue-safe SLI clock attributes commit_backlog waits per pod
+  even when commits complete out of phase with dispatches (ISSUE 18
+  satellite).
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.config import KubeSchedulerConfiguration
+from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.obs.journey import JourneyLedger
+from kubernetes_tpu.pipeline import STAGES, PipelineStopped, StreamingPipeline
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.server import SchedulerServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+SEED = 1813
+
+
+class Killed(Exception):
+    """Simulated process death inside a pipeline worker."""
+
+
+def _nodes(api, n=8, cpu=64, mem="128Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _specs(n, seed, prefix="p"):
+    rng = random.Random(seed)
+    return [(f"{prefix}{i}", "default", 250 * rng.randint(1, 6),
+             512 * rng.randint(1, 4)) for i in range(n)]
+
+
+def _pods(specs, raw=None):
+    out = []
+    for name, ns, cpu, mem in specs:
+        pod = make_pod(name, namespace=ns).req(
+            {"cpu": f"{cpu}m", "memory": f"{mem}Mi"}).obj()
+        if raw is not None:
+            raw[pod.uid] = (name, ns, cpu, mem)
+        out.append(pod)
+    return out
+
+
+def _assignments(api):
+    return {uid: p.spec.node_name for uid, p in api.pods.items()}
+
+
+def _audited(sched):
+    assert sched.audit is not None, "ShadowOracleAudit gate must be on"
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
+    return sched
+
+
+def _no_sleep(sched):
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _sched(client, batch_size=64):
+    return _audited(_no_sleep(Scheduler(client, batch_size=batch_size)))
+
+
+def _divergence(sched):
+    m = sched.metrics
+    return sum(int(m.oracle_divergence.value(kind))
+               for kind in ("assignment", "reason", "verdict"))
+
+
+def _bound(api):
+    return sum(1 for p in api.pods.values() if p.spec.node_name)
+
+
+class BindRecorder:
+    """Record every committed (uid, node) chunk in commit order — the
+    replay twin's script (tests/test_shards.py pattern). Installed on
+    the INNER store so killer facades route through it."""
+
+    def __init__(self, api):
+        self.chunks = []
+        self._real_all, self._real_one = api.bind_all, api.bind
+        api.bind_all = self._bind_all
+        api.bind = self._bind
+
+    def _bind_all(self, pairs, fence_token=None):
+        failures = self._real_all(pairs, fence_token=fence_token)
+        failed = {p.uid for p, _e in failures}
+        chunk = [(a.uid, a.spec.node_name) for a, _o in pairs
+                 if a.uid not in failed]
+        if chunk:
+            self.chunks.append(chunk)
+        return failures
+
+    def _bind(self, pod, node_name, fence_token=None):
+        out = self._real_one(pod, node_name, fence_token=fence_token)
+        self.chunks.append([(pod.uid, node_name)])
+        return out
+
+
+def _replay_twin(raw, chunks, n_nodes=8, cpu=64, mem="128Gi"):
+    """Feed the recorded commit order, chunk by chunk, to ONE fresh
+    lock-step scheduler on a fresh store: if the pipeline changed
+    nothing but WHEN work happened, the twin's final assignment map is
+    byte-identical."""
+    api = APIServer()
+    _nodes(api, n=n_nodes, cpu=cpu, mem=mem)
+    sched = _sched(api)
+    want = 0
+    for chunk in chunks:
+        for uid, _node in chunk:
+            name, ns, pcpu, pmem = raw[uid]
+            api.create_pod(make_pod(name, namespace=ns).req(
+                {"cpu": f"{pcpu}m", "memory": f"{pmem}Mi"}).obj())
+        want += len(chunk)
+        for _ in range(60):
+            sched.schedule_pending()
+            if _bound(api) >= want:
+                break
+            sched.flush_queues()
+    assert sched.reconcile() == []
+    return _assignments(api)
+
+
+class MidFlushKiller:
+    """Victim-only client facade: when armed, the next bulk bind commits
+    its first half and then the 'process' dies (tests/test_shards.py)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.armed = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def bind_all(self, pairs, fence_token=None):
+        if self.armed and len(pairs) > 1:
+            self.armed = False
+            self.inner.bind_all(pairs[:len(pairs) // 2],
+                                fence_token=fence_token)
+            raise Killed("died mid-flush")
+        return self.inner.bind_all(pairs, fence_token=fence_token)
+
+
+def _arm_kill(sched, phase, client=None):
+    """Wire the simulated death into the chosen pipeline stage."""
+    if phase == "host_build":
+        orig = sched.builder.build
+
+        def die(*a, **k):
+            sched.builder.build = orig
+            raise Killed("died in host build")
+        sched.builder.build = die
+    elif phase == "device":
+        def die(*a, **k):
+            raise Killed("died before commit")
+        sched._commit_next = die
+    elif phase == "commit":
+        orig_flush = sched.dispatcher.flush
+
+        def die_flush(*a, **k):
+            if len(sched.dispatcher):
+                raise Killed("died before the API flush")
+            return orig_flush(*a, **k)
+        sched.dispatcher.flush = die_flush
+    elif phase == "mid_flush":
+        client.armed = True
+    else:                            # pragma: no cover
+        raise AssertionError(phase)
+
+
+# -- feature gate --------------------------------------------------------------
+
+
+def test_gate_off_means_no_pipeline():
+    api = APIServer()
+    sched = Scheduler(api, config=KubeSchedulerConfiguration(
+        feature_gates={"StreamingDrainPipeline": False}))
+    with pytest.raises(RuntimeError, match="StreamingDrainPipeline"):
+        StreamingPipeline(sched)
+
+
+def test_feed_after_stop_raises():
+    api = APIServer()
+    _nodes(api, 2)
+    sched = _sched(api)
+    sched.prime()
+    pipe = StreamingPipeline(sched).start()
+    pipe.stop()
+    with pytest.raises(PipelineStopped):
+        pipe.feed(_pods(_specs(1, SEED)))
+
+
+# -- parity gates --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [SEED, SEED + 1])
+def test_streaming_matches_lockstep_bind_for_bind(seed):
+    """Same seeded trace, same chunk boundaries, through both paths:
+    byte-identical assignment maps, zero shadow-oracle divergence at
+    100% sampling, verifying ledgers on both sides."""
+    specs = _specs(192, seed)
+    chunks = [specs[i:i + 32] for i in range(0, len(specs), 32)]
+
+    # lock-step twin: one schedule_pending() per chunk
+    api_l = APIServer()
+    _nodes(api_l)
+    lock = _sched(api_l)
+    lock.prime()
+    for chunk in chunks:
+        for pod in _pods(chunk):
+            api_l.create_pod(pod)
+        lock.schedule_pending()
+    assert _bound(api_l) == len(specs)
+
+    # streaming path: one feed(close=True) per chunk pins the SAME
+    # batch boundaries; commits ride the async commit worker
+    api_s = APIServer()
+    _nodes(api_s)
+    stream = _sched(api_s)
+    stream.prime()
+    pipe = StreamingPipeline(stream)
+    pipe.start()
+    try:
+        for chunk in chunks:
+            pipe.feed(_pods(chunk), close=True)
+        pipe.drain(timeout=60.0)
+    finally:
+        pipe.stop()
+    assert not pipe.errors
+    assert _bound(api_s) == len(specs)
+
+    assert _assignments(api_s) == _assignments(api_l)
+    assert _divergence(stream) == 0 and _divergence(lock) == 0
+    assert stream.audit.ledger.verify() and lock.audit.ledger.verify()
+    assert api_s.binding_count == len(specs)
+
+
+def test_free_running_pipeline_replay_twin_parity():
+    """The pipeline choosing its OWN adaptive batch boundaries still
+    byte-matches a lock-step replay twin of the recorded commit order,
+    with the ledger verifying and zero divergence — plus the satellite
+    SLI gate: every bound pod gets exactly one commit_backlog segment
+    sample even though commits land out of phase with dispatches."""
+    rng = random.Random(SEED)
+    specs = _specs(224, SEED + 2)
+    raw = {}
+    api = APIServer()
+    _nodes(api)
+    rec = BindRecorder(api)
+    sched = _sched(api)
+    sched.prime()
+    pipe = StreamingPipeline(sched, latency_budget_s=0.002)
+    pipe.start()
+    try:
+        for i in range(0, len(specs), 16):
+            pipe.feed(_pods(specs[i:i + 16], raw=raw))
+            time.sleep(rng.uniform(0.0, 0.003))
+        pipe.drain(timeout=60.0)
+    finally:
+        pipe.stop()
+    assert not pipe.errors
+    assert _bound(api) == len(specs)
+    assert api.binding_count == len(specs)
+    assert _divergence(sched) == 0
+    assert sched.audit.ledger.verify()
+    assert _replay_twin(raw, rec.chunks) == _assignments(api)
+    # requeue-safe SLI clock, out-of-phase commits: one commit_backlog
+    # sample per bound pod, none lost, none double-counted
+    assert sched.metrics.e2e_segment.count("commit_backlog") == len(specs)
+
+
+# -- kill-mid-pipeline chaos ---------------------------------------------------
+
+
+@pytest.mark.parametrize("phase",
+                         ["host_build", "device", "commit", "mid_flush"])
+def test_kill_mid_pipeline_no_double_binds(phase):
+    """A worker dies at each stage boundary: the fault surfaces through
+    drain(), a fresh scheduler over the same store recovers every pod,
+    binding_count stays exact and the replay twin still matches."""
+    specs = _specs(160, SEED + 3)
+    raw = {}
+    api = APIServer()
+    _nodes(api)
+    rec = BindRecorder(api)
+    victim_client = MidFlushKiller(api) if phase == "mid_flush" else api
+    sched = _sched(victim_client)
+    sched.prime()
+    pipe = StreamingPipeline(sched, latency_budget_s=0.001)
+    pipe.start()
+    chunks = [specs[i:i + 32] for i in range(0, len(specs), 32)]
+    killed = False
+    try:
+        pipe.feed(_pods(chunks[0], raw=raw))   # healthy prologue
+        time.sleep(0.02)
+        _arm_kill(sched, phase, client=victim_client)
+        for chunk in chunks[1:]:
+            pipe.feed(_pods(chunk, raw=raw))
+            time.sleep(0.002)
+        pipe.drain(timeout=30.0)
+    except Killed:
+        killed = True
+    finally:
+        pipe.stop()
+    assert killed, f"{phase} kill never fired"
+    assert any(isinstance(e, Killed) for _stage, e in pipe.errors)
+
+    # the fault fails feeds fast, so only a prefix of the trace reached
+    # the store — recovery owes exactly those pods, nothing less
+    total = len(api.pods)
+    assert total >= len(chunks[0]), "prologue never landed"
+
+    # 'process restart': a fresh scheduler over the same store LISTs the
+    # survivors and finishes the job
+    sched2 = _sched(api)
+    sched2.prime()
+    for _ in range(60):
+        sched2.schedule_pending()
+        if _bound(api) >= total:
+            break
+        sched2.flush_queues()
+    assert _bound(api) == total
+    assert api.binding_count == total            # zero double-binds
+    assert _divergence(sched2) == 0
+    assert sched2.audit.ledger.verify()
+    assert _replay_twin(raw, rec.chunks) == _assignments(api)
+
+
+# -- backpressure --------------------------------------------------------------
+
+
+def _await(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+def test_dispatch_depth_caps_ingest():
+    """With commits stalled and dispatch_depth=1, a second close must
+    stall INGEST (the stalled stage carries the label) until the commit
+    worker catches up."""
+    api = APIServer()
+    _nodes(api)
+    sched = _sched(api)
+    sched.prime()
+    real_commit = sched.commit_ready
+    sched.commit_ready = lambda limit=0: 0      # commits stall
+    pipe = StreamingPipeline(sched, dispatch_depth=1)
+    pipe.start()
+    try:
+        pipe.feed(_pods(_specs(16, SEED + 4)), close=True)
+        blocked = threading.Thread(
+            target=pipe.feed,
+            args=(_pods(_specs(16, SEED + 5, prefix="q")),),
+            kwargs={"close": True})
+        blocked.start()
+        assert _await(lambda: pipe._backpressure["ingest"] > 0), \
+            "ingest never saw backpressure"
+        sched.commit_ready = real_commit        # commits resume
+        blocked.join(timeout=20.0)
+        assert not blocked.is_alive()
+        pipe.drain(timeout=30.0)
+    finally:
+        sched.commit_ready = real_commit
+        pipe.stop()
+    assert not pipe.errors
+    assert pipe.stats()["backpressure"]["ingest"] >= 1
+    assert _bound(api) == 32
+
+
+def test_commit_backlog_caps_dispatch():
+    """With the bind-echo flush stalled and a 1-pod commit backlog cap,
+    the next dispatch must stall on the DEVICE label (commit backlog
+    caps dispatch) until the flush drains."""
+    api = APIServer()
+    _nodes(api)
+    sched = _sched(api)
+    sched.prime()
+    real_flush = sched.dispatcher.flush
+    sched.dispatcher.flush = lambda *a, **k: 0  # echo stalls, backlog grows
+    pipe = StreamingPipeline(sched, commit_backlog_pods=1)
+    pipe.start()
+    try:
+        pipe.feed(_pods(_specs(16, SEED + 6)), close=True)
+        assert _await(lambda: len(sched.dispatcher) > 0), \
+            "commit backlog never formed"
+        blocked = threading.Thread(
+            target=pipe.feed,
+            args=(_pods(_specs(16, SEED + 7, prefix="q")),),
+            kwargs={"close": True})
+        blocked.start()
+        assert _await(lambda: pipe._backpressure["device"] > 0), \
+            "dispatch never saw commit-backlog backpressure"
+        sched.dispatcher.flush = real_flush     # the echo drains
+        blocked.join(timeout=20.0)
+        assert not blocked.is_alive()
+        pipe.drain(timeout=30.0)
+    finally:
+        sched.dispatcher.flush = real_flush
+        pipe.stop()
+    assert not pipe.errors
+    assert pipe.stats()["backpressure"]["device"] >= 1
+    assert _bound(api) == 32
+
+
+# -- observability -------------------------------------------------------------
+
+
+def test_stats_metrics_and_debug_endpoint():
+    """stats() reports occupancy and depths; the scheduler_pipeline_*
+    families mirror the pipeline's counters; /debug/pipeline serves the
+    occupancy block (404 with no pipeline attached)."""
+    api = APIServer()
+    _nodes(api)
+    sched = _sched(api)
+    sched.prime()
+
+    # no pipeline attached yet: 404
+    srv = SchedulerServer(sched).start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/pipeline", timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        pipe = StreamingPipeline(sched)
+        pipe.start()
+        try:
+            for i in range(0, 96, 16):
+                pipe.feed(_pods(_specs(16, SEED + 8 + i,
+                                       prefix=f"w{i}-")))
+                time.sleep(0.002)
+            pipe.drain(timeout=60.0)
+        finally:
+            pipe.stop()
+        st = pipe.stats()
+        assert st["running"] is False
+        assert st["batches"] >= 1 and st["commits"] >= 1
+        assert st["busySeconds"]["ingest"] > 0
+        assert st["busySeconds"]["commit"] > 0
+        assert st["depths"] == {"queue": 0, "dispatch": 0,
+                                "commitBacklog": 0}
+        assert set(st["batchClose"]) >= {"full", "idle", "budget", "feed"}
+        # the metric families mirror the pipeline's own counters exactly
+        m = sched.metrics
+        for stage in STAGES:
+            # stats() rounds for display; the raw counter is the truth
+            assert m.pipeline_stage_busy.value(stage) == pytest.approx(
+                st["busySeconds"][stage], abs=1e-6)
+            assert m.pipeline_backpressure.value(stage) == float(
+                st["backpressure"][stage])
+
+        # the pipeline stays reachable at /debug after stop()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/pipeline",
+                timeout=5) as r:
+            assert r.status == 200
+            out = json.loads(r.read().decode())
+        assert out["batches"] == st["batches"]
+        assert set(out["busySeconds"]) == set(STAGES)
+        assert set(out["backpressure"]) == set(STAGES)
+    finally:
+        srv.stop()
+
+
+# -- the requeue-safe SLI clock under out-of-phase commits ---------------------
+
+
+def test_sli_commit_backlog_attribution_out_of_phase():
+    """ISSUE 18 satellite: commit_backlog waits are attributed per pod
+    from each pod's OWN dispatcher-enqueue clock, even when bind echoes
+    land out of phase with dispatch order (drain N+1 confirming before
+    drain N) and across a bind-error re-enqueue."""
+    led = JourneyLedger(enabled=True)
+    led.bind_enqueued(["default/a", "default/b"], now=100.0)   # drain N
+    led.bind_enqueued(["default/c"], now=101.0)                # drain N+1
+    # out of phase: drain N+1's echo lands FIRST — its wait must use
+    # its own enqueue clock, not drain N's
+    assert led.bind_confirmed(["default/c"], now=101.5) == [0.5]
+    assert led.bind_confirmed(["default/a", "default/b"],
+                              now=104.0) == [4.0, 4.0]
+    # a bind-error re-enqueue restarts the commit_backlog clock (the
+    # e2e clock elsewhere keeps first_seen; this segment is per attempt)
+    led.bind_enqueued(["default/a"], now=110.0)
+    assert led.bind_confirmed(["default/a"], now=110.25) == [0.25]
+    # an echo with no recorded enqueue contributes no wait sample
+    assert led.bind_confirmed(["default/ghost"], now=120.0) == []
+    # clocks are dropped at confirm: a second echo is idempotent
+    assert led.bind_confirmed(["default/c"], now=130.0) == []
+
+
+# -- tools/check.py pipeline_stages gate ---------------------------------------
+
+
+def _load_check():
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_tools_check_pipeline", os.path.join(repo, "tools", "check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pipeline_stages_check_repo_is_clean():
+    """The shipped pipeline.py reaches the device only through the
+    Scheduler seams — the check must pass on the real tree."""
+    assert _load_check().pipeline_stage_gaps() == []
+
+
+def test_pipeline_stages_check_catches_bypasses():
+    """Every bypass class is caught: kernel-module imports (absolute and
+    relative), direct JIT entry calls, and raw measured_call()."""
+    chk = _load_check()
+    gaps = chk.pipeline_stage_gaps(source=(
+        "import jax\n"
+        "from kubernetes_tpu.ops.program import run_batch\n"
+        "from .parallel import sharding\n"
+        "def stage(cfg, na, carry, pods):\n"
+        "    out = run_batch(cfg, na, carry, pods)\n"
+        "    return LEDGER.measured_call('run_batch', fn, cfg)\n"))
+    kinds = "\n".join(gaps)
+    assert len(gaps) == 5
+    assert "import jax" in kinds
+    assert "kubernetes_tpu.ops.program" in kinds
+    assert ".parallel" in kinds
+    assert "run_batch()" in kinds
+    assert "measured_call()" in kinds
+    # and the sanctioned seams are NOT flagged
+    assert chk.pipeline_stage_gaps(source=(
+        "def loop(sched):\n"
+        "    sched.dispatch_once()\n"
+        "    sched.commit_ready()\n"
+        "    sched.flush_queues()\n")) == []

@@ -33,6 +33,13 @@ and fails (exit 2) on:
     comms share or lane-time imbalance regressing means the mesh port is
     sliding back toward collective-bound dispatch. Skipped when either
     side lacks the profile;
+  * streaming-overlap loss (ISSUE 18, recorded for the Streaming* tiers
+    since r11): a pipeline-mode workload whose stage occupancy
+    (busy-seconds sum / wall) falls below 1.2 when the baseline held the
+    floor — the drain quietly degraded back to lock-step. The Streaming*
+    e2e-p99 numbers are DELTA quantiles for the paced window only, and
+    ride the ordinary MAX_E2E_P99_GROWTH gate at the same offered load
+    (the qps tier is part of the workload name);
   * with --slo: any burn-rate breach recorded in the candidate's per-
     workload `slo` block (obs/slo.py, evaluated at bench end), or ANY
     nonzero shadow-oracle divergence — a bench run whose decisions
@@ -123,7 +130,23 @@ NOISE = {
     # in-process store with a mid-run steal — wall time jitters with
     # machine load like the other multi-process probes
     "MultiShardBasic": 0.30,
+    # open-loop streaming tiers (r11 streaming pipeline, ISSUE 18): the
+    # Poisson arrival process and adaptive batch-close policy make the
+    # sustained rate jitter with machine load; the e2e-p99 gate
+    # (MAX_E2E_P99_GROWTH) carries the latency contract at the same
+    # offered load — workload names encode the qps tier, so a shared
+    # name IS the same offered load
+    "StreamingBasic": 0.30,
+    "StreamingSharded": 0.30,
 }
+
+# streaming-overlap floor (ISSUE 18): pipeline-mode streaming workloads
+# record stage-occupancy (busy-seconds sum / wall) in their `pipeline`
+# block. Occupancy falling below this floor means the stages stopped
+# overlapping — the drain degraded back to lock-step even if throughput
+# noise hides it. Gated only when the BASELINE held the floor too, so a
+# loaded machine can't make an old green run unreproducible.
+MIN_STREAM_OCCUPANCY = 1.2
 
 SKIP_PREFIXES = ("Sharded_",)
 
@@ -288,6 +311,18 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
             if growth > MAX_LANE_GROWTH:
                 failures.append(f"SHARDED LANE REGRESSION {line}")
             report.append(line)
+        b_pipe = b.get("pipeline") or {}
+        n_pipe = n.get("pipeline") or {}
+        if (b_pipe.get("mode") == "pipeline"
+                and n_pipe.get("mode") == "pipeline"):
+            b_occ = float(b_pipe.get("occupancy") or 0.0)
+            n_occ = float(n_pipe.get("occupancy") or 0.0)
+            if b_occ > 0 and n_occ > 0:
+                line = (f"{w}: stage occupancy {b_occ:.2f} -> {n_occ:.2f} "
+                        f"(floor {MIN_STREAM_OCCUPANCY:.1f})")
+                if n_occ < MIN_STREAM_OCCUPANCY <= b_occ:
+                    failures.append(f"PIPELINE OVERLAP REGRESSION {line}")
+                report.append(line)
         b_k = b.get("kernels") or {}
         n_k = n.get("kernels") or {}
         for kernel in sorted(set(b_k) & set(n_k)):

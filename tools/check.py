@@ -10,7 +10,8 @@ Exit codes: 0 = no unwaived findings; 1 = findings; 2 = configuration
 error (a declared JIT entry point no longer reaches a jitted function —
 the lint silently lost device-path coverage — or is missing from the
 kernel observatory's ENTRY_KERNELS map, so its dispatches would go
-unmeasured).
+unmeasured, or the streaming pipeline grew a dispatch path that
+bypasses the measured_call/observatory seams — `pipeline_stages`).
 
 The same analysis runs in tier-1 via tests/test_jaxsan.py, so CI fails
 on any unwaived finding; this CLI is the local/fix-up loop. Waiver
@@ -70,6 +71,80 @@ def observatory_gaps(entry_points=None) -> list:
     return gaps
 
 
+# The streaming pipeline's only sanctioned routes to the device: the
+# Scheduler seams, which run every kernel through
+# CompileLedger.measured_call under the observatory capture installed by
+# the scheduler. A stage thread reaching around them dispatches
+# unmeasured work.
+PIPELINE_DISPATCH_SEAMS = frozenset({
+    "dispatch_once", "commit_ready", "schedule_pending",
+    "flush_queues", "flush_backoff_completed",
+})
+
+
+def pipeline_stage_gaps(path: str = None, source: str = None) -> list:
+    """ISSUE 18 `pipeline_stages` check: kubernetes_tpu/pipeline.py must
+    reach the device ONLY through the Scheduler dispatch seams
+    (PIPELINE_DISPATCH_SEAMS) — never by importing jax / the ops or
+    parallel kernel modules, calling a declared JIT entry point, or
+    invoking measured_call itself (attribution context lives in the
+    Scheduler). Returns ["pipeline.py:LINE what (why)", ...]; empty =
+    every dispatch path keeps measured_call/observatory attribution."""
+    import ast
+
+    from kubernetes_tpu.analysis.jaxsan import ENTRY_POINTS
+    from kubernetes_tpu.perf.observatory import ENTRY_KERNELS
+
+    if source is None:
+        path = path or os.path.join(_REPO, "kubernetes_tpu", "pipeline.py")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    fname = os.path.basename(path or "pipeline.py")
+    tree = ast.parse(source, filename=fname)
+
+    entry_names = ({n for names in ENTRY_POINTS.values() for n in names}
+                   | set(ENTRY_KERNELS))
+    banned_abs = ("jax", "kubernetes_tpu.ops", "kubernetes_tpu.parallel")
+    banned_rel = ("ops", "parallel")
+
+    def _banned_module(mod: str, level: int) -> bool:
+        if level:                      # relative: from .ops.program import ..
+            return any(mod == b or mod.startswith(b + ".")
+                       for b in banned_rel)
+        return any(mod == b or mod.startswith(b + ".")
+                   for b in banned_abs)
+
+    gaps: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _banned_module(alias.name, 0):
+                    gaps.append(
+                        f"{fname}:{node.lineno} import {alias.name} "
+                        "(kernel modules are off-limits to stage threads)")
+        elif isinstance(node, ast.ImportFrom):
+            if _banned_module(node.module or "", node.level):
+                gaps.append(
+                    f"{fname}:{node.lineno} from "
+                    f"{'.' * node.level}{node.module or ''} import ... "
+                    "(kernel modules are off-limits to stage threads)")
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in entry_names:
+                gaps.append(
+                    f"{fname}:{node.lineno} direct JIT entry call "
+                    f"{name}() (bypasses the Scheduler dispatch seams "
+                    f"{sorted(PIPELINE_DISPATCH_SEAMS)})")
+            elif name == "measured_call":
+                gaps.append(
+                    f"{fname}:{node.lineno} raw measured_call() "
+                    "(observatory attribution is installed by the "
+                    "Scheduler, not the pipeline)")
+    return gaps
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=_REPO)
@@ -99,6 +174,7 @@ def main(argv=None) -> int:
     # points; an ad-hoc --entries override lints someone else's tree,
     # whose functions have no business in ENTRY_KERNELS
     obs_gaps = [] if entry_points is not None else observatory_gaps()
+    pipe_gaps = [] if entry_points is not None else pipeline_stage_gaps()
 
     if args.as_json:
         print(json.dumps({
@@ -106,6 +182,7 @@ def main(argv=None) -> int:
             "waived": [f.to_dict() for f in waived],
             "missingEntries": an.missing_entries,
             "observatoryGaps": obs_gaps,
+            "pipelineStageGaps": pipe_gaps,
             "modules": len(an.modules),
             "tracedFunctions": sum(1 for fi in an.fns.values()
                                    if fi.traced),
@@ -129,6 +206,11 @@ def main(argv=None) -> int:
         print("jaxsan: CONFIG ERROR — entries invisible to the kernel "
               "observatory (perf/observatory.py ENTRY_KERNELS): "
               + ", ".join(obs_gaps), file=sys.stderr)
+        return 2
+    if pipe_gaps:
+        print("jaxsan: CONFIG ERROR — pipeline_stages: a dispatch path "
+              "bypasses measured_call/observatory attribution: "
+              + "; ".join(pipe_gaps), file=sys.stderr)
         return 2
     return 1 if live else 0
 
