@@ -361,6 +361,36 @@ def ha_failover_case(nodes: int) -> dict:
     }
 
 
+def _env_fingerprint() -> dict:
+    """Execution-environment stamp for the payload: cpu model/count,
+    interpreter + array-stack versions, JAX platform. bench_compare
+    reads both sides' stamps and downgrades cross-container THROUGHPUT
+    failures to warnings on mismatch — numbers from different silicon
+    are not an A/B — while same-container comparisons stay strict."""
+    import platform
+    cpu_model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    versions = {"python": platform.python_version()}
+    for mod in ("jax", "numpy"):
+        try:
+            versions[mod] = __import__(mod).__version__
+        except Exception:
+            versions[mod] = ""
+    return {
+        "cpu_model": cpu_model,
+        "cpu_count": os.cpu_count() or 0,
+        "versions": versions,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
+
+
 def multi_shard_case(nodes: int, pods: int) -> dict:
     """Sharded control plane (ISSUE 17): N=4 fenced scheduler instances
     over ONE cluster, each draining its namespace slice under its own
@@ -404,7 +434,11 @@ def multi_shard_case(nodes: int, pods: int) -> dict:
         for inst in insts:
             inst.tick()
             inst.scheduler.schedule_pending()
-            t["now"] += 5.0
+            # advance the simulated clock just enough to expire bind
+            # backoffs: a 5s step would put every pod's queue→bind SLI
+            # past the 5s e2e objective and the federated SLO block
+            # below would report a driver artifact, not the fleet
+            t["now"] += 0.05
             inst.scheduler.flush_queues()
         bound = sum(1 for p in api.pods.values() if p.spec.node_name)
         if round_no == 0 and bound < pods:
@@ -423,6 +457,14 @@ def multi_shard_case(nodes: int, pods: int) -> dict:
         divergence += sum(int(m.oracle_divergence.value(kind))
                           for kind in ("assignment", "reason", "verdict"))
     rebalance_dts.sort()
+    # fleet observatory proof (ISSUE 19): every bound pod must stitch to
+    # exactly ONE cross-shard timeline ending in bind_confirm (zero
+    # orphaned per-instance fragments survive the mid-run steal), and
+    # the fleet burns ONE federated SLO budget per SLI — the block
+    # bench_compare --slo gates, replacing N private per-instance ones
+    bound_uids = [p.uid for p in api.pods.values() if p.spec.node_name]
+    coverage = mgr.stitcher.coverage(bound_uids)
+    fed = mgr.fleet.federated_slo()
     return {
         "value": round(bound / wall_s, 1) if wall_s else 0.0,
         "pods": bound, "nodes": nodes, "shards": n_shards,
@@ -442,6 +484,17 @@ def multi_shard_case(nodes: int, pods: int) -> dict:
                 i.audit_ledger() is not None
                 and i.audit_ledger().verify()
                 and i.audit_ledger().verify_handoffs() for i in insts),
+            "journeys_total": coverage["pods"],
+            "journeys_stitched": coverage["stitched"],
+            "orphaned_fragments": coverage["orphaned"],
+        },
+        # ONE federated burn per SLI over the fleet (standbys excluded):
+        # what --slo gates instead of N per-instance budgets
+        "slo": {
+            "breaches": fed.breaches(),
+            "divergence_total": divergence,
+            "federated": True,
+            "shards": n_shards,
         },
     }
 
@@ -721,6 +774,9 @@ def main() -> None:
         "value": head["value"],
         "unit": head.get("unit", "pods/s"),
         "vs_baseline": head.get("vs_baseline", 0.0),
+        # environment fingerprint (ISSUE 19): lets bench_compare tell a
+        # cross-container comparison from a same-container A/B
+        "env": _env_fingerprint(),
         "summary": summary,
         "extra": {k: v for k, v in results.items() if k != head_key},
     }))

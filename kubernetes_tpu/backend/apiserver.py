@@ -142,6 +142,9 @@ class APIServer:
     resource_claims: dict[str, ResourceClaim] = field(default_factory=dict)
     leases: dict[str, Lease] = field(default_factory=dict)
     shard_map: Optional[ShardMap] = None
+    # bounded audit trail of accepted shard-map writes (who owned what,
+    # when) — captured into incident bundles (obs/incident.py)
+    shard_map_history: list[dict] = field(default_factory=list)
     pod_handlers: list[WatchHandlers] = field(default_factory=list)
     node_handlers: list[WatchHandlers] = field(default_factory=list)
     workload_handlers: list[WatchHandlers] = field(default_factory=list)
@@ -262,6 +265,13 @@ class APIServer:
         self.shard_map = ShardMap(num_shards=max(1, new.num_shards),
                                   assignments=dict(new.assignments),
                                   version=expect_version + 1)
+        self.shard_map_history.append({
+            "version": self.shard_map.version,
+            "numShards": self.shard_map.num_shards,
+            "assignments": dict(self.shard_map.assignments),
+            "fence": str(fence_token) if fence_token is not None else "",
+        })
+        del self.shard_map_history[:-32]
         return self.get_shard_map()
 
     # -- watch registration (LIST+WATCH: informer semantics) ------------------

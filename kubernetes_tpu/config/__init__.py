@@ -97,6 +97,11 @@ class KubeSchedulerConfiguration:
     # directory for standalone replay records (one pickle per audited
     # drain, re-runnable via tools/audit_replay.py); "" = in-memory only
     shadow_audit_dir: str = ""
+    # directory for incident evidence bundles (obs/incident.py,
+    # `IncidentForensics` gate): the watchdog writes one bounded JSON
+    # bundle per trigger edge, verifiable offline by
+    # tools/incident_dump.py; "" = last bundle kept in memory only
+    incident_dir: str = ""
     # telemetry timeline (obs/timeline.py, `TelemetryTimeline` gate):
     # ring depth in seconds, and the JSON-lines export sink — each
     # per-second bucket is appended as it rotates out of "current"
@@ -191,6 +196,7 @@ class KubeSchedulerConfiguration:
             "shadowAuditSampleRate": self.shadow_audit_sample_rate,
             "shadowAuditMaxReplayPods": self.shadow_audit_max_replay_pods,
             "shadowAuditDir": self.shadow_audit_dir,
+            "incidentDir": self.incident_dir,
             "timelineHorizonSeconds": self.timeline_horizon_seconds,
             "timelineExportPath": self.timeline_export_path,
             "sloObjectives": dict(self.slo_objectives),
@@ -244,6 +250,7 @@ class KubeSchedulerConfiguration:
             shadow_audit_max_replay_pods=d.get("shadowAuditMaxReplayPods",
                                                64),
             shadow_audit_dir=d.get("shadowAuditDir", ""),
+            incident_dir=d.get("incidentDir", ""),
             timeline_horizon_seconds=d.get("timelineHorizonSeconds", 900),
             timeline_export_path=d.get("timelineExportPath", ""),
             slo_objectives=dict(d.get("sloObjectives", {})),

@@ -132,6 +132,17 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # scheduler_kernel_*/scheduler_shard_* metric families. Process-
     # global like the compile ledger it extends.
     "KernelObservatory": FeatureSpec(True, BETA),
+    # fleet observatory (obs/federation.py + obs/stitch.py): telemetry
+    # federation over N sharded instances — shard/role-labeled fleet
+    # exposition, ONE federated SLO burn per SLI (standbys excluded),
+    # capacity-weighted fleet cluster probe (/debug/fleet) — and the
+    # cross-shard journey stitcher behind the manager's /debug/pod.
+    "FleetObservatory": FeatureSpec(True, ALPHA),
+    # incident forensics (obs/incident.py): the watchdog over federated
+    # SLO / divergence / fenced-write / pipeline-stall signals that
+    # captures bounded evidence bundles to incidentDir, offline
+    # verifiable by tools/incident_dump.py.
+    "IncidentForensics": FeatureSpec(True, ALPHA),
 }
 
 

@@ -39,6 +39,7 @@ import time as _time
 from typing import Callable, Optional
 
 from ..backend.apiserver import Conflict, FencedWrite, ShardMap
+from ..obs.journey import EV_STEAL as _EV_STEAL, EV_TRANSFER as _EV_TRANSFER
 from ..scheduler import Scheduler
 from .lease import LeaderElector
 
@@ -94,6 +95,12 @@ class ShardScheduler:
         # keep re-scheduling the winner's pod
         self._chain_bind_error = sched.dispatcher.on_bind_error
         sched.dispatcher.on_bind_error = self._on_bind_error
+        # stitching provenance (obs/stitch.py): every journey transition
+        # this instance writes carries its identity plus the held-lease
+        # fence stamp — a zombie's post-depose transitions remain
+        # distinguishable from the new owner's in the merged timeline
+        sched.journey.instance = identity
+        sched.journey.fence_stamp = self._fence_stamp
 
     # -- ownership ------------------------------------------------------------
 
@@ -117,6 +124,14 @@ class ShardScheduler:
         e = self.electors.get(sid)
         gen = e.fence_token() if e is not None else None
         return (shard_lease_name(sid), gen if gen is not None else -1)
+
+    def _fence_stamp(self) -> str:
+        """Journey-ledger fence stamp: the writer's currently HELD
+        (lease, generation) set, joined — "" when this instance holds
+        no shard lease (unfenced writer)."""
+        return ",".join(
+            f"{shard_lease_name(sid)}@{e.fence_token()}"
+            for sid, e in sorted(self.electors.items()) if e.is_leader())
 
     def elector_for(self, sid: int) -> LeaderElector:
         e = self.electors.get(sid)
@@ -205,6 +220,9 @@ class ShardScheduler:
             fresh = pod.with_node_name("")
             self.scheduler.queue.delete(fresh)
             self.scheduler._shard_parked[fresh.uid] = fresh
+            self.scheduler._journey_park(
+                [fresh], detail="fence unwind" if lost
+                else "lost ownership")
 
     # -- serving --------------------------------------------------------------
 
@@ -250,6 +268,48 @@ class ShardManager:
         self.splits = 0
         self.merges = 0
         self.steals = 0
+        # fleet observatory (ISSUE 19): telemetry federation + journey
+        # stitching over the fleet, and (on demand) the incident
+        # watchdog — all fed by the same member list. The
+        # `FleetObservatory` gate (read off the reference instance's
+        # config) switches the whole plane; off, the manager degrades
+        # to the pre-19 per-instance surfaces.
+        gates = (ref.scheduler.feature_gates if ref is not None else None)
+        self.fleet = None
+        self.stitcher = None
+        self.watchdog = None
+        if gates is None or gates.enabled("FleetObservatory"):
+            from ..obs.federation import FleetAggregator
+            from ..obs.stitch import JourneyStitcher
+            self.fleet = FleetAggregator(self.instances)
+            self.stitcher = JourneyStitcher(self.instances)
+            # incidentDir in the reference config arms forensics at
+            # construction; attach_watchdog() still works for ad-hoc use
+            incident_dir = getattr(
+                getattr(ref.scheduler, "config", None) if ref is not None
+                else None, "incident_dir", "")
+            if incident_dir and (gates is None
+                                 or gates.enabled("IncidentForensics")):
+                self.attach_watchdog(dirpath=incident_dir)
+
+    def attach_watchdog(self, dirpath: str = "", **kwargs):
+        """Arm incident forensics: the watchdog polls the federated
+        signals at each tick_all and captures evidence bundles to
+        `dirpath` (kubernetes_tpu/obs/incident.py). No-op (returns
+        None) when the fleet observatory or the `IncidentForensics`
+        gate is off."""
+        if self.fleet is None:
+            return None
+        ref = self.instances[0] if self.instances else None
+        if (ref is not None and not
+                ref.scheduler.feature_gates.enabled("IncidentForensics")):
+            return None
+        from ..obs.incident import IncidentWatchdog
+        self.watchdog = IncidentWatchdog(
+            self.fleet, self.stitcher, dirpath=dirpath,
+            clock=self.clock, metrics=self.metrics, manager=self,
+            **kwargs)
+        return self.watchdog
 
     # -- topology -------------------------------------------------------------
 
@@ -332,6 +392,18 @@ class ShardManager:
             led = dst.audit_ledger()
             if led is not None and led is not src.audit_ledger():
                 led.record_handoff(sid, head, seq)
+        # the handoff is a first-class journey transition on the
+        # successor: every watch-parked pod of the moving shard gets a
+        # steal/transfer mark BEFORE adopt re-enqueues it, so the
+        # stitched cross-shard timeline names the handoff that moved it
+        moved = [p.uid for p in dst.scheduler._shard_parked.values()
+                 if dst._shard_of(p) == sid]
+        dst.scheduler.journey.record_bulk(
+            moved, _EV_STEAL if reason == "steal" else _EV_TRANSFER,
+            dst.clock(),
+            detail=f"shard {sid}: "
+                   f"{src.identity if src is not None else '?'}"
+                   f" -> {dst.identity} ({reason})")
         dst.rebalance()
         dt = _time.perf_counter() - t0
         if self.metrics is not None:
@@ -376,6 +448,8 @@ class ShardManager:
     def tick_all(self) -> None:
         for inst in self.instances:
             inst.tick()
+        if self.watchdog is not None:
+            self.watchdog.check()
 
     def sync_all(self) -> int:
         return sum(inst.sync() for inst in self.instances)
@@ -421,7 +495,11 @@ class ShardManager:
         return {"numShards": m.num_shards,
                 "mapVersion": m.version,
                 "assignments": dict(m.assignments),
+                "mapHistory": len(getattr(self.client,
+                                          "shard_map_history", ())),
                 "leases": leases,
                 "splits": self.splits, "merges": self.merges,
                 "steals": self.steals,
+                "incidents": (None if self.watchdog is None
+                              else self.watchdog.debug()),
                 "instances": [inst.debug() for inst in self.instances]}

@@ -63,6 +63,10 @@ class StandbyScheduler:
         self.enabled = self.scheduler.feature_gates.enabled(
             "ActiveStandbyHA")
         self.scheduler.ha_role = "standby"
+        # federation provenance (obs/federation.py): the standby reports
+        # under its own shard label with role="standby" — its mirrored
+        # series stay visible but are EXCLUDED from the cluster SLO burn
+        self.scheduler.journey.instance = identity
         self.ledger = ledger
         self.cursor = 0              # last consumed ledger seq
         self.last_hash = ""          # hash of the last consumed record

@@ -557,6 +557,16 @@ class SchedulerMetrics:
             "guard) or fenced (stale shard-lease generation — the "
             "ordering primitive). Both unwind through on_bind_error.",
             ("outcome",)))
+        self.incidents = r.register(Counter(
+            n + "incidents_total",
+            "Incident-watchdog evidence-bundle captures, by trigger: "
+            "slo_breach (federated SLO ladder trip), divergence "
+            "(shadow-oracle divergence growth), fence_storm "
+            "(fenced-write burst over threshold), pipeline_stall (no "
+            "pipeline forward progress beyond budget). Each capture "
+            "writes one bounded bundle to incidentDir "
+            "(kubernetes_tpu/obs/incident.py).",
+            ("trigger",)))
         # streaming drain pipeline (kubernetes_tpu/pipeline.py, ISSUE 18):
         # per-stage busy walls + backpressure stalls, mirrored from the
         # pipeline's own counters at exposition time (publish_metrics)
@@ -725,6 +735,9 @@ class SchedulerMetrics:
             self.shard_steals.inc(reason, by=0)
         for outcome in CROSS_SHARD_OUTCOMES:
             self.cross_shard_conflicts.inc(outcome, by=0)
+        from ..obs.incident import TRIGGERS
+        for trigger in TRIGGERS:
+            self.incidents.inc(trigger, by=0)
         from ..obs.journey import CAUSES, EVENTS, SEGMENTS
         for segment in SEGMENTS:
             self.e2e_segment.seed(segment)

@@ -15,4 +15,7 @@ Three parts, all served by the SchedulerServer's /debug endpoints:
   breaches, not just throughput medians.
 """
 
+from .federation import FleetAggregator  # noqa: F401
+from .incident import IncidentWatchdog  # noqa: F401
 from .slo import SLOEngine, validate_objectives  # noqa: F401
+from .stitch import JourneyStitcher  # noqa: F401

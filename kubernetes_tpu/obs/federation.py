@@ -1,0 +1,220 @@
+"""Telemetry federation: one cluster-level view over N scheduler shards.
+
+PR 17 sharded the control plane; every rail (metrics, SLO burn, cluster
+probe) stayed per-instance. The FleetAggregator pulls each member's
+exposition / live metric objects — in-process today, but shaped exactly
+like an HTTP scrape (text exposition in, labels injected) so the
+cross-process step only swaps the transport — and merges them:
+
+- **series**: every per-instance sample re-labeled with `shard` (the
+  instance identity) and `role` (active/standby), concatenated into one
+  fleet exposition. Histograms stay log2-bucketed, so the per-shard
+  series merge losslessly via `Histogram.merged_counts` into
+  cluster-level series.
+- **SLO**: the fleet burns ONE error budget per SLI. Active members'
+  burn-bucket rings merge epoch-wise into a federated SLOEngine, so
+  `bench_compare --slo` gates the cluster's budget, not N private ones.
+  Standby members are EXCLUDED: a warm standby tails the active's drain
+  ledger, so its mirrored SLI streams would double-count every event
+  (the ISSUE 19 bugfix — standbys still appear in the series view, with
+  `role="standby"`, they just never contribute to the cluster burn).
+- **probe**: the latest per-shard `cluster_probe` snapshots merge
+  capacity-weighted (by each slice's valid-node count) into fleet-level
+  fragmentation / stranded / imbalance indices — the trigger signal the
+  defragmentation policy (ROADMAP item 3) will read — at /debug/fleet.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+
+class FleetAggregator:
+    """Merge N instances' telemetry into one cluster view.
+
+    `members` are ShardScheduler / StandbyScheduler / Scheduler-shaped:
+    anything with `.scheduler` (or itself Scheduler-shaped) exposing
+    `.metrics`, `.slo`, `.ha_role` and `._last_probe`."""
+
+    def __init__(self, members=()):
+        self._members = list(members)
+
+    def add(self, member) -> None:
+        self._members.append(member)
+
+    def _resolve(self):
+        """Yield (name, role, scheduler) per member. Role comes from the
+        scheduler's HA lifecycle: a StandbyScheduler's inner Scheduler
+        reports "standby" until promoted."""
+        for i, m in enumerate(self._members):
+            sched = getattr(m, "scheduler", m)
+            if getattr(sched, "metrics", None) is None:
+                continue
+            ledger = getattr(sched, "journey", None)
+            name = ((ledger.instance if ledger is not None else "")
+                    or getattr(m, "identity", "") or f"instance-{i}")
+            yield name, getattr(sched, "ha_role", "active"), sched
+
+    def _actives(self):
+        return [(n, r, s) for n, r, s in self._resolve() if r != "standby"]
+
+    # -- federated series (scrape-shaped) -------------------------------------
+
+    @staticmethod
+    def _inject_labels(text: str, extra: str, samples: list,
+                       headers: dict) -> None:
+        """Re-label one instance's exposition text: every sample line
+        gains the `extra` labels; HELP/TYPE headers are collected once
+        per family. This is the scrape-side half of federation — the
+        cross-process step feeds the same function from HTTP bodies."""
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("#"):
+                # "# HELP name ..." / "# TYPE name ..."
+                parts = line.split(None, 3)
+                if len(parts) >= 3:
+                    headers.setdefault((parts[2], parts[1]), line)
+                continue
+            name, brace, rest = line.partition("{")
+            if brace:
+                samples.append(f"{name}{{{extra},{rest}")
+            else:
+                metric, _, value = line.partition(" ")
+                samples.append(f"{metric}{{{extra}}} {value}")
+
+    def exposition(self) -> str:
+        """One fleet exposition: every member's samples with shard/role
+        labels injected, HELP/TYPE emitted once per family. Standby
+        members ARE included here (labeled role="standby") — exclusion
+        only applies to the cluster SLO burn and cluster-level merges,
+        where a mirrored series would double-count."""
+        samples: list = []
+        headers: dict = {}
+        for name, role, sched in self._resolve():
+            self._inject_labels(
+                sched.metrics.exposition(),
+                f'shard="{name}",role="{role}"', samples, headers)
+        return "\n".join(list(headers.values()) + samples) + "\n"
+
+    def cluster_series(self) -> dict:
+        """Cluster-level merged series over ACTIVE members: counters and
+        gauges sum per label set; histograms merge each instance's
+        log2-bucket counts via `Histogram.merged_counts` (identical
+        bucket layout per family by construction — same registry code)."""
+        from ..metrics import Counter, Gauge, Histogram
+        counters: dict = {}
+        histograms: dict = {}
+        for name, role, sched in self._actives():
+            sched.metrics.sync_compile_ledger()
+            sched.metrics.sync_observatory()
+            for fam, metric in sched.metrics.registry._metrics.items():
+                if isinstance(metric, Histogram):
+                    agg = histograms.setdefault(fam, {
+                        "buckets": list(metric.buckets),
+                        "counts": [0] * (len(metric.buckets) + 1),
+                        "sum": 0.0, "count": 0, "shards": 0})
+                    for i, c in enumerate(metric.merged_counts()):
+                        agg["counts"][i] += c
+                    agg["sum"] += sum(metric._sums.values())
+                    agg["count"] += sum(metric._totals.values())
+                    agg["shards"] += 1
+                elif isinstance(metric, (Counter, Gauge)):
+                    values = (metric.callback()
+                              if getattr(metric, "callback", None)
+                              is not None else metric._values)
+                    dst = counters.setdefault(fam, {})
+                    for key, v in values.items():
+                        dst[key] = dst.get(key, 0.0) + v
+        return {"counters": counters, "histograms": histograms}
+
+    # -- federated SLO burn ---------------------------------------------------
+
+    def federated_slo(self):
+        """ONE SLOEngine over the fleet: active members' burn-bucket
+        rings merged epoch-wise (all in-process engines share a clock,
+        so epochs align; the cross-process step aligns scrape clocks).
+        Standbys are excluded — their SLI streams mirror the active's."""
+        from .slo import SLOEngine
+        actives = self._actives()
+        base = actives[0][2] if actives else None
+        eng = SLOEngine(clock=(base.slo.clock if base is not None
+                               else _time.monotonic))
+        if base is not None:
+            eng.objectives = dict(base.slo.objectives)
+            eng._totals = {sli: [0, 0] for sli in eng.objectives}
+        merged: dict = {}
+        for name, role, sched in actives:
+            with sched.slo._lock:
+                rings = {sli: [tuple(b) for b in ring]
+                         for sli, ring in sched.slo._buckets.items()}
+                totals = {sli: tuple(t)
+                          for sli, t in sched.slo._totals.items()}
+            for sli, ring in rings.items():
+                dst = merged.setdefault(sli, {})
+                for epoch, good, bad in ring:
+                    cell = dst.setdefault(epoch, [epoch, 0, 0])
+                    cell[1] += good
+                    cell[2] += bad
+            for sli, (good, bad) in totals.items():
+                tot = eng._totals.setdefault(sli, [0, 0])
+                tot[0] += good
+                tot[1] += bad
+        eng._buckets = {sli: [dst[e] for e in sorted(dst)]
+                        for sli, dst in merged.items()}
+        return eng
+
+    def slo_snapshot(self, compact: bool = False) -> dict:
+        return self.federated_slo().snapshot(compact=compact)
+
+    # -- federated cluster probe ----------------------------------------------
+
+    def fleet_probe(self) -> dict:
+        """Capacity-weighted merge of the latest per-shard cluster_probe
+        snapshots: fleet frag/stranded/utilization indices weighted by
+        each slice's valid-node count, domain imbalance likewise."""
+        shards: dict = {}
+        res_acc: dict = {}
+        dom_acc: dict = {}
+        total_w = 0
+        for name, role, sched in self._actives():
+            probe = getattr(sched, "_last_probe", None)
+            if not probe:
+                continue
+            w = int(probe.get("validNodes", 0)) or 1
+            shards[name] = probe
+            total_w += w
+            for rname, stats in (probe.get("resources") or {}).items():
+                dst = res_acc.setdefault(rname, {})
+                for stat, v in stats.items():
+                    dst[stat] = dst.get(stat, 0.0) + w * float(v)
+            for stat, v in (probe.get("domains") or {}).items():
+                dom_acc[stat] = dom_acc.get(stat, 0.0) + w * float(v)
+        if not total_w:
+            return {"validNodes": 0, "shards": {}}
+        return {
+            "validNodes": total_w,
+            "resources": {rname: {stat: round(v / total_w, 6)
+                                  for stat, v in stats.items()}
+                          for rname, stats in res_acc.items()},
+            "domains": {stat: round(v / total_w, 6)
+                        for stat, v in dom_acc.items()},
+            "shards": shards,
+        }
+
+    # -- /debug/fleet ---------------------------------------------------------
+
+    def fleet_view(self) -> dict:
+        members = {}
+        for name, role, sched in self._resolve():
+            members[name] = {
+                "role": role,
+                "journey": sched.journey.stats(),
+                "slo": sched.slo.snapshot(compact=True),
+                "probe": getattr(sched, "_last_probe", None),
+            }
+        return {
+            "members": members,
+            "slo": self.slo_snapshot(compact=True),
+            "probe": self.fleet_probe(),
+        }

@@ -1,0 +1,243 @@
+"""Incident forensics: auto-captured evidence bundles for fleet breaches.
+
+Every rail so far OBSERVES; nothing captures. When something goes wrong
+across a shard handoff — a fenced-write storm during a steal, a shadow-
+oracle divergence, an SLO ladder trip, a stalled pipeline — the evidence
+is spread over N instances' ring buffers and ages out of them within
+seconds. The IncidentWatchdog polls the fleet-level signals and, on a
+breach, captures a BOUNDED evidence bundle to `incidentDir`:
+
+- the federated SLO snapshot + the fleet view (per-member role/probe),
+- each instance's flight-recorder window (last K drains),
+- the stitched journeys of the implicated pods (cross-shard timelines),
+- the kernel-observatory snapshot,
+- each instance's audit-ledger slice WITH its hash-chain head and the
+  handoff annex (chain heads across shard handoffs) — offline
+  verifiable by `tools/incident_dump.py`, which exits 2 on any broken
+  chain,
+- the ShardMap version history (who owned what, when),
+- per-instance pipeline occupancy stats.
+
+Triggers are edge-detected (a persisting breach captures once, a new
+breach signature captures again) and every capture increments
+`scheduler_incidents_total{trigger}`. Retention is bounded: the oldest
+bundles beyond `max_bundles` are deleted, so a flapping trigger cannot
+fill the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time as _time
+from typing import Optional
+
+from .journey import EV_PARK, EV_REQUEUE, EV_STEAL
+
+# trigger label set of scheduler_incidents_total (pre-seeded; the
+# exposition lint asserts this exact set)
+TRIGGERS = ("slo_breach", "divergence", "fence_storm", "pipeline_stall")
+
+BUNDLE_SCHEMA = "tpu-scheduler-incident/v1"
+
+
+class IncidentWatchdog:
+    """Poll fleet signals; capture evidence bundles on breach."""
+
+    def __init__(self, fleet, stitcher, dirpath: str = "",
+                 clock=None, metrics=None, manager=None,
+                 max_bundles: int = 8, flight_limit: int = 64,
+                 journey_limit: int = 32, audit_limit: int = 16,
+                 fence_storm_threshold: int = 16,
+                 stall_budget_s: float = 30.0):
+        self.fleet = fleet
+        self.stitcher = stitcher
+        self.dirpath = dirpath
+        self.clock = clock or _time.monotonic
+        self.metrics = metrics
+        self.manager = manager
+        self.max_bundles = int(max_bundles)
+        self.flight_limit = int(flight_limit)
+        self.journey_limit = int(journey_limit)
+        self.audit_limit = int(audit_limit)
+        self.fence_storm_threshold = int(fence_storm_threshold)
+        self.stall_budget_s = float(stall_budget_s)
+        self.sequence = 0
+        self.bundles: list[dict] = []      # in-memory ring (last capture)
+        self._last_bundle: Optional[dict] = None   # full last bundle
+        # edge-detection state
+        self._seen_divergence = 0.0
+        self._seen_fenced = 0.0
+        self._breach_sig: frozenset = frozenset()
+        self._stalled: set = set()
+
+    # -- signal sampling ------------------------------------------------------
+
+    def _sum_counter(self, attr: str) -> float:
+        total = 0.0
+        for name, role, sched in self.fleet._actives():
+            metric = getattr(sched.metrics, attr, None)
+            if metric is not None:
+                total += sum(metric._values.values())
+        return total
+
+    def check(self) -> list[dict]:
+        """Sample every trigger signal once; capture a bundle per newly
+        breached trigger. Returns the captured bundle summaries."""
+        captured = []
+        # 1. federated SLO ladder trip (new breach signature only)
+        breaches = self.fleet.federated_slo().breaches()
+        sig = frozenset((b["sli"], b["window"]) for b in breaches)
+        if sig and sig != self._breach_sig:
+            captured.append(self.capture("slo_breach",
+                                         {"breaches": breaches}))
+        self._breach_sig = sig
+        # 2. shadow-oracle divergence (any growth)
+        div = self._sum_counter("oracle_divergence")
+        if div > self._seen_divergence:
+            captured.append(self.capture(
+                "divergence", {"divergenceTotal": div,
+                               "delta": div - self._seen_divergence}))
+        self._seen_divergence = div
+        # 3. fenced-write storm (threshold-many rejections since last check)
+        fenced = self._sum_counter("fenced_writes_rejected")
+        if fenced - self._seen_fenced >= self.fence_storm_threshold:
+            captured.append(self.capture(
+                "fence_storm", {"fencedTotal": fenced,
+                                "delta": fenced - self._seen_fenced}))
+        self._seen_fenced = fenced
+        # 4. pipeline stall beyond budget (once per continuous stall)
+        stalled_now = set()
+        for name, role, sched in self.fleet._actives():
+            pipe = getattr(sched, "pipeline", None)
+            stall = pipe.stall_seconds() if pipe is not None else 0.0
+            if stall > self.stall_budget_s:
+                stalled_now.add(name)
+                if name not in self._stalled:
+                    captured.append(self.capture(
+                        "pipeline_stall",
+                        {"instance": name, "stallSeconds": stall}))
+        self._stalled = stalled_now
+        return captured
+
+    # -- implicated pods ------------------------------------------------------
+
+    def _implicated(self) -> list:
+        """Bounded uid set for the journey slice: pods whose recent
+        transitions are the kind incidents are made of — requeues
+        (fence unwinds, bind errors), parks and steals — newest first
+        across every instance's ring."""
+        uids: dict = {}
+        wanted = (EV_REQUEUE, EV_PARK, EV_STEAL)
+        for name, ledger in self.stitcher.ledgers():
+            if len(uids) >= self.journey_limit:
+                break
+            evs, ids = ledger._ev, ledger._uid
+            for i in range(len(evs) - 1, -1, -1):
+                if evs[i] in wanted and ids[i] not in uids:
+                    uids[ids[i]] = True
+                    if len(uids) >= self.journey_limit:
+                        break
+        return list(uids)
+
+    # -- capture --------------------------------------------------------------
+
+    def capture(self, trigger: str, signals: Optional[dict] = None) -> dict:
+        """Capture one bounded evidence bundle for `trigger`; write it to
+        incidentDir (when set), enforce retention, bump the counter.
+        Returns the bundle summary {trigger, sequence, path}."""
+        self.sequence += 1
+        flight = {}
+        audit = {}
+        pipeline = {}
+        for name, role, sched in self.fleet._resolve():
+            rec = getattr(sched, "flight", None)
+            if rec is not None:
+                flight[name] = rec.dump(limit=self.flight_limit)
+            aud = getattr(sched, "audit", None)
+            ledger = getattr(aud, "ledger", None)
+            if ledger is not None:
+                audit[name] = {
+                    "dump": ledger.dump(limit=self.audit_limit),
+                    "handoffs": [dict(e) for e in ledger.handoffs],
+                    "handoffHead": ledger.handoff_head,
+                    "handoffsValid": ledger.verify_handoffs(),
+                }
+            pipe = getattr(sched, "pipeline", None)
+            if pipe is not None:
+                pipeline[name] = pipe.stats()
+        uids = self._implicated()
+        observatory = None
+        for name, role, sched in self.fleet._actives():
+            obs = getattr(sched, "observatory", None)
+            if obs is not None and getattr(obs, "enabled", False):
+                try:
+                    observatory = obs.snapshot()
+                except Exception:
+                    observatory = None
+            break
+        shard_map = None
+        if self.manager is not None:
+            client = getattr(self.manager, "client", None)
+            if client is not None and hasattr(client, "get_shard_map"):
+                cur = client.get_shard_map()
+                shard_map = {
+                    "current": {"numShards": cur.num_shards,
+                                "version": cur.version,
+                                "assignments": dict(cur.assignments)},
+                    "history": list(getattr(client, "shard_map_history",
+                                            ())),
+                }
+        bundle = {
+            "schema": BUNDLE_SCHEMA,
+            "trigger": trigger,
+            "sequence": self.sequence,
+            "capturedAt": round(self.clock(), 6),
+            "signals": signals or {},
+            "slo": self.fleet.slo_snapshot(),
+            "fleet": self.fleet.fleet_view(),
+            "flight": flight,
+            "journeys": {uid: self.stitcher.pod(uid) for uid in uids},
+            "observatory": observatory,
+            "audit": audit,
+            "shardMap": shard_map,
+            "pipeline": pipeline,
+        }
+        summary = {"trigger": trigger, "sequence": self.sequence,
+                   "path": self._write(bundle)}
+        self.bundles.append(summary)
+        del self.bundles[:-self.max_bundles]
+        if self.metrics is not None:
+            self.metrics.incidents.inc(trigger)
+        return summary
+
+    def _write(self, bundle: dict) -> str:
+        if not self.dirpath:
+            # in-memory only: keep the full bundle reachable for tests
+            bundle_path = ""
+            self._last_bundle = bundle
+            return bundle_path
+        os.makedirs(self.dirpath, exist_ok=True)
+        name = f"incident-{bundle['sequence']:06d}-{bundle['trigger']}.json"
+        path = os.path.join(self.dirpath, name)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(bundle, f, indent=1, sort_keys=True, default=str)
+        self._last_bundle = bundle
+        # retention: bounded bundle count, oldest deleted first
+        kept = sorted(fn for fn in os.listdir(self.dirpath)
+                      if fn.startswith("incident-")
+                      and fn.endswith(".json"))
+        for fn in kept[:-self.max_bundles]:
+            try:
+                os.remove(os.path.join(self.dirpath, fn))
+            except OSError:
+                pass
+        return path
+
+    def debug(self) -> dict:
+        return {"sequence": self.sequence,
+                "dir": self.dirpath,
+                "maxBundles": self.max_bundles,
+                "recent": list(self.bundles),
+                "stallBudgetSeconds": self.stall_budget_s,
+                "fenceStormThreshold": self.fence_storm_threshold}

@@ -47,10 +47,20 @@ EV_REQUEUE = 7       # re-entered the queue (detail = cause)
 EV_BIND_ENQUEUE = 8  # bind handed to the API dispatcher
 EV_BIND_FLUSH = 9    # dispatcher flushed the bind to the API server
 EV_BIND_CONFIRM = 10  # bind echo confirmed through the watch stream
+# shard lifecycle (ha/shards.py, ISSUE 19): parked for a peer shard,
+# warm adoption out of the parked set, eviction back into it, and the
+# manager-driven steal/transfer handoffs — first-class transitions so
+# the fleet stitcher can merge per-instance ledgers into one causal
+# cross-shard timeline
+EV_PARK = 11         # peer shard's pod parked (detail = why)
+EV_ADOPT = 12        # parked pod adopted into the queue (rebalance/steal)
+EV_EVICT = 13        # queued pod evicted to the parked set (handoff)
+EV_STEAL = 14        # shard slice stolen by another instance
+EV_TRANSFER = 15     # cooperative shard transfer (split/merge/rebalance)
 
 EVENTS = ("enqueue", "gate", "ungate", "pop", "drain", "assign",
           "fit_error", "requeue", "bind_enqueue", "bind_flush",
-          "bind_confirm")
+          "bind_confirm", "park", "adopt", "evict", "steal", "transfer")
 
 # requeue causes (the `cause` label set of scheduler_pod_requeues_total;
 # exposition-lint asserts this exact set)
@@ -73,13 +83,24 @@ class JourneyLedger:
         self.metrics = metrics
         self.timeline = None   # obs/timeline.py ring, attached by the owner
         self.enabled = enabled
+        # writer identity (ha/shards.py sets the instance name): the
+        # stitching key — a transition's provenance when N instances'
+        # ledgers merge into one cross-shard timeline (obs/stitch.py)
+        self.instance = ""
+        # fence-stamp provider: () -> str naming the writer's held
+        # (lease, generation) set at record time ("" = unfenced). Wired
+        # by ShardScheduler so every transition carries proof of WHICH
+        # fencing epoch wrote it — a zombie's post-depose transitions are
+        # distinguishable from the new owner's in the stitched timeline.
+        self.fence_stamp: Optional[Callable[[], str]] = None
         # parallel columns (the ring): object ref, event code, timestamp,
-        # detail string, drain id
+        # detail string, drain id, writer fence stamp
         self._uid: list = []
         self._ev: list = []
         self._ts: list = []
         self._detail: list = []
         self._drain: list = []
+        self._fence: list = []
         # e2e SLI clock: uid → first-enqueue time (see module docstring —
         # maintained even when transition recording is disabled)
         self._first_seen: dict[str, float] = {}
@@ -118,6 +139,8 @@ class JourneyLedger:
         self._ts.append(now)
         self._detail.append(detail)
         self._drain.append(drain)
+        self._fence.append(self.fence_stamp() if self.fence_stamp
+                           is not None else "")
         if self.metrics is not None:
             self.metrics.journey_transitions.inc(EVENTS[ev])
         if len(self._uid) >= self.capacity * 2:
@@ -137,6 +160,10 @@ class JourneyLedger:
         self._detail.extend(detail if isinstance(detail, list)
                             else [detail] * n)
         self._drain.extend([drain] * n)
+        # one stamp per batch: every member was written under the same
+        # fencing epoch (the batch is one critical section)
+        self._fence.extend([self.fence_stamp() if self.fence_stamp
+                            is not None else ""] * n)
         if self.metrics is not None:
             self.metrics.journey_transitions.inc(EVENTS[ev], by=n)
         if len(self._uid) >= self.capacity * 2:
@@ -153,6 +180,7 @@ class JourneyLedger:
         del self._ts[:cut]
         del self._detail[:cut]
         del self._drain[:cut]
+        del self._fence[:cut]
 
     def popped(self, qpis: list, now: float) -> None:
         """Pods popped off the activeQ into a scheduling attempt: EV_POP
@@ -202,11 +230,13 @@ class JourneyLedger:
         order) plus the derived per-segment decomposition."""
         transitions = [
             {"t": self._ts[i], "event": EVENTS[self._ev[i]],
-             "detail": self._detail[i], "drain": self._drain[i]}
+             "detail": self._detail[i], "drain": self._drain[i],
+             "fence": self._fence[i]}
             for i in range(len(self._uid)) if self._uid[i] == uid
         ]
         return {
             "uid": uid,
+            "instance": self.instance,
             "firstEnqueue": self._first_seen.get(uid),
             "transitions": transitions,
             "segments": self._segments(transitions),

@@ -1,0 +1,166 @@
+"""Cross-shard journey stitching (ISSUE 19).
+
+Each scheduler instance owns a per-process JourneyLedger; a pod that is
+parked on one shard, stolen mid-drain and bound by another leaves a
+FRAGMENT of its lifecycle on every instance it touched. The stitcher
+merges those fragments by pod uid into one causal cross-shard timeline:
+
+- every transition is tagged with the writer instance's identity and
+  carries the fence stamp the ledger recorded (the writer's held
+  (lease, generation) set), so a zombie's post-depose transitions are
+  attributable to the OLD fencing epoch while the adopter's carry the
+  new one;
+- transitions merge in timestamp order (all in-process ledgers share
+  one monotonic clock; the cross-process step will align scrape
+  clocks) — ties keep member order, which is deterministic;
+- the e2e SLI clock is the MINIMUM first-enqueue across instances: a
+  steal must not restart the queue→bind clock any more than a requeue
+  does (parking seeds the clock on the peer, so the adopter's clock
+  already matches the origin's);
+- segment decomposition reuses `JourneyLedger._segments` over the
+  merged transition list, so a stitched timeline decomposes exactly
+  like a single-instance one.
+
+`coverage()` is the bench/test proof: every bound pod must stitch to
+exactly ONE timeline ending in bind_confirm, with zero orphaned
+per-instance fragments left dangling.
+"""
+
+from __future__ import annotations
+
+from .journey import CAUSES, EVENTS, JourneyLedger
+
+# one-line renderer notes per transition code — the /debug/pod legend.
+# tools/check.py `obs_coverage` asserts this covers EVERY event in
+# EVENTS: a new journey transition cannot land without its rendering.
+EVENT_NOTES = {
+    "enqueue": "first add to the scheduling queue",
+    "gate": "PreEnqueue gated (detail = gating plugin)",
+    "ungate": "gate cleared (quorum met / gate removed)",
+    "pop": "popped off the activeQ into a scheduling attempt",
+    "drain": "entered device drain N (detail = path)",
+    "assign": "node chosen (detail = node name)",
+    "fit_error": "unschedulable (detail = rejector plugins)",
+    "requeue": "re-entered the queue (detail = cause)",
+    "bind_enqueue": "bind handed to the API dispatcher",
+    "bind_flush": "dispatcher flushed the bind to the API server",
+    "bind_confirm": "bind echo confirmed through the watch stream",
+    "park": "peer shard's pod parked warm (detail = why)",
+    "adopt": "parked pod adopted into the queue (rebalance/steal)",
+    "evict": "queued pod evicted to the parked set (handoff)",
+    "steal": "shard slice stolen by another instance",
+    "transfer": "cooperative shard transfer (split/merge/rebalance)",
+}
+
+# one-line renderer notes per requeue cause — also obs_coverage-gated
+CAUSE_NOTES = {
+    "preemption": "failure nominated a node; waiting on victim eviction",
+    "fence_unwind": "write fenced (deposed/stolen lease); assumed undone",
+    "breaker_fallback": "device tier breaker open; host-path retry",
+    "gang_split": "gang member unwound with its group",
+    "resync": "queue rebuilt from a fresh LIST (watch loss)",
+    "bind_error": "API bind failed; forgotten and backed off",
+    "unschedulable": "no feasible node this attempt",
+}
+
+
+class JourneyStitcher:
+    """Merge N instances' journey ledgers into per-pod fleet timelines.
+
+    `members` are ShardScheduler / StandbyScheduler / Scheduler-shaped
+    objects: anything with a `.scheduler` (or itself Scheduler-shaped)
+    exposing `.journey`."""
+
+    def __init__(self, members=()):
+        self._members = list(members)
+
+    def add(self, member) -> None:
+        self._members.append(member)
+
+    def ledgers(self):
+        """Yield (instance name, JourneyLedger) per member."""
+        for i, m in enumerate(self._members):
+            sched = getattr(m, "scheduler", m)
+            ledger = getattr(sched, "journey", None)
+            if ledger is None:
+                continue
+            name = (ledger.instance or getattr(m, "identity", "")
+                    or f"instance-{i}")
+            yield name, ledger
+
+    # -- query (cold path: /debug/pod on the manager) -------------------------
+
+    def pod(self, uid: str) -> dict:
+        """One stitched causal timeline for a pod across every instance
+        that saw it."""
+        merged: list = []
+        instances: list = []
+        first = None
+        for name, ledger in self.ledgers():
+            view = ledger.pod(uid)
+            if not view["transitions"] and view["firstEnqueue"] is None:
+                continue
+            instances.append(name)
+            if view["firstEnqueue"] is not None:
+                first = (view["firstEnqueue"] if first is None
+                         else min(first, view["firstEnqueue"]))
+            for tr in view["transitions"]:
+                tr["instance"] = name
+                merged.append(tr)
+        merged.sort(key=lambda tr: tr["t"])   # stable: ties keep member order
+        if first is None and merged:
+            first = merged[0]["t"]
+        fences = list(dict.fromkeys(tr["fence"] for tr in merged
+                                    if tr["fence"]))
+        present = {tr["event"] for tr in merged}
+        return {
+            "uid": uid,
+            "firstEnqueue": first,
+            "instances": instances,
+            "fences": fences,
+            "transitions": merged,
+            "segments": JourneyLedger._segments(merged),
+            "notes": {ev: EVENT_NOTES[ev] for ev in EVENTS
+                      if ev in present},
+            "causes": {c: CAUSE_NOTES[c] for c in CAUSES
+                       if any(tr["event"] == "requeue"
+                              and tr["detail"].split(":")[0] == c
+                              for tr in merged)},
+        }
+
+    def coverage(self, uids) -> dict:
+        """The stitch proof over a pod population: `stitched` counts
+        pods whose MERGED timeline reaches bind_confirm; `orphaned`
+        counts per-instance fragments belonging to pods that never
+        stitched to a confirmed bind (dangling lifecycle shards). For a
+        fully bound population, stitched == len(uids), orphaned == 0."""
+        stitched = orphaned = fragments = 0
+        for uid in uids:
+            view = self.pod(uid)
+            n = len(view["instances"])
+            fragments += n
+            if any(tr["event"] == "bind_confirm"
+                   for tr in view["transitions"]):
+                stitched += 1
+            else:
+                orphaned += n
+        return {"pods": len(uids), "stitched": stitched,
+                "fragments": fragments, "orphaned": orphaned}
+
+    # -- fleet Chrome trace ---------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """All instances' span histories merged onto one clock with a
+        per-shard process track (utils/tracing.py fleet_chrome_trace)."""
+        from ..utils.tracing import fleet_chrome_trace
+        pairs = []
+        for i, m in enumerate(self._members):
+            sched = getattr(m, "scheduler", m)
+            tracer = getattr(sched, "tracer", None)
+            if tracer is None:
+                continue
+            ledger = getattr(sched, "journey", None)
+            name = ((ledger.instance if ledger is not None else "")
+                    or getattr(m, "identity", "") or f"instance-{i}")
+            pairs.append((name, tracer))
+        return fleet_chrome_trace(pairs)

@@ -109,6 +109,10 @@ class StreamingPipeline:
         self._started_at = 0.0
         self._stopped_at: Optional[float] = None
         self._oldest_arrival: Optional[float] = None
+        # last forward progress (a dispatched batch or a committed
+        # drain): the incident watchdog's pipeline_stall signal reads
+        # the age of this stamp while work is queued
+        self._last_progress = 0.0
         # device-busy accounting: non-overlapping [dispatched, ready)
         # windows (the device executes drains serially)
         self._last_ready = 0.0
@@ -123,6 +127,7 @@ class StreamingPipeline:
         self._started = True
         self._started_at = time.perf_counter()
         self._last_ready = self._started_at
+        self._last_progress = self._started_at
         if self.gc_pause:
             self._stack.enter_context(scheduling_gc_pause())
         self.sched.pipeline = self
@@ -249,6 +254,7 @@ class StreamingPipeline:
         self._busy["ingest"] += time.perf_counter() - t0
         if took:
             self._batches += 1
+            self._last_progress = time.perf_counter()
             self._close_reasons[reason] = (
                 self._close_reasons.get(reason, 0) + 1)
         self._oldest_arrival = (
@@ -304,6 +310,7 @@ class StreamingPipeline:
                         # commit every landed drain in one lock hold
                         # (head-first: commit order IS dispatch order)
                         self._commits += sched.commit_ready()
+                        self._last_progress = time.perf_counter()
                     sched.dispatcher.flush()
                     self._busy["commit"] += time.perf_counter() - t0
                 finally:
@@ -357,6 +364,19 @@ class StreamingPipeline:
             m.pipeline_backpressure._values[(stage,)] = float(
                 self._backpressure[stage])
 
+    def stall_seconds(self) -> float:
+        """Age of the last forward progress (dispatched batch or
+        committed drain) while work is queued; 0.0 when the pipeline is
+        idle-empty, stopped, or progressing. The incident watchdog trips
+        its pipeline_stall trigger when this exceeds the stall budget."""
+        if not self._started or self._stop:
+            return 0.0
+        sched = self.sched
+        if (not len(sched.queue.active_q) and not sched._pending
+                and not len(sched.dispatcher)):
+            return 0.0
+        return max(time.perf_counter() - self._last_progress, 0.0)
+
     def stats(self) -> dict:
         """The /debug/pipeline occupancy block."""
         self.publish_metrics()
@@ -372,6 +392,7 @@ class StreamingPipeline:
             # this: sum of per-stage busy seconds vs wall)
             "occupancy": round(busy_sum / wall, 4) if wall > 0 else 0.0,
             "backpressure": dict(self._backpressure),
+            "stallSeconds": round(self.stall_seconds(), 6),
             "batchClose": dict(self._close_reasons),
             "batches": self._batches,
             "commits": self._commits,

@@ -42,9 +42,10 @@ from .framework.interface import Code, CycleState, Status
 from .framework.runtime import Framework, schedule_pod
 from .framework.types import (ActionType, ClusterEvent, EventResource,
                               FitError, PodInfo, QueuedPodInfo)
-from .obs.journey import (EV_ASSIGN as _EV_ASSIGN, EV_DRAIN as _EV_DRAIN,
+from .obs.journey import (EV_ADOPT as _EV_ADOPT, EV_ASSIGN as _EV_ASSIGN,
+                          EV_DRAIN as _EV_DRAIN, EV_EVICT as _EV_EVICT,
                           EV_FIT_ERROR as _EV_FIT_ERROR,
-                          EV_REQUEUE as _EV_REQUEUE)
+                          EV_PARK as _EV_PARK, EV_REQUEUE as _EV_REQUEUE)
 from .ops.program import (PROBE_STATS, PodXs, ScoreConfig, WaveXs,
                           cluster_probe, initial_carry, run_batch,
                           run_plan, run_uniform, run_wave,
@@ -396,6 +397,7 @@ class Scheduler:
                                     spread_plugin=spread_p, ipa_plugin=ipa_p)
         self.dispatcher = APIDispatcher(
             client=client, on_bind_error=self._on_bind_error)
+        self.config = config    # retained: ShardManager reads incident_dir
         if config is not None:
             self.dispatcher.retry_max_attempts = config.api_retry_max_attempts
             self.dispatcher.retry_base_seconds = config.api_retry_base_seconds
@@ -920,6 +922,7 @@ class Scheduler:
                 # a peer shard's pod: stay warm (workload state above,
                 # node/cache state via the bind echo) but don't schedule
                 self._shard_parked[pod.uid] = pod
+                self._journey_park([pod], detail="peer shard's pod")
                 return
             self.queue.add(pod)
             gated = (pod.uid in self.queue.unschedulable_pods)
@@ -948,18 +951,22 @@ class Scheduler:
         all), and the quorum retry runs once per gang, not per member."""
         plain: list[Pod] = []
         gang_pods: list[Pod] = []
+        parked: list[Pod] = []
         for pod in pods:
             if pod.spec.node_name or not self._responsible(pod):
                 self._on_pod_add(pod)
             elif not self._shard_owns(pod):
                 self.workload_manager.add_pod(pod)
                 self._shard_parked[pod.uid] = pod
+                parked.append(pod)
             elif pod.spec.workload_ref:
                 self.workload_manager.add_pod(pod)
                 gang_pods.append(pod)
             else:
                 self.workload_manager.add_pod(pod)
                 plain.append(pod)
+        if parked:
+            self._journey_park(parked, detail="peer shard's pod")
         if plain:
             n = self.queue.add_bulk(plain)
             self.metrics.queue_incoming_pods.inc("active", "PodAdd",
@@ -1186,6 +1193,20 @@ class Scheduler:
         self.journey.record_bulk(uids, _EV_REQUEUE, now,
                                  detail=f"{cause}: {detail}" if detail
                                  else cause)
+
+    def _journey_park(self, pods: list, detail: str = "") -> None:
+        """A peer shard's pods parked: first-class park transition AND
+        the e2e SLI clock seed — a pod first sighted parked starts its
+        clock at park time, so the stitched cross-shard timeline's
+        firstEnqueue (the min across instances) anchors at the earliest
+        sighting anywhere in the fleet, steal or no steal."""
+        if not pods:
+            return
+        now = self.clock()
+        for pod in pods:
+            self.journey.first_enqueue(pod.uid, now)
+        self.journey.record_bulk([p.uid for p in pods], _EV_PARK, now,
+                                 detail=detail)
 
     def _timeline_slo_sample(self) -> dict:
         """Compact SLO sample stamped onto each closing timeline bucket:
@@ -2477,6 +2498,13 @@ class Scheduler:
                 return 0
             for p in owned:
                 self._shard_parked.pop(p.uid, None)
+            # adopt precedes the (re-)enqueue in the stitched timeline;
+            # queue.add_bulk below restores each known pod's original
+            # first-enqueue e2e clock (parking seeded it), so the SLI
+            # clock survives the handoff like it survives requeues
+            self.journey.record_bulk(
+                [p.uid for p in owned], _EV_ADOPT, self.clock(),
+                detail=f"{len(owned)} pod(s) from parked set")
             n_gated = self.queue.add_bulk(owned)
             self.metrics.queue_incoming_pods.inc(
                 "active", "PodAdd", by=len(owned) - n_gated)
@@ -2503,12 +2531,17 @@ class Scheduler:
             self.dispatcher.flush()
             pods, _ = self.queue.pending_pods()
             moved = 0
+            evicted: list = []
             for pod in pods:
                 if pod.spec.node_name or self._shard_owns(pod):
                     continue
                 self.queue.delete(pod)
                 self._shard_parked[pod.uid] = pod
+                evicted.append(pod.uid)
                 moved += 1
+            if evicted:
+                self.journey.record_bulk(evicted, _EV_EVICT, self.clock(),
+                                         detail="shard handoff")
             return moved
 
     def resync(self) -> None:
@@ -2579,6 +2612,7 @@ class Scheduler:
         # whose quorum already arrived re-gates then ungates in the same
         # add_bulk pass instead of stranding behind PreEnqueue.
         self._shard_parked.clear()
+        reparked: list[Pod] = []
         for pod in self.client.pods.values():
             wm_add(pod)
             if pod.spec.node_name:
@@ -2588,6 +2622,9 @@ class Scheduler:
                     unbound_pods.append(pod)
                 else:
                     self._shard_parked[pod.uid] = pod
+                    reparked.append(pod)
+        if reparked:
+            self._journey_park(reparked, detail="resync")
         self.cache.add_pods(bound_pods)
         if unbound_pods:
             # journey: every unbound pod re-enters the queue because of

@@ -10,7 +10,12 @@ in-process model:
   the informer handlers to be registered (the reference's
   WaitForHandlersSync analog) and — when leader election is on — this
   instance to hold the lease; /metrics serves the Prometheus exposition.
-- /debug/* are the observability surfaces: /debug/flightrecorder (the
+- /debug/* are the observability surfaces (GET /debug/ for the machine-
+  readable index of every endpoint with its gate status): /debug/fleet
+  (the fleet observatory: per-member role/journey/SLO/probe, ONE
+  federated SLO burn per SLI, the capacity-weighted fleet probe;
+  ?exposition=1 serves the shard/role-labeled fleet exposition),
+  /debug/flightrecorder (the
   per-drain flight ring), /debug/slowcycles (slow span trees + slowest
   drains), /debug/events (the event recorder, ?reason=FailedScheduling to
   filter), /debug/cachedump (CacheDebugger.dump), /debug/cache (dump +
@@ -60,6 +65,43 @@ from typing import Optional
 from .backend.apiserver import LEASE_NAME, Lease  # noqa: F401 (re-export)
 from .ha.lease import LeaderElector
 
+# every /debug endpoint, enumerated for the /debug/ index. The
+# registration test (tests/test_federation.py) asserts this table and
+# the do_GET handler chain stay in lockstep — a new endpoint MUST land
+# in both or the suite fails.
+DEBUG_ENDPOINTS = (
+    ("/debug/cache", "cache debugger dump + full divergence sweep"),
+    ("/debug/cachedump", "cache dump without the divergence sweep"),
+    ("/debug/fleet", "federated fleet view: per-member role/journey/SLO/"
+     "probe + ONE cluster SLO burn + capacity-weighted fleet probe "
+     "(?exposition=1 for the shard/role-labeled fleet exposition)"),
+    ("/debug/flightrecorder", "per-drain flight ring (?limit=N)"),
+    ("/debug/slowcycles", "slow span trees + slowest drains"),
+    ("/debug/hostprofile", "continuous host profiler stacks "
+     "(?seconds=N&format=collapsed|speedscope)"),
+    ("/debug/compileledger", "per-kernel XLA compile seconds, retraces, "
+     "donation misses, h2d bytes"),
+    ("/debug/kernels", "kernel observatory snapshot "
+     "(?plans=N&lanes=refresh)"),
+    ("/debug/audit", "shadow-oracle audit's hash-chained drain ledger "
+     "(?limit=N&details=1)"),
+    ("/debug/explain", "per-bind plugin-level score decomposition "
+     "(?pod=<ns/name>&k=N)"),
+    ("/debug/ha", "HA role, lease + fencing token, ledger-tail cursor, "
+     "takeover count"),
+    ("/debug/pod", "pod journey timeline (?uid=<ns/name>) — stitched "
+     "across shards when a shard manager is attached"),
+    ("/debug/pipeline", "streaming drain pipeline occupancy: stage busy "
+     "walls, overlap, backpressure, stall clock"),
+    ("/debug/cluster", "latest resolved cluster_probe snapshot"),
+    ("/debug/timeline", "per-second aggregate telemetry ring "
+     "(?seconds=N)"),
+    ("/debug/shards", "shard topology + per-shard leases + instance "
+     "slices + incident watchdog summary"),
+    ("/debug/slo", "per-SLI multi-window burn rates + breaches"),
+    ("/debug/events", "event recorder dump (?reason=&limit=N)"),
+)
+
 
 class SchedulerServer:
     """healthz/readyz/metrics endpoints for one Scheduler instance."""
@@ -103,6 +145,15 @@ class SchedulerServer:
                 elif self.path == "/statusz":
                     self._send(200, json.dumps(outer.status(), indent=2),
                                "application/json")
+                elif self.path in ("/debug", "/debug/"):
+                    # the index MUST be an exact match: every other
+                    # /debug route below matches by prefix
+                    avail = outer.debug_availability()
+                    self._send(200, json.dumps({"endpoints": [
+                        {"path": p, "description": d,
+                         "available": avail.get(p, True)}
+                        for p, d in DEBUG_ENDPOINTS]}, indent=2),
+                        "application/json")
                 elif self.path == "/debug/cache":
                     # cache debugger dump + comparer (the reference binds
                     # these to SIGUSR2, debugger.go:31-76; an endpoint is
@@ -117,6 +168,20 @@ class SchedulerServer:
                     self._send(200, json.dumps(
                         outer.scheduler.debugger.dump(), indent=2,
                         default=str), "application/json")
+                elif self.path.startswith("/debug/fleet"):
+                    fleet = getattr(outer.shard_manager, "fleet", None)
+                    if fleet is None:
+                        self._send(404, "no fleet aggregator (shard "
+                                        "manager not attached)")
+                        return
+                    q = self._query()
+                    if q.get("exposition") == "1":
+                        self._send(200, fleet.exposition(),
+                                   "text/plain; version=0.0.4")
+                    else:
+                        self._send(200, json.dumps(
+                            fleet.fleet_view(), indent=2, default=str),
+                            "application/json")
                 elif self.path.startswith("/debug/flightrecorder"):
                     q = self._query()
                     self._send(200, json.dumps({
@@ -231,7 +296,12 @@ class SchedulerServer:
                         self._send(404, "journey tracing off "
                                         "(PodJourneyTracing gate)")
                         return
-                    out = journey.pod(uid)
+                    # a shard manager's server stitches the fleet's
+                    # per-instance ledgers into ONE cross-shard timeline
+                    stitcher = getattr(outer.shard_manager, "stitcher",
+                                       None)
+                    out = (stitcher.pod(uid) if stitcher is not None
+                           else journey.pod(uid))
                     code = (200 if out["transitions"]
                             or out["firstEnqueue"] is not None else 404)
                     self._send(code, json.dumps(out, indent=2),
@@ -296,6 +366,22 @@ class SchedulerServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
+
+    def debug_availability(self) -> dict:
+        """Gate status per conditionally-available /debug endpoint
+        (anything not listed here always serves). Backs the /debug/
+        index so an operator sees WHY an endpoint 404s without curling
+        each one."""
+        s = self.scheduler
+        return {
+            "/debug/hostprofile": getattr(s, "profiler", None) is not None,
+            "/debug/kernels": s.observatory.enabled,
+            "/debug/audit": getattr(s, "audit", None) is not None,
+            "/debug/pod": s.journey.enabled,
+            "/debug/pipeline": getattr(s, "pipeline", None) is not None,
+            "/debug/fleet": getattr(self.shard_manager, "fleet",
+                                    None) is not None,
+        }
 
     def readiness(self) -> tuple[bool, str]:
         """server.go:190-211: handlers registered + (if elected) leading."""

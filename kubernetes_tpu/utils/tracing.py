@@ -241,6 +241,36 @@ def export_chrome_trace(path: str, spans: list[Span]) -> int:
     return len(trace["traceEvents"])
 
 
+def fleet_chrome_trace(members) -> dict:
+    """Merge N instances' span histories onto ONE Chrome trace: each
+    instance becomes its own process track (pid = shard index + 1, named
+    after the instance) with the usual host-loop / device-lanes threads
+    underneath. All tracers share the monotonic clock base (in-process
+    fleet; the cross-process step will need a clock offset per scrape),
+    so per-shard tracks line up timewise — a steal renders as the drain
+    span ending on one track and the adopter's drain starting on the
+    next. `members` is an iterable of (name, tracer) pairs; each
+    tracer's retained root spans (recent if kept, else slow ring) are
+    exported."""
+    events: list[dict] = []
+    for i, (name, tracer) in enumerate(members):
+        pid = i + 1
+        events.extend([
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 1,
+             "args": {"name": f"shard:{name}"}},
+            {"ph": "M", "name": "thread_name", "pid": pid, "tid": 1,
+             "args": {"name": "host-loop"}},
+            {"ph": "M", "name": "thread_name", "pid": pid,
+             "tid": DEVICE_LANE_TID, "args": {"name": "device-lanes"}},
+        ])
+        keep_recent = getattr(tracer, "keep_recent", 0)
+        spans = list(tracer.recent if keep_recent
+                     else getattr(tracer, "slow_cycles", ()))
+        for sp in spans:
+            _span_events(sp, events, pid, 1)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 @contextmanager
 def jax_profiler_session(trace_dir: Optional[str]):
     """Bracket a workload with a jax.profiler trace when `trace_dir` is

@@ -414,6 +414,96 @@ class TestSLOGate:
         assert "ORACLE DIVERGENCE" in bad.stdout
 
 
+class TestStitchGate:
+    """ISSUE 19: orphaned journey fragments and stitch gaps in the
+    `shard` proof block fail the sentinel like double-binds do."""
+
+    def _summary(self, shard):
+        return {"MultiShardBasic_X": {
+            "pods_per_s": 400.0, "p50": 390, "p99": 410,
+            "attempt_p50_ms": 1.0, "attempt_p99_ms": 2.0,
+            "shard": shard}}
+
+    def test_fully_stitched_passes(self):
+        assert bench_compare.slo_failures(self._summary(
+            {"double_binds": 0, "divergence": 0, "ledgers_verified": True,
+             "orphaned_fragments": 0, "journeys_total": 4096,
+             "journeys_stitched": 4096})) == []
+
+    def test_orphaned_fragments_fail(self):
+        fails = bench_compare.slo_failures(self._summary(
+            {"double_binds": 0, "divergence": 0, "ledgers_verified": True,
+             "orphaned_fragments": 3, "journeys_total": 8,
+             "journeys_stitched": 6}))
+        assert any(f.startswith("ORPHANED JOURNEY") for f in fails)
+        assert any(f.startswith("JOURNEY STITCH GAP") for f in fails)
+
+    def test_pre19_payload_without_stitch_block_passes(self):
+        assert bench_compare.slo_failures(self._summary(
+            {"double_binds": 0, "divergence": 0,
+             "ledgers_verified": True})) == []
+
+
+class TestEnvFingerprint:
+    """ISSUE 19: cross-container throughput comparisons downgrade to
+    warnings when the env fingerprints differ; everything else (and
+    unstamped payloads) stays strict."""
+
+    ENV_A = {"cpu_model": "Xeon 8481C", "cpu_count": 16,
+             "versions": {"python": "3.11.8", "jax": "0.4.30"},
+             "jax_platforms": "cpu"}
+
+    def test_mismatch_fields(self):
+        env_b = dict(self.ENV_A, cpu_model="EPYC 9B14", cpu_count=8)
+        assert bench_compare.fingerprint_mismatch(self.ENV_A, env_b) \
+            == ["cpu_model", "cpu_count"]
+        assert bench_compare.fingerprint_mismatch(
+            self.ENV_A, dict(self.ENV_A)) == []
+
+    def test_absent_stamp_stays_strict(self):
+        assert bench_compare.fingerprint_mismatch({}, self.ENV_A) == []
+        assert bench_compare.fingerprint_mismatch(self.ENV_A, {}) == []
+
+    def test_env_fingerprint_reads_both_payload_shapes(self):
+        assert bench_compare.env_fingerprint(
+            {"env": self.ENV_A}) == self.ENV_A
+        assert bench_compare.env_fingerprint(
+            {"parsed": {"env": self.ENV_A}}) == self.ENV_A
+        assert bench_compare.env_fingerprint({"summary": {}}) == {}
+
+    def test_cli_cross_container_throughput_downgrades(self, tmp_path):
+        """A 2× throughput drop between DIFFERENT containers warns (exit
+        0, WARNING line); the same drop with matching fingerprints — or
+        with no fingerprints at all — still fails (exit 2)."""
+        wl = {"pods_per_s": 1000.0, "p50": 900, "p99": 1100,
+              "attempt_p50_ms": 1.0, "attempt_p99_ms": 2.0}
+        slow_wl = dict(wl, pods_per_s=500.0, p50=450, p99=550)
+        base = {"summary": {"SchedulingBasic_X": wl}, "env": self.ENV_A}
+        slow_other_env = {"summary": {"SchedulingBasic_X": slow_wl},
+                          "env": dict(self.ENV_A, cpu_model="EPYC 9B14")}
+        slow_same_env = {"summary": {"SchedulingBasic_X": slow_wl},
+                         "env": dict(self.ENV_A)}
+
+        def run(b, n):
+            bp = tmp_path / "b.json"
+            np_ = tmp_path / "n.json"
+            bp.write_text(json.dumps(b))
+            np_.write_text(json.dumps(n))
+            return subprocess.run(
+                [sys.executable, TOOL, "--baseline", str(bp), "--new",
+                 str(np_)], capture_output=True, text=True)
+
+        warned = run(base, slow_other_env)
+        assert warned.returncode == 0, warned.stdout + warned.stderr
+        assert "WARNING (env fingerprint differs" in warned.stdout
+        strict = run(base, slow_same_env)
+        assert strict.returncode == 2
+        assert "THROUGHPUT REGRESSION" in strict.stdout
+        unstamped = run({"summary": {"SchedulingBasic_X": wl}},
+                        {"summary": {"SchedulingBasic_X": slow_wl}})
+        assert unstamped.returncode == 2
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(not _has_trail(), reason="BENCH_r04/r05 not present")
 class TestFreshBenchCheck:

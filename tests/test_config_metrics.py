@@ -483,6 +483,23 @@ class TestExpositionLint:
             assert all(v == 0.0 for _l, v in series[fam]), fam
         assert set(STAGES) == {"ingest", "device", "commit"}
 
+    def test_issue19_families_covered_by_lint(self):
+        """ISSUE 19 satellite: the incident-forensics counter is
+        registered AND pre-seeded with the EXACT trigger label set the
+        watchdog fires — dashboards can alert on rate() before the
+        first capture."""
+        from kubernetes_tpu.obs.incident import TRIGGERS
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_incidents_total"] == "counter"
+        triggers = {lbl["trigger"] for lbl, _v in
+                    series["scheduler_incidents_total"]}
+        assert triggers == set(TRIGGERS)
+        assert set(TRIGGERS) == {"slo_breach", "divergence",
+                                 "fence_storm", "pipeline_stall"}
+        assert all(v == 0.0
+                   for _l, v in series["scheduler_incidents_total"])
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

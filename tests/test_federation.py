@@ -1,0 +1,532 @@
+"""Fleet observatory (ISSUE 19): telemetry federation, cross-shard
+journey stitching, incident forensics.
+
+- the FleetAggregator merges N instances' series/SLO/probe into ONE
+  cluster view — counters sum, log2 histograms merge losslessly, the
+  fleet burns one error budget per SLI;
+- the ISSUE 19 bugfix regression: a warm standby's mirrored series are
+  visible (role="standby") but EXCLUDED from cluster merges and the
+  federated SLO burn — they would double-count the active's stream;
+- the IncidentWatchdog captures bounded evidence bundles on breach and
+  `tools/incident_dump.py` re-verifies the embedded audit chains
+  offline (exit 2 on tamper);
+- /debug/fleet and the /debug/ index serve it all, and the index test
+  keeps DEBUG_ENDPOINTS in lockstep with the do_GET handler chain;
+- the slow tier holds the PR-13-shape overhead gate at 5k nodes.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.backend.apiserver import APIServer
+from kubernetes_tpu.config import KubeSchedulerConfiguration
+from kubernetes_tpu.ha import ShardManager, ShardScheduler, StandbyScheduler
+from kubernetes_tpu.obs.federation import FleetAggregator
+from kubernetes_tpu.obs.incident import TRIGGERS, IncidentWatchdog
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "incident_dump", os.path.join(REPO, "tools", "incident_dump.py"))
+incident_dump = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(incident_dump)
+
+SEED = int(os.environ.get("TEST_SEED", "20260807"))
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _no_sleep(sched):
+    sched.dispatcher.sleep = lambda _s: None
+    return sched
+
+
+def _audited(sched):
+    assert sched.audit is not None, "ShadowOracleAudit gate must be on"
+    sched.audit.sample_rate = 1.0
+    sched.audit.synchronous = True
+    return sched
+
+
+def _nodes(api, n=6, cpu=32, mem="64Gi"):
+    for i in range(n):
+        api.create_node(make_node(f"n{i}")
+                        .capacity({"cpu": cpu, "memory": mem, "pods": 80})
+                        .zone(f"z{i % 3}").obj())
+
+
+def _create(api, n, prefix="p", ns="default"):
+    for i in range(n):
+        api.create_pod(make_pod(f"{prefix}{i}", namespace=ns).req(
+            {"cpu": "250m", "memory": "512Mi"}).obj())
+
+
+def _shard(client, identity, clock):
+    inst = ShardScheduler(client, identity=identity, clock=clock,
+                          batch_size=32)
+    _audited(_no_sleep(inst.scheduler))
+    return inst
+
+
+def _fleet(api, clock, identities=("sched-a", "sched-b")):
+    insts = [_shard(api, ident, clock) for ident in identities]
+    mgr = ShardManager(api, instances=insts, clock=clock)
+    mgr.wire_ledgers()
+    return insts, mgr
+
+
+def _drive(api, insts, clock, want_bound, mgr=None, max_rounds=80):
+    for _ in range(max_rounds):
+        for inst in insts:
+            inst.tick()
+            inst.scheduler.schedule_pending()
+            clock.t += 5.0
+            inst.scheduler.flush_queues()
+        if mgr is not None:
+            mgr.sync_all()
+        bound = sum(1 for p in api.pods.values() if p.spec.node_name)
+        if bound >= want_bound:
+            return
+    raise AssertionError("fleet did not quiesce")
+
+
+# -- federated series ----------------------------------------------------------
+
+
+def test_fleet_exposition_injects_shard_and_role_labels():
+    """The fleet exposition is every member's scrape with shard/role
+    labels injected, HELP/TYPE once per family — scrape-shaped, so the
+    cross-process step only swaps the transport."""
+    api = APIServer()
+    _nodes(api, n=4)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, 4, prefix="pa", ns="ns-a")
+    _drive(api, (a, b), clock, want_bound=4, mgr=mgr)
+
+    text = mgr.fleet.exposition()
+    assert 'shard="sched-a"' in text and 'shard="sched-b"' in text
+    assert 'role="active"' in text
+    # HELP/TYPE once per family even with two members contributing
+    assert text.count("# TYPE scheduler_schedule_attempts_total ") == 1
+    # one concrete re-labeled sample: sched-a committed the 4 binds
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("scheduler_schedule_attempts_total")
+                and 'shard="sched-a"' in ln and 'result="scheduled"' in ln)
+    assert line.endswith(" 4")
+
+
+def test_cluster_series_sums_counters_and_merges_histograms():
+    """Counters sum per label set across active members; histograms
+    merge bucket-wise (identical log2 layout per family), so the
+    cluster-level count equals the sum of per-shard counts."""
+    api = APIServer()
+    _nodes(api, n=4)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, 3, prefix="pa", ns="ns-a")
+    _create(api, 3, prefix="pb", ns="ns-b")
+    _drive(api, (a, b), clock, want_bound=6, mgr=mgr)
+
+    series = mgr.fleet.cluster_series()
+    per_shard = sum(
+        inst.scheduler.metrics.schedule_attempts.value(
+            "scheduled", "default-scheduler")
+        for inst in (a, b))
+    assert per_shard == 6
+    merged = series["counters"]["scheduler_schedule_attempts_total"]
+    key = next(k for k in merged if "scheduled" in k)
+    assert merged[key] == 6.0
+
+    hist = series["histograms"]["scheduler_scheduling_attempt_duration_seconds"]
+    want = sum(sum(inst.scheduler.metrics.attempt_duration._totals.values())
+               for inst in (a, b))
+    assert hist["count"] == want and want >= 2   # ≥1 attempt per shard
+    assert hist["shards"] == 2
+    assert sum(hist["counts"]) == hist["count"]
+
+
+def test_federated_slo_burns_one_budget_across_actives():
+    """Two actives' burn rings merge epoch-wise: the federated engine's
+    totals are the sums, and a breach that only shows at cluster level
+    (each shard under threshold, fleet over) is detected."""
+    clock = Clock()
+    api = APIServer()
+    a = _no_sleep(Scheduler(api, batch_size=8, clock=clock))
+    b = _no_sleep(Scheduler(api, batch_size=8, clock=clock))
+    a.journey.instance, b.journey.instance = "sched-a", "sched-b"
+    fleet = FleetAggregator([a, b])
+
+    # 2% bad on each shard against a 98.0%-target SLI would pass alone
+    # at 2× headroom; together they still merge to exactly the sum
+    a.slo.observe("e2e_latency", good=490, bad=10)
+    b.slo.observe("e2e_latency", good=480, bad=20)
+    eng = fleet.federated_slo()
+    assert eng._totals["e2e_latency"] == [970, 30]
+    snap = eng.snapshot(compact=True)
+    assert snap is not None
+    ring = eng._buckets["e2e_latency"]
+    assert sum(cell[1] for cell in ring) == 970
+    assert sum(cell[2] for cell in ring) == 30
+
+
+def test_standby_mirror_excluded_from_cluster_merge_and_burn():
+    """THE ISSUE 19 bugfix regression: a warm standby mirrors the
+    active's SLI streams (it ingests the same watch echoes), so its
+    series must appear in the federated exposition (role="standby") but
+    NEVER in cluster_series / the federated SLO burn — else every event
+    double-counts and the cluster budget burns twice as fast."""
+    clock = Clock()
+    api = APIServer()
+    active = _audited(_no_sleep(Scheduler(api, batch_size=8, clock=clock)))
+    active.journey.instance = "sched-active"
+    standby = StandbyScheduler(
+        api, identity="sched-standby", clock=clock,
+        scheduler=_audited(_no_sleep(Scheduler(api, batch_size=8,
+                                               clock=clock))))
+    assert standby.scheduler.ha_role == "standby"
+    fleet = FleetAggregator([active, standby])
+
+    active.metrics.api_retries.inc("bind", by=3.0)
+    standby.scheduler.metrics.api_retries.inc("bind", by=3.0)  # the mirror
+    active.slo.observe("e2e_latency", good=90, bad=10)
+    standby.scheduler.slo.observe("e2e_latency", good=90, bad=10)
+
+    # visible in the series view, labeled as the mirror it is
+    text = fleet.exposition()
+    assert 'shard="sched-standby",role="standby"' in text
+    # ...but the cluster merge and the burn see the ACTIVE stream once
+    merged = fleet.cluster_series()["counters"]["scheduler_api_retries_total"]
+    key = next(k for k in merged if "bind" in k)
+    assert merged[key] == 3.0
+    eng = fleet.federated_slo()
+    assert eng._totals["e2e_latency"] == [90, 10]
+    # promotion flips the role: the former standby now contributes
+    standby.scheduler.promote()
+    eng2 = fleet.federated_slo()
+    assert eng2._totals["e2e_latency"] == [180, 20]
+
+
+def test_fleet_probe_is_capacity_weighted():
+    """Per-shard cluster_probe snapshots merge weighted by validNodes:
+    a 3×-bigger slice moves the fleet index 3× as far."""
+    clock = Clock()
+    a = _no_sleep(Scheduler(APIServer(), batch_size=8, clock=clock))
+    b = _no_sleep(Scheduler(APIServer(), batch_size=8, clock=clock))
+    a.journey.instance, b.journey.instance = "sched-a", "sched-b"
+    a._last_probe = {"validNodes": 30,
+                     "resources": {"cpu": {"frag": 0.2}},
+                     "domains": {"spread": 0.1}}
+    b._last_probe = {"validNodes": 10,
+                     "resources": {"cpu": {"frag": 0.6}},
+                     "domains": {"spread": 0.5}}
+    probe = FleetAggregator([a, b]).fleet_probe()
+    assert probe["validNodes"] == 40
+    assert probe["resources"]["cpu"]["frag"] == pytest.approx(0.3)
+    assert probe["domains"]["spread"] == pytest.approx(0.2)
+    assert set(probe["shards"]) == {"sched-a", "sched-b"}
+
+
+# -- incident forensics --------------------------------------------------------
+
+
+def test_watchdog_divergence_capture_verifies_offline(tmp_path):
+    """Injected divergence growth → ONE bundle captured (edge-detected:
+    a second check without growth captures nothing), written to
+    incidentDir, offline-verified by tools/incident_dump.py; a tampered
+    copy exits 2."""
+    api = APIServer()
+    _nodes(api, n=4)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    wd = mgr.attach_watchdog(dirpath=str(tmp_path))
+    assert mgr.watchdog is wd
+    _create(api, 4, prefix="pa", ns="ns-a")
+    _drive(api, (a, b), clock, want_bound=4, mgr=mgr)
+    assert wd.check() == []                   # healthy fleet: no capture
+
+    before = a.scheduler.metrics.incidents.value("divergence")
+    a.scheduler.metrics.oracle_divergence.inc("assignment")
+    captured = wd.check()
+    assert [c["trigger"] for c in captured] == ["divergence"]
+    assert wd.check() == []                   # no growth → no re-capture
+    assert a.scheduler.metrics.incidents.value("divergence") == before + 1
+
+    path = captured[0]["path"]
+    assert os.path.exists(path)
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["schema"] == "tpu-scheduler-incident/v1"
+    assert bundle["signals"]["delta"] == 1.0
+    # real evidence: per-instance flight windows + audit slices with
+    # records from the drains above, and the shard-map history
+    assert any(bundle["flight"].values())
+    assert any((s["dump"].get("records") or [])
+               for s in bundle["audit"].values())
+    assert bundle["shardMap"]["current"]["numShards"] == 2
+    assert bundle["shardMap"]["history"]
+
+    assert incident_dump.main([path]) == 0
+    assert incident_dump.main([path, "--verify-only"]) == 0
+
+    # tamper with one audit record: the offline verifier must exit 2
+    name = next(n for n, s in bundle["audit"].items()
+                if s["dump"].get("records"))
+    bundle["audit"][name]["dump"]["records"][0]["profile"] = "edited"
+    tampered = tmp_path / "tampered.json"
+    tampered.write_text(json.dumps(bundle, default=str))
+    assert incident_dump.main([str(tampered)]) == 2
+    assert incident_dump.main(["/nonexistent/bundle.json"]) == 1
+
+
+def test_watchdog_fence_storm_and_retention(tmp_path):
+    """A fenced-write burst over threshold trips fence_storm; retention
+    keeps only the newest max_bundles files."""
+    api = APIServer()
+    _nodes(api, n=2)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    wd = mgr.attach_watchdog(dirpath=str(tmp_path), max_bundles=2,
+                             fence_storm_threshold=4)
+    a.scheduler.metrics.fenced_writes_rejected.inc(by=4.0)
+    assert [c["trigger"] for c in wd.check()] == ["fence_storm"]
+    for _ in range(3):
+        wd.capture("divergence", {})
+    files = sorted(fn for fn in os.listdir(tmp_path)
+                   if fn.startswith("incident-"))
+    assert len(files) == 2                    # retention pruned the rest
+    assert files[-1].endswith("-divergence.json")
+
+
+def test_incident_triggers_preseeded_in_exposition():
+    """Every watchdog trigger is a pre-seeded series: dashboards can
+    alert on rate() before the first incident ever fires."""
+    sched = Scheduler(APIServer(), batch_size=8)
+    text = sched.metrics.exposition()
+    for trigger in TRIGGERS:
+        assert f'scheduler_incidents_total{{trigger="{trigger}"}} 0' \
+            in text, trigger
+
+
+def test_fleet_observatory_gate_off_degrades(tmp_path):
+    """With FleetObservatory off the manager carries no federation
+    plane (pre-19 behavior); with it on but IncidentForensics off,
+    attach_watchdog is a no-op; incidentDir in the config arms the
+    watchdog at construction when both gates are on."""
+    clock = Clock()
+    api = APIServer()
+
+    def _inst(gates, **cfg_kw):
+        cfg = KubeSchedulerConfiguration(feature_gates=gates, **cfg_kw)
+        inst = ShardScheduler(api, identity="sched-a", clock=clock,
+                              batch_size=8, config=cfg)
+        _no_sleep(inst.scheduler)
+        return inst
+
+    off = ShardManager(api, instances=[
+        _inst({"FleetObservatory": False})], clock=clock)
+    assert off.fleet is None and off.stitcher is None
+    assert off.attach_watchdog(dirpath=str(tmp_path)) is None
+    off.tick_all()                            # no watchdog poll, no crash
+    assert off.debug()["incidents"] is None
+
+    no_forensics = ShardManager(api, instances=[
+        _inst({"IncidentForensics": False})], clock=clock)
+    assert no_forensics.fleet is not None
+    assert no_forensics.attach_watchdog(dirpath=str(tmp_path)) is None
+
+    armed = ShardManager(api, instances=[
+        _inst({}, incident_dir=str(tmp_path))], clock=clock)
+    assert armed.watchdog is not None
+    assert armed.watchdog.dirpath == str(tmp_path)
+
+
+# -- serving -------------------------------------------------------------------
+
+
+def test_debug_fleet_endpoint_and_index():
+    """/debug/fleet serves the federated view (and ?exposition=1 the
+    merged scrape); /debug/ lists every registered endpoint with its
+    availability; without a manager /debug/fleet 404s."""
+    from kubernetes_tpu.server import DEBUG_ENDPOINTS, SchedulerServer
+
+    api = APIServer()
+    _nodes(api, n=2)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0})
+
+    srv = SchedulerServer(a.scheduler, shard_manager=mgr).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/debug/fleet") as r:
+            fleet = json.loads(r.read())
+        assert set(fleet["members"]) == {"sched-a", "sched-b"}
+        assert fleet["members"]["sched-a"]["role"] == "active"
+        assert "slo" in fleet and "probe" in fleet
+        with urllib.request.urlopen(f"{base}/debug/fleet?exposition=1") as r:
+            text = r.read().decode()
+        assert 'shard="sched-b"' in text
+        with urllib.request.urlopen(f"{base}/debug/") as r:
+            index = json.loads(r.read())
+        listed = {e["path"] for e in index["endpoints"]}
+        assert listed == {p for p, _d in DEBUG_ENDPOINTS}
+        by_path = {e["path"]: e for e in index["endpoints"]}
+        assert by_path["/debug/fleet"]["available"] is True
+        assert all(e["description"] for e in index["endpoints"])
+    finally:
+        srv.stop()
+
+    solo = SchedulerServer(a.scheduler).start()   # no manager
+    try:
+        base = f"http://127.0.0.1:{solo.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/fleet")
+        assert err.value.code == 404
+        with urllib.request.urlopen(f"{base}/debug") as r:
+            index = json.loads(r.read())
+        assert {e["path"]: e["available"]
+                for e in index["endpoints"]}["/debug/fleet"] is False
+    finally:
+        solo.stop()
+
+
+def test_debug_index_lockstep_with_handler_chain():
+    """Source-level lint: every `/debug/...` route the do_GET chain
+    matches must be described in DEBUG_ENDPOINTS and vice versa — a new
+    endpoint cannot land invisible to the index."""
+    from kubernetes_tpu.server import DEBUG_ENDPOINTS
+
+    with open(os.path.join(REPO, "kubernetes_tpu", "server.py")) as f:
+        source = f.read()
+    handler = source[source.index("def do_GET"):source.index("def _query")]
+    routed = set(re.findall(r'"(/debug/[a-z]+)"', handler))
+    declared = {p for p, _d in DEBUG_ENDPOINTS}
+    assert routed == declared, (
+        f"do_GET routes {sorted(routed - declared)} missing from "
+        f"DEBUG_ENDPOINTS; {sorted(declared - routed)} declared but "
+        "not routed")
+
+
+def test_stitched_pod_served_from_manager_server():
+    """/debug/pod on a manager-attached server returns the STITCHED
+    cross-shard view (instances list present), not one ledger's slice."""
+    from kubernetes_tpu.server import SchedulerServer
+
+    api = APIServer()
+    _nodes(api, n=4)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, 2, prefix="pa", ns="ns-a")
+    _drive(api, (a, b), clock, want_bound=2, mgr=mgr)
+    uid = next(iter(api.pods))
+
+    srv = SchedulerServer(a.scheduler, shard_manager=mgr).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/pod?uid={uid}") as r:
+            view = json.loads(r.read())
+    finally:
+        srv.stop()
+    # stitched shape: fragments from BOTH instances (owner scheduled it,
+    # the peer parked it), with the renderer legend attached
+    assert set(view["instances"]) == {"sched-a", "sched-b"}
+    assert view["notes"] and view["transitions"]
+    assert all("instance" in tr for tr in view["transitions"])
+
+
+def test_fleet_chrome_trace_has_per_shard_tracks():
+    api = APIServer()
+    _nodes(api, n=4)
+    clock = Clock()
+    (a, b), mgr = _fleet(api, clock)
+    mgr.split(2, owners={0: a, 1: b},
+              assignments={"default-scheduler/ns-a": 0,
+                           "default-scheduler/ns-b": 1})
+    _create(api, 2, prefix="pb", ns="ns-b")
+    _drive(api, (a, b), clock, want_bound=2, mgr=mgr)
+    trace = mgr.stitcher.chrome_trace()
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert {"shard:sched-a", "shard:sched-b"} <= names
+
+
+# -- overhead gate (slow tier) -------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFleetObservatoryOverheadGate:
+    def test_overhead_within_5_percent_at_5k_nodes(self):
+        """ISSUE 19 acceptance: SchedulingBasic-shaped 5k-node drains
+        with FleetObservatory+IncidentForensics (plus the journey rails
+        they ride on) ON stay within 5% of gates-OFF throughput (median
+        of 3 measured passes each — the PR 13 gate shape)."""
+
+        def _feed(api, n, start=0):
+            api.create_pods([make_pod(f"p{start + i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj()
+                for i in range(n)])
+
+        def one_pass(gate_on):
+            cfg = KubeSchedulerConfiguration(feature_gates={
+                "PodJourneyTracing": gate_on,
+                "FleetObservatory": gate_on,
+                "IncidentForensics": gate_on})
+            api = APIServer()
+            sched = Scheduler(api, batch_size=8192, config=cfg)
+            fleet = FleetAggregator([sched])
+            from kubernetes_tpu.obs.stitch import JourneyStitcher
+            wd = (IncidentWatchdog(fleet, JourneyStitcher([sched]),
+                                   metrics=sched.metrics)
+                  if gate_on else None)
+            for i in range(5000):
+                api.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": 32, "memory": "64Gi", "pods": 110}).obj())
+            sched.prime()
+            t0 = time.perf_counter()
+            created = 0
+            while created < 10000:
+                _feed(api, 512, start=created)
+                created += 512
+                sched.schedule_pending(wait=False)
+                if wd is not None:
+                    wd.check()                # the watchdog rides along
+            sched.schedule_pending()
+            dt = time.perf_counter() - t0
+            assert sched.scheduled_count == created
+            return created / dt
+
+        one_pass(True)    # warm every executable outside the measurement
+        off = sorted(one_pass(False) for _ in range(3))[1]
+        on = sorted(one_pass(True) for _ in range(3))[1]
+        assert on >= 0.95 * off, (
+            f"fleet-observatory overhead gate: on={on:.0f} off={off:.0f} "
+            f"pods/s ({on / off - 1:+.1%})")

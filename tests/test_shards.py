@@ -280,6 +280,7 @@ def test_steal_mid_drain_zombie_cannot_double_bind():
               assignments={"default-scheduler/ns-a": 0,
                            "default-scheduler/ns-b": 1})
 
+    t_enqueue = clock.t                      # the e2e clock's true origin
     _create(api, _specs(8, seed=SEED, prefix="pb", ns="ns-b"))
     b.tick()
     real_flush = b.scheduler.dispatcher.flush
@@ -314,6 +315,28 @@ def test_steal_mid_drain_zombie_cannot_double_bind():
     m = a.scheduler.metrics
     assert m.shard_steals.value("steal") == 1
     assert m.shard_rebalance.count() >= 1
+
+    # r19 stitched journeys: every stolen pod merges to exactly ONE
+    # causal cross-shard timeline — fragments from both instances, zero
+    # orphans, steal + bind_confirm present, timestamps monotone
+    uids = [p.uid for p in api.pods.values()]
+    cov = mgr.stitcher.coverage(uids)
+    assert cov == {"pods": 8, "stitched": 8, "fragments": 16,
+                   "orphaned": 0}
+    for uid in uids:
+        view = mgr.stitcher.pod(uid)
+        assert set(view["instances"]) == {"sched-a", "sched-b"}
+        events = [tr["event"] for tr in view["transitions"]]
+        assert "steal" in events and "adopt" in events
+        assert "bind_confirm" in events
+        times = [tr["t"] for tr in view["transitions"]]
+        assert times == sorted(times)
+        # the e2e SLI clock SURVIVED the steal: the stitched origin is
+        # the victim's original enqueue, not the thief's adoption
+        assert view["firstEnqueue"] == t_enqueue
+        # the zombie's drain fragment and the thief's carry DIFFERENT
+        # fencing epochs — the stamp attributes each write to its reign
+        assert len(view["fences"]) >= 2
 
 
 def test_merge_collapses_ownership_with_annexed_chains():

@@ -11,7 +11,10 @@ error (a declared JIT entry point no longer reaches a jitted function —
 the lint silently lost device-path coverage — or is missing from the
 kernel observatory's ENTRY_KERNELS map, so its dispatches would go
 unmeasured, or the streaming pipeline grew a dispatch path that
-bypasses the measured_call/observatory seams — `pipeline_stages`).
+bypasses the measured_call/observatory seams — `pipeline_stages` — or a
+telemetry surface lost coverage: a registered metric family without a
+pre-seeded sample / bench-archive TYPE line, or a journey event/cause
+the /debug/pod renderer cannot annotate — `obs_coverage`).
 
 The same analysis runs in tier-1 via tests/test_jaxsan.py, so CI fails
 on any unwaived finding; this CLI is the local/fix-up loop. Waiver
@@ -145,6 +148,74 @@ def pipeline_stage_gaps(path: str = None, source: str = None) -> list:
     return gaps
 
 
+def obs_coverage(prom_path: str = None) -> list:
+    """ISSUE 19 `obs_coverage` check: the fleet-observatory surfaces must
+    stay complete. (a) Every registered metric family is pre-seeded — a
+    fresh SchedulerMetrics exposition yields at least one sample per
+    family (histograms via their `_count` series; the callback gauge
+    `scheduler_pending_pods` resolves only against a live scheduler and
+    is exempt, mirroring the tier-1 exposition lint) — AND appears as a
+    `# TYPE` family in bench_metrics.prom, so dashboards built on the
+    bench archive never miss a series. (b) Every journey transition in
+    obs/journey.py EVENTS and every requeue cause in CAUSES has a legend
+    note in the /debug/pod stitched renderer (obs/stitch.py EVENT_NOTES
+    / CAUSE_NOTES) — a new lifecycle event cannot land unrendered — and
+    no stale note survives a removed code. Returns gap strings; empty =
+    covered."""
+    from kubernetes_tpu.metrics import SchedulerMetrics
+    from kubernetes_tpu.obs.journey import CAUSES, EVENTS
+    from kubernetes_tpu.obs.stitch import CAUSE_NOTES, EVENT_NOTES
+
+    gaps: list[str] = []
+    m = SchedulerMetrics()
+    sampled = set()
+    for line in m.exposition().splitlines():
+        if line and not line.startswith("#"):
+            sampled.add(line.partition("{")[0].partition(" ")[0])
+    families = sorted(m.registry._metrics)
+    for fam in families:
+        if fam == "scheduler_pending_pods":
+            continue               # callback gauge: no callback wired here
+        if fam not in sampled and f"{fam}_count" not in sampled:
+            gaps.append(f"{fam} (no pre-seeded sample in a fresh "
+                        "exposition)")
+
+    prom = prom_path or os.path.join(_REPO, "bench_metrics.prom")
+    try:
+        with open(prom, encoding="utf-8") as f:
+            typed = {parts[2] for parts in
+                     (ln.split() for ln in f if ln.startswith("# TYPE "))
+                     if len(parts) >= 3}
+    except OSError:
+        typed = None
+    if typed is None:
+        gaps.append(f"{os.path.basename(prom)} unreadable (bench archive "
+                    "missing — dashboards have no seed scrape)")
+    else:
+        for fam in families:
+            if fam not in typed:
+                gaps.append(f"{fam} (no TYPE family in "
+                            f"{os.path.basename(prom)})")
+
+    for ev in EVENTS:
+        if ev not in EVENT_NOTES:
+            gaps.append(f"journey event {ev!r} (no /debug/pod renderer "
+                        "note in obs/stitch.py EVENT_NOTES)")
+    for ev in EVENT_NOTES:
+        if ev not in EVENTS:
+            gaps.append(f"EVENT_NOTES entry {ev!r} (stale: not a journey "
+                        "event)")
+    for cause in CAUSES:
+        if cause not in CAUSE_NOTES:
+            gaps.append(f"requeue cause {cause!r} (no /debug/pod renderer "
+                        "note in obs/stitch.py CAUSE_NOTES)")
+    for cause in CAUSE_NOTES:
+        if cause not in CAUSES:
+            gaps.append(f"CAUSE_NOTES entry {cause!r} (stale: not a "
+                        "requeue cause)")
+    return gaps
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=_REPO)
@@ -175,6 +246,7 @@ def main(argv=None) -> int:
     # whose functions have no business in ENTRY_KERNELS
     obs_gaps = [] if entry_points is not None else observatory_gaps()
     pipe_gaps = [] if entry_points is not None else pipeline_stage_gaps()
+    cov_gaps = [] if entry_points is not None else obs_coverage()
 
     if args.as_json:
         print(json.dumps({
@@ -183,6 +255,7 @@ def main(argv=None) -> int:
             "missingEntries": an.missing_entries,
             "observatoryGaps": obs_gaps,
             "pipelineStageGaps": pipe_gaps,
+            "obsCoverageGaps": cov_gaps,
             "modules": len(an.modules),
             "tracedFunctions": sum(1 for fi in an.fns.values()
                                    if fi.traced),
@@ -211,6 +284,12 @@ def main(argv=None) -> int:
         print("jaxsan: CONFIG ERROR — pipeline_stages: a dispatch path "
               "bypasses measured_call/observatory attribution: "
               + "; ".join(pipe_gaps), file=sys.stderr)
+        return 2
+    if cov_gaps:
+        print("jaxsan: CONFIG ERROR — obs_coverage: a telemetry surface "
+              "lost coverage (unseeded metric family, bench-archive "
+              "family missing, or journey code without a renderer note): "
+              + "; ".join(cov_gaps), file=sys.stderr)
         return 2
     return 1 if live else 0
 

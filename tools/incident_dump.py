@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Render + verify an incident evidence bundle offline.
+
+    python tools/incident_dump.py <bundle.json>           # render summary
+    python tools/incident_dump.py <bundle.json> --json    # machine-readable
+    python tools/incident_dump.py <bundle.json> --verify-only
+
+A bundle is captured by the IncidentWatchdog (kubernetes_tpu/obs/
+incident.py). This tool needs NOTHING from the live cluster: the audit
+chain segments embedded in the bundle re-verify from their serialized
+fields alone — each record's hash is sha256(prev_hash + canonical
+chain bytes), each handoff-annex entry folds (shard|head|seq) from the
+genesis hash — so a tampered bundle (or a ledger edited before capture)
+is detectable months later from the JSON file.
+
+Exit codes: 0 = chains verify; 1 = usage / unreadable bundle;
+2 = a hash chain is broken (record chain, linkage, or handoff annex).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+
+GENESIS = "0" * 64
+
+
+def _sha(*parts) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p if isinstance(p, bytes) else str(p).encode())
+    return h.hexdigest()
+
+
+def _chain_bytes(rec: dict) -> bytes:
+    # must mirror obs/audit.py AuditRecord.chain_bytes exactly
+    return json.dumps({"drain": rec["drainId"],
+                       "profile": rec["profile"],
+                       "fingerprints": rec["fingerprints"]},
+                      sort_keys=True).encode()
+
+
+def verify_record_chain(dump: dict) -> list[str]:
+    """Re-verify one instance's audit slice: linkage record-to-record
+    from the slice anchor, each hash recomputed, final hash == head
+    (dump() slices from the tail, so the head IS the last record's
+    hash). Returns human-readable problems; empty = verified."""
+    problems: list[str] = []
+    records = dump.get("records") or []
+    head = dump.get("head", GENESIS)
+    if not records:
+        return problems
+    prev = records[0].get("prevHash", GENESIS)
+    for i, rec in enumerate(records):
+        if rec.get("prevHash") != prev:
+            problems.append(
+                f"record {i} (drain {rec.get('drainId')}): prevHash "
+                f"{rec.get('prevHash')!r:.20} does not link to "
+                f"predecessor hash {prev!r:.20}")
+            prev = rec.get("prevHash", prev)
+        want = _sha(prev, _chain_bytes(rec))
+        if rec.get("hash") != want:
+            problems.append(
+                f"record {i} (drain {rec.get('drainId')}): stored hash "
+                f"does not match recomputed chain hash (content edited)")
+        prev = rec.get("hash", want)
+    if prev != head:
+        problems.append(
+            f"chain tail {prev!r:.20} != ledger head {head!r:.20} "
+            "(slice spliced or head rewritten)")
+    return problems
+
+
+def verify_handoffs(entries: list, head: str) -> list[str]:
+    """Re-fold the handoff annex chain from GENESIS (obs/audit.py
+    record_handoff): each entry hashes (shard|predecessor head|seq)
+    onto the previous annex hash."""
+    problems: list[str] = []
+    prev = GENESIS
+    for i, e in enumerate(entries or []):
+        if e.get("prev") != prev:
+            problems.append(f"handoff {i} (shard {e.get('shard')}): "
+                            "prev does not link to predecessor")
+            prev = e.get("prev", prev)
+        want = _sha(prev, f"{e['shard']}|{e['head']}|{e['seq']}"
+                    .encode("utf-8"))
+        if e.get("hash") != want:
+            problems.append(f"handoff {i} (shard {e.get('shard')}): "
+                            "stored hash does not match recomputation")
+        prev = e.get("hash", want)
+    if (entries or head != GENESIS) and prev != head:
+        problems.append("handoff annex tail does not match handoffHead")
+    return problems
+
+
+def verify_bundle(bundle: dict) -> dict:
+    """instance → list of problems across record chain + handoff annex."""
+    out: dict = {}
+    for name, slice_ in (bundle.get("audit") or {}).items():
+        problems = verify_record_chain(slice_.get("dump") or {})
+        problems += verify_handoffs(slice_.get("handoffs"),
+                                    slice_.get("handoffHead", GENESIS))
+        if slice_.get("dump", {}).get("chainValid") is False:
+            problems.append("capture-time verify() already failed "
+                            "(chainValid=false in the live ledger)")
+        out[name] = problems
+    return out
+
+
+def render(bundle: dict, verdicts: dict) -> str:
+    lines = [
+        f"incident bundle: trigger={bundle.get('trigger')} "
+        f"seq={bundle.get('sequence')} "
+        f"capturedAt={bundle.get('capturedAt')}",
+        f"signals: {json.dumps(bundle.get('signals') or {}, sort_keys=True)}",
+    ]
+    slo = bundle.get("slo") or {}
+    breaches = slo.get("breaches") or []
+    lines.append(f"federated SLO: {len(breaches)} breach(es)"
+                 + ("".join(f"\n  - {b['sli']}/{b['window']} "
+                            f"burn={b['burn']} (max {b['threshold']})"
+                            for b in breaches)))
+    journeys = bundle.get("journeys") or {}
+    lines.append(f"stitched journeys: {len(journeys)} pod(s)")
+    for uid, j in sorted(journeys.items()):
+        lines.append(
+            f"  {uid}: {len(j.get('transitions') or [])} transitions "
+            f"across {len(j.get('instances') or [])} instance(s), "
+            f"fences={j.get('fences')}")
+    for name, flight in sorted((bundle.get("flight") or {}).items()):
+        lines.append(f"flight[{name}]: {len(flight)} drain record(s)")
+    shard_map = bundle.get("shardMap") or {}
+    if shard_map:
+        cur = shard_map.get("current") or {}
+        lines.append(f"shard map: v{cur.get('version')} "
+                     f"({cur.get('numShards')} shards), "
+                     f"{len(shard_map.get('history') or [])} "
+                     "historical version(s)")
+    for name, problems in sorted(verdicts.items()):
+        if problems:
+            lines.append(f"audit[{name}]: CHAIN BROKEN")
+            lines.extend(f"  ! {p}" for p in problems)
+        else:
+            n = len((bundle["audit"][name].get("dump") or {})
+                    .get("records") or [])
+            nh = len(bundle["audit"][name].get("handoffs") or [])
+            lines.append(f"audit[{name}]: chain verified "
+                         f"({n} records, {nh} handoffs)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="incident bundle JSON path")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--verify-only", action="store_true",
+                    help="no rendering; just the chain verdicts")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.bundle, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"incident_dump: cannot read bundle: {e}", file=sys.stderr)
+        return 1
+
+    verdicts = verify_bundle(bundle)
+    broken = {n: p for n, p in verdicts.items() if p}
+    if args.as_json:
+        print(json.dumps({"trigger": bundle.get("trigger"),
+                          "sequence": bundle.get("sequence"),
+                          "verdicts": verdicts,
+                          "chainsValid": not broken}, indent=2))
+    elif args.verify_only:
+        for name, problems in sorted(verdicts.items()):
+            status = "BROKEN" if problems else "ok"
+            print(f"{name}: {status}")
+            for p in problems:
+                print(f"  ! {p}")
+    else:
+        print(render(bundle, verdicts))
+    return 2 if broken else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
