@@ -148,6 +148,7 @@ def run():
     m = sched.metrics
     if sched.audit is not None:
         sched.audit.flush()
+    from kubernetes_tpu.perf.critical_path import aggregate as cp_agg
     return {
         "pods_per_s": round(PODS / dt, 1), "seconds": round(dt, 3),
         "p50": round(perc(0.50)), "p99": round(perc(0.99)),
@@ -160,6 +161,10 @@ def run():
         # seconds, imbalance ratio and comms share — bench_compare's
         # sharded-lane regression gate reads this off the median pass
         "lanes": sched.profile_shard_lanes() or {},
+        # per-drain bottleneck verdicts folded over this pass's flight
+        # ring (ISSUE 20): the sharded tier's headroom scoreboard
+        "critical_path": cp_agg(d.get("criticalPath")
+                                for d in sched.flight.dump()),
     }
 
 run()           # warm pass: compiles the node-axis-sharded program
@@ -218,6 +223,7 @@ def run():
     m = sched.metrics
     assert sched.scheduled_count == WARM + PODS, sched.scheduled_count
     assert not st["errors"], st["errors"]
+    from kubernetes_tpu.perf.critical_path import aggregate as cp_agg
     return {
         "pods_per_s": round(PODS / dt, 1), "seconds": round(dt, 3),
         "offered_qps": QPS,
@@ -226,6 +232,8 @@ def run():
         "e2e_p99_ms": round(
             m.sli_duration.quantile(0.99, since=chk) * 1e3, 3),
         "pipeline": st,
+        "critical_path": cp_agg(d.get("criticalPath")
+                                for d in sched.flight.dump()),
     }
 
 passes = [run() for _ in range(RUNS)]
@@ -383,11 +391,27 @@ def _env_fingerprint() -> dict:
             versions[mod] = __import__(mod).__version__
         except Exception:
             versions[mod] = ""
+    # accelerator identity (ISSUE 20 satellite): resolved backend +
+    # device kind/count, not just the requested JAX_PLATFORMS — numbers
+    # from a different accelerator are not an A/B even when the env var
+    # matches, and bench_compare's mismatch downgrade keys on this too
+    accel = {"backend": "", "device_kind": "", "device_count": 0}
+    try:
+        import jax
+        devs = jax.devices()
+        accel = {
+            "backend": jax.default_backend(),
+            "device_kind": devs[0].device_kind if devs else "",
+            "device_count": len(devs),
+        }
+    except Exception:
+        pass
     return {
         "cpu_model": cpu_model,
         "cpu_count": os.cpu_count() or 0,
         "versions": versions,
         "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "accelerator": accel,
     }
 
 
@@ -715,14 +739,26 @@ def main() -> None:
     # neither the sentinel nor a human parses `extra` ad hoc — fixing the
     # headline blindness where phases outside the headline metric (and
     # every non-headline workload) had no first-class number
+    from kubernetes_tpu.perf.critical_path import phase_shares
     summary = {}
     for key, entry in results.items():
         if "error" in entry or entry.get("unit") in ("s", "ms"):
             continue    # HAFailover reports time, not throughput
-        hb = float(entry.get("host_build_s", 0.0))
-        dv = float(entry.get("device_s", 0.0))
-        cm = float(entry.get("commit_s", 0.0))
-        total = hb + dv + cm
+        # ONE share implementation (ISSUE 20 bugfix): the same
+        # perf/critical_path.phase_shares the pipeline occupancy block
+        # uses — bench and pipeline can no longer drift apart on what
+        # "host share" means over the same FlightRecorder window
+        shares = phase_shares({
+            "host_build": float(entry.get("host_build_s", 0.0)),
+            "device": float(entry.get("device_s", 0.0)),
+            "commit": float(entry.get("commit_s", 0.0)),
+        })
+        # critical-path headroom (ISSUE 20): verdict histogram + the
+        # projected ceiling if the window's dominant cause were free
+        cp = dict(entry.get("critical_path", {}))
+        if cp.get("ceiling_factor"):
+            cp["ceiling_pods_per_s"] = round(
+                float(entry["value"]) * float(cp["ceiling_factor"]), 1)
         summary[key] = {
             "pods_per_s": entry["value"],
             "p50": entry.get("p50", 0), "p99": entry.get("p99", 0),
@@ -739,11 +775,10 @@ def main() -> None:
             # ingest engine's regression contract — tools/bench_compare.py
             # gates a >10% relative regression of it per workload.
             "phase_pct": {
-                "host_build": round(100.0 * hb / total, 1) if total else 0.0,
-                "device": round(100.0 * dv / total, 1) if total else 0.0,
-                "commit": round(100.0 * cm / total, 1) if total else 0.0,
+                phase: round(100.0 * frac, 1)
+                for phase, frac in shares["shares"].items()
             },
-            "host_share": round((hb + cm) / total, 4) if total else 0.0,
+            "host_share": shares["host_share"],
             # SLO engine verdict at bench end (obs/slo.py): burn-rate
             # breaches + audit divergence count — what bench_compare's
             # --slo gate reads (fail on breach or nonzero divergence)
@@ -765,6 +800,11 @@ def main() -> None:
             # busy seconds, overlap factor (busySum/wall), backpressure
             # and batch-close counts ({} for non-streaming cases)
             "pipeline": entry.get("pipeline", {}),
+            # critical-path headroom block (ISSUE 20): per-drain verdict
+            # histogram, per-cause seconds, the window's dominant cause
+            # and the projected pods/s ceiling — what bench_compare's
+            # --attribute mode diffs to EXPLAIN a throughput delta
+            "critical_path": cp,
         }
 
     head_key = next(iter(results))

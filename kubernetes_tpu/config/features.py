@@ -143,6 +143,14 @@ DEFAULT_FEATURES: dict[str, FeatureSpec] = {
     # captures bounded evidence bundles to incidentDir, offline
     # verifiable by tools/incident_dump.py.
     "IncidentForensics": FeatureSpec(True, ALPHA),
+    # critical-path observatory (perf/critical_path.py + costmodel.py):
+    # per-drain bottleneck verdicts over {host_build, device_compute,
+    # device_comms, commit, backpressure, idle} stamped on the flight
+    # record and aggregated as scheduler_critical_path_seconds /
+    # scheduler_bottleneck_drains_total; the device cost model
+    # (cost_analysis flops/bytes, achieved-vs-modeled fraction per
+    # kernel variant); /debug/criticalpath and the bench headroom block.
+    "CriticalPathObservatory": FeatureSpec(True, BETA),
 }
 
 

@@ -229,6 +229,11 @@ class FlightRecord:
     # shard ids the committing instance owned at commit time (sharded
     # control plane, ha/shards.py); () = unsharded operation
     shard: tuple = ()
+    # critical-path verdict for this drain (perf/critical_path.py,
+    # `CriticalPathObservatory` gate): {"verdict": cause, "causes":
+    # {cause: seconds}, "chain": [...]}; {} = gate off or host-path
+    # commit predating the stamp
+    critical_path: dict = field(default_factory=dict)
 
     def total_seconds(self) -> float:
         return float(sum(self.phases.values()))
@@ -249,7 +254,8 @@ class FlightRecord:
                 "probe": dict(self.probe),
                 "kernels": {k: round(v, 6)
                             for k, v in self.kernels.items()},
-                "shard": list(self.shard)}
+                "shard": list(self.shard),
+                "criticalPath": dict(self.critical_path)}
 
 
 class FlightRecorder:

@@ -634,6 +634,25 @@ class SchedulerMetrics:
             "valid node per domain) over the gang engine's Tesserae "
             "dom-id column.",
             ("stat",)))
+        # critical-path observatory (kubernetes_tpu/perf/critical_path.py,
+        # `CriticalPathObservatory` gate, ISSUE 20): per-drain bottleneck
+        # attribution stamped on the flight record and summed here
+        self.critical_path_seconds = r.register(Counter(
+            n + "critical_path_seconds",
+            "Seconds attributed to each critical-path cause across "
+            "committed drains: host_build (snapshot/tensorize/group-seed/"
+            "cache), device_compute / device_comms (device dispatch wall "
+            "split by the sharded-lane comms share), commit (assume/bind "
+            "+ bind-echo flush), backpressure (streaming-pipeline stage "
+            "stalls), idle (lock-step readback wait — the overlap the "
+            "pipeline reclaims).",
+            ("cause",)))
+        self.bottleneck_drains = r.register(Counter(
+            n + "bottleneck_drains_total",
+            "Committed drains by dominant critical-path verdict (argmax "
+            "of the per-cause seconds above; all-zero drains count as "
+            "idle).",
+            ("cause",)))
         # pre-seed the zero samples so dashboards (and bench_metrics.prom)
         # always carry the fault-path series, faults or not
         from ..backend.dispatcher import CallType
@@ -713,6 +732,10 @@ class SchedulerMetrics:
         for stage in PIPELINE_STAGES:
             self.pipeline_stage_busy.inc(stage, by=0)
             self.pipeline_backpressure.inc(stage, by=0)
+        from ..perf.critical_path import CAUSES as CP_CAUSES
+        for cause in CP_CAUSES:
+            self.critical_path_seconds.inc(cause, by=0)
+            self.bottleneck_drains.inc(cause, by=0)
         for kind in ("assignment", "reason", "verdict"):
             self.oracle_divergence.inc(kind, by=0)
         for outcome in ("clean", "divergent", "skipped", "error"):

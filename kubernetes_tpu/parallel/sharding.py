@@ -248,6 +248,11 @@ def _lane_carry(host_carry: Carry, sl: slice) -> Carry:
                  ports=host_carry.ports[sl], cache=cache_l, groups=None)
 
 
+# lane-imbalance verdict threshold (ISSUE 20): peak/mean lane time
+# beyond this means a straggler lane binds the collective barrier
+IMBALANCE_BOUND_RATIO = 1.5
+
+
 def profile_shard_lanes(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
                         carry: Carry, pods: PodXs, table: PodTableDev,
                         groups: GroupsDev | None = None, fam=None) -> dict:
@@ -316,6 +321,21 @@ def profile_shard_lanes(cfg: ScoreConfig, mesh: Mesh, na: NodeArrays,
     prof["imbalanceRatio"] = round(peak / mean, 4) if mean > 0 else 0.0
     prof["commsShare"] = (round(max(0.0, 1.0 - peak / total), 4)
                           if total > 0 else 0.0)
+    # lane verdict (ISSUE 20): classify what binds the sharded dispatch,
+    # with the same comms threshold the device cost model uses — so the
+    # lane profile, the kernel cost rows and the per-drain critical-path
+    # chain all call the same dispatch "comms_bound" at the same share
+    from ..perf.costmodel import COMMS_BOUND_SHARE
+    prof["laneShares"] = ([round(s / total, 4) for s in lanes]
+                          if total > 0 else [0.0] * len(lanes))
+    if prof["commsShare"] > COMMS_BOUND_SHARE:
+        prof["verdict"] = "comms_bound"
+    elif prof["imbalanceRatio"] > IMBALANCE_BOUND_RATIO:
+        # one straggler lane holds the collective barrier: the fix is
+        # rebalancing the node slices, not shrinking the collectives
+        prof["verdict"] = "imbalance_bound"
+    else:
+        prof["verdict"] = "compute_bound"
     return prof
 
 

@@ -1,0 +1,225 @@
+"""Device cost model: flops/bytes per compiled kernel variant (ISSUE 20).
+
+The kernel observatory records how long every JIT entry RUNS per
+plan/shape variant, but not what the variant COSTS — so a kernel row
+could not say whether it is compute-bound or memory-bound, and ROADMAP
+item 5's autotuner has no roofline to search against. This module is
+that cost table:
+
+- when `CompileLedger.measured_call` detects a fresh compile it reports
+  the (kernel, jitted fn, args) here ONCE per plan/shape key. The model
+  asks XLA for the variant's cost via `Lowered.cost_analysis()` —
+  tracing + lowering only, never a second XLA compile (measured ~4ms
+  for a small program on this container's jax 0.4.37, paid only on
+  compile events) — and falls back to a per-kernel HOST ESTIMATOR
+  (`KERNEL_COSTS` coefficients over the args' array cells/bytes) where
+  XLA reports nothing. Every jaxsan ENTRY_POINT's kernel MUST have a
+  `KERNEL_COSTS` entry: tools/check.py `cost_model_gaps` (exit 2)
+  mirrors `observatory_gaps`, so a new JIT entry cannot land uncosted.
+- per (flops, bytes) row the model derives arithmetic intensity and —
+  against the backend's roofline anchors (`PEAKS`) — a modeled runtime
+  `max(flops/peak_flops, bytes/peak_bw)`, the achieved-vs-modeled
+  fraction once the observatory has a measured warm p50 for the same
+  plan key, and a boundness verdict: compute-bound vs memory-bound by
+  intensity against the ridge point, comms-bound when the sharded-lane
+  profile attributes the majority of the kernel's window to
+  collectives.
+
+Rows are bounded by the observatory's own MAX_PLAN_KEYS discipline and
+surface at /debug/kernels, in tools/kernel_sweep.py sweep points, and as
+the per-backend cost table the critical-path verdicts read.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Roofline anchors per JAX backend: (peak flops/s, peak bytes/s). These
+# are deliberately coarse single-socket/single-device numbers — the
+# achieved fraction is a RATIO used to rank variants and spot order-of-
+# magnitude gaps, not a vendor benchmark. Overridable per-process via
+# `set_peaks` (the accelerator tier of ROADMAP item 5 calibrates them).
+PEAKS = {
+    "cpu": (1.0e11, 2.0e10),     # ~100 GFLOP/s, ~20 GB/s per socket
+    "gpu": (1.0e13, 1.0e12),     # ~10 TFLOP/s, ~1 TB/s HBM
+    "tpu": (1.0e14, 1.2e12),     # ~100 TFLOP/s bf16, ~1.2 TB/s HBM
+}
+_DEFAULT_PEAKS = (1.0e11, 2.0e10)
+
+# Host-estimator coefficients per ledger kernel: (flops per array cell,
+# bytes-accessed multiplier over the args' raw bytes). The flops
+# coefficients encode each kernel's work shape — the scoring/filter
+# kernels do a few tens of ops per node-pod cell, the scan/wave kernels
+# revisit the carry per segment, the probe/diagnose reductions are
+# single-pass. Used ONLY where XLA's cost_analysis reports nothing;
+# rows carry source="host" so readers know the provenance.
+# tools/check.py cost_model_gaps asserts every ENTRY_KERNELS target has
+# an entry here.
+KERNEL_COSTS = {
+    "run_batch": (48.0, 3.0),
+    "run_uniform": (32.0, 3.0),
+    "run_wave": (64.0, 4.0),
+    "run_wave_scan": (96.0, 5.0),
+    "run_plan": (48.0, 3.0),
+    "wave_statics": (8.0, 2.0),
+    "diagnose": (16.0, 2.0),
+    "dry_run": (40.0, 3.0),
+    "run_gang": (64.0, 4.0),
+    "scatter_rows": (2.0, 2.0),
+    "explain_row": (16.0, 2.0),
+    "cluster_probe": (24.0, 2.0),
+    "run_batch_sharded": (48.0, 4.0),
+    "run_uniform_sharded": (32.0, 4.0),
+    "run_plan_sharded": (48.0, 4.0),
+    "run_gang_sharded": (64.0, 5.0),
+    "scatter_rows_sharded": (2.0, 3.0),
+    "cluster_probe_sharded": (24.0, 3.0),
+}
+
+BOUND_COMPUTE = "compute_bound"
+BOUND_MEMORY = "memory_bound"
+BOUND_COMMS = "comms_bound"
+
+# a sharded kernel whose lane profile attributes more than this share of
+# the device window to collectives is comms-bound regardless of its
+# arithmetic intensity — the roofline it sits under is the interconnect
+COMMS_BOUND_SHARE = 0.35
+
+
+def set_peaks(backend: str, peak_flops: float, peak_bw: float) -> None:
+    """Calibration hook (ROADMAP item 5 accelerator tier)."""
+    PEAKS[backend] = (float(peak_flops), float(peak_bw))
+
+
+def peaks(backend: str):
+    return PEAKS.get(backend, _DEFAULT_PEAKS)
+
+
+def host_estimate(kernel: str, args: tuple) -> tuple:
+    """(flops, bytes) from the dispatch args alone — the fallback when
+    XLA reports nothing. Cells = total array elements across args;
+    bytes = the args' raw bytes times the kernel's revisit multiplier."""
+    coeff = KERNEL_COSTS.get(kernel)
+    if coeff is None:
+        return (0.0, 0.0)
+    flops_per_cell, byte_mult = coeff
+    cells = 0
+    nbytes = 0
+    for a in args:
+        sh = getattr(a, "shape", None)
+        if sh is not None:
+            n = 1
+            for d in sh:
+                n *= int(d)
+            cells += n
+            nbytes += int(getattr(a, "nbytes", 0) or 0)
+            continue
+        if hasattr(a, "_fields"):
+            for f in a:
+                fsh = getattr(f, "shape", None)
+                if fsh is None:
+                    continue
+                n = 1
+                for d in fsh:
+                    n *= int(d)
+                cells += n
+                nbytes += int(getattr(f, "nbytes", 0) or 0)
+    return (float(cells) * flops_per_cell, float(nbytes) * byte_mult)
+
+
+def xla_cost(fn, args: tuple, kw: dict) -> tuple:
+    """(flops, bytes) from XLA's HLO cost analysis of the jitted fn's
+    LOWERING (no second compile), or (0, 0) when the backend/API
+    reports nothing — the caller falls back to the host estimator."""
+    try:
+        ca = fn.lower(*args, **kw).cost_analysis()
+    except Exception:
+        return (0.0, 0.0)
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return (0.0, 0.0)
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops < 0.0:
+        flops = 0.0
+    if nbytes < 0.0:
+        nbytes = 0.0
+    return (flops, nbytes)
+
+
+def modeled_seconds(flops: float, nbytes: float, backend: str) -> float:
+    """Roofline runtime: whichever of the compute and memory walls is
+    binding for the variant on this backend."""
+    pf, pb = peaks(backend)
+    return max(flops / pf if pf > 0 else 0.0,
+               nbytes / pb if pb > 0 else 0.0)
+
+
+def classify(flops: float, nbytes: float, backend: str,
+             comms_share: float = 0.0) -> str:
+    """compute/memory/comms-bound for one (flops, bytes) row: comms wins
+    when the lane profile says collectives own the window; otherwise
+    arithmetic intensity against the backend's ridge point."""
+    if comms_share > COMMS_BOUND_SHARE:
+        return BOUND_COMMS
+    pf, pb = peaks(backend)
+    ridge = pf / pb if pb > 0 else 0.0
+    ai = flops / nbytes if nbytes > 0 else float("inf")
+    return BOUND_COMPUTE if ai >= ridge else BOUND_MEMORY
+
+
+class CostModel:
+    """Per-(kernel, plan-key) cost rows, filled once per fresh compile.
+
+    Owned by the KernelObservatory (one instance behind its GLOBAL);
+    thread-safe the same way — compiles land from the host loop, the
+    standby scheduler and the audit worker."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (kernel, plan_key) -> {"flops","bytes","source"}
+        self.rows: dict = {}
+
+    def record_compile(self, kernel: str, fn, args: tuple,
+                       kw: dict) -> None:
+        """One fresh compile: cost the new variant unless its plan key
+        is already costed (re-compiles of a known shape are donation/
+        cache churn, not new variants)."""
+        from .observatory import MAX_PLAN_KEYS, _shape_key
+        key = (kernel, _shape_key(args))
+        with self._lock:
+            if key in self.rows:
+                return
+            # bound memory like the observatory's plan histograms: past
+            # the cap new variants fold into the overflow row
+            if sum(1 for k, _p in self.rows if k == kernel) \
+                    >= MAX_PLAN_KEYS:
+                key = (kernel, "~other")
+                if key in self.rows:
+                    return
+            self.rows[key] = None          # claim under the lock
+        flops, nbytes = xla_cost(fn, args, kw)
+        source = "xla"
+        if flops <= 0.0 and nbytes <= 0.0:
+            flops, nbytes = host_estimate(kernel, args)
+            source = "host"
+        with self._lock:
+            self.rows[key] = {"flops": flops, "bytes": nbytes,
+                              "source": source}
+
+    def kernel_rows(self, kernel: str) -> dict:
+        """{plan_key: row} for one kernel (completed rows only)."""
+        with self._lock:
+            return {plan: dict(row) for (k, plan), row in self.rows.items()
+                    if k == kernel and row is not None}
+
+    def covered(self) -> set:
+        """Kernels with at least one completed cost row."""
+        with self._lock:
+            return {k for (k, _p), row in self.rows.items()
+                    if row is not None}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.rows.clear()

@@ -519,6 +519,17 @@ class WorkloadRunner:
             shard = sched.observatory.shard_profile()
             if shard:
                 extras["shard_lanes"] = shard
+        if getattr(sched, "critical_path_enabled", False):
+            # critical-path headroom block (ISSUE 20): fold the run's
+            # per-drain verdicts (this scheduler is fresh per run, so the
+            # flight ring is exactly this run's last <=256 drains) into
+            # the verdict histogram + ceiling factor bench.py projects
+            # a pods/s ceiling from
+            from .critical_path import aggregate
+            cp = aggregate(d.get("criticalPath")
+                           for d in sched.flight.dump())
+            if cp.get("drains"):
+                extras["critical_path"] = cp
         prof = getattr(sched, "profiler", None)
         if prof is not None and prof.sample_count:
             # hottest host frames of the run (continuous profiler): the

@@ -169,7 +169,12 @@ class CompileLedger:
                 rec.donation_misses += 1
         # per-dispatch run-time attribution (perf/observatory.py): the
         # observatory decides itself whether its gate is on
-        _observatory().on_call(kernel, t0, dt, delta > 0, args)
+        obs = _observatory()
+        obs.on_call(kernel, t0, dt, delta > 0, args)
+        if delta > 0:
+            # a fresh executable was minted: cost the new variant
+            # (perf/costmodel.py — trace+lower only, once per plan key)
+            obs.on_compile(kernel, fn, args, kw)
         return out
 
     def wrap(self, kernel: str, fn):

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 
+from .costmodel import CostModel, classify, modeled_seconds
 from .ledger import KERNELS
 
 # log2-spaced bucket edges, 1µs .. ~67s: edge[i] = 1e-6 * 2**i. A
@@ -190,15 +191,30 @@ class KernelObservatory:
         self._backend = ""
         self._shard_profile: dict = {}
         self._tl = threading.local()
+        # device cost model (perf/costmodel.py, ISSUE 20): per-variant
+        # flops/bytes rows, filled on compile events. Gated separately
+        # (`CriticalPathObservatory`) so the run-time histograms keep
+        # working with the cost model off.
+        self.costs = CostModel()
+        self._cost_enabled = True
 
     # -- gate -----------------------------------------------------------------
 
     def enable(self, on: bool = True) -> None:
         self._enabled = bool(on)
 
+    def enable_cost_model(self, on: bool = True) -> None:
+        """`CriticalPathObservatory` gate hook (scheduler ctor): the
+        most recently constructed Scheduler wins, like `enable`."""
+        self._cost_enabled = bool(on)
+
     @property
     def enabled(self) -> bool:
         return self._enabled
+
+    @property
+    def cost_model_enabled(self) -> bool:
+        return self._enabled and self._cost_enabled
 
     # -- capture --------------------------------------------------------------
 
@@ -234,6 +250,14 @@ class KernelObservatory:
         events = getattr(self._tl, "events", None)
         if events is not None:
             events.append((kernel, start, seconds, compiled))
+
+    def on_compile(self, kernel: str, fn, args: tuple, kw: dict) -> None:
+        """A fresh compile, reported by `CompileLedger.measured_call`
+        (cache-size delta > 0): cost the new variant. Once per plan key;
+        tracing+lowering only — never a second XLA compile."""
+        if not (self._enabled and self._cost_enabled):
+            return
+        self.costs.record_compile(kernel, fn, args, kw)
 
     # -- per-drain device lane ------------------------------------------------
 
@@ -286,25 +310,82 @@ class KernelObservatory:
 
     # -- reporting ------------------------------------------------------------
 
+    def _cost_table(self, name: str, st: _KernelStats, backend: str,
+                    comms_share: float) -> list:
+        """One kernel's cost-model rows (perf/costmodel.py), joined with
+        the plan histograms' measured warm p50 for the achieved-vs-
+        modeled fraction. Caller holds self._lock (the cost model's own
+        lock nests inside — no reverse path exists)."""
+        rows = []
+        for plan, row in sorted(self.costs.kernel_rows(name).items(),
+                                key=repr):
+            flops = float(row["flops"])
+            nbytes = float(row["bytes"])
+            h = st.plans.get(plan)
+            measured = (h.quantile(0.50)
+                        if h is not None and h.count else 0.0)
+            model_s = modeled_seconds(flops, nbytes, backend)
+            rows.append({
+                "plan": str(plan),
+                "flops": flops,
+                "bytes": nbytes,
+                # arithmetic intensity (flops/byte) — the roofline x-axis
+                "ai": round(flops / nbytes, 4) if nbytes > 0 else 0.0,
+                "modeledMs": round(model_s * 1e3, 4),
+                "measuredP50Ms": round(measured * 1e3, 4),
+                # modeled/measured: the fraction of the backend roofline
+                # this variant achieves (0.0 until a warm call lands)
+                "achievedFraction": (round(model_s / measured, 4)
+                                     if measured > 0 and model_s > 0
+                                     else 0.0),
+                "bound": classify(flops, nbytes, backend,
+                                  comms_share=comms_share),
+                "source": row["source"],
+            })
+        return rows
+
+    def cost_view(self) -> dict:
+        """{kernel: [cost rows]} for every kernel with at least one
+        costed variant — tools/kernel_sweep.py's roofline annotation and
+        the /debug/kernels costModel field share this."""
+        backend = self.backend()
+        out = {}
+        with self._lock:
+            shard_comms = float(self._shard_profile.get("commsShare",
+                                                        0.0) or 0.0)
+            for name, st in self.kernels.items():
+                comms = shard_comms if name.endswith("_sharded") else 0.0
+                rows = self._cost_table(name, st, backend, comms)
+                if rows:
+                    out[name] = rows
+        return out
+
     def snapshot(self, top_plans: int = 5) -> dict:
         """/debug/kernels payload: per-kernel run-time table (all
         thirteen pre-seeded entries, zeros before the first dispatch),
-        the top-N per-plan variants by cumulative seconds, and the
-        latest sharded-lane profile."""
+        the top-N per-plan variants by cumulative seconds, each
+        variant's cost-model rows, and the latest sharded-lane
+        profile."""
+        backend = self.backend()
         with self._lock:
+            shard = dict(self._shard_profile)
+            shard_comms = float(shard.get("commsShare", 0.0) or 0.0)
             kernels = {}
             for name in sorted(self.kernels):
                 st = self.kernels[name]
                 top = sorted(st.plans.items(),
                              key=lambda kv: kv[1].sum, reverse=True)
+                comms = shard_comms if name.endswith("_sharded") else 0.0
                 kernels[name] = st.hist.to_dict() | {
                     "dispatches": st.dispatches,
                     "compileCalls": st.compile_calls,
                     "plans": {str(k): h.to_dict()
                               for k, h in top[:top_plans]},
+                    "costModel": self._cost_table(name, st, backend,
+                                                  comms),
                 }
-            shard = dict(self._shard_profile)
-        return {"enabled": self._enabled, "backend": self.backend(),
+        return {"enabled": self._enabled, "backend": backend,
+                "costModelEnabled": self._cost_enabled,
                 "kernels": kernels, "shardLanes": shard}
 
     def metrics_view(self) -> tuple:
@@ -353,6 +434,7 @@ class KernelObservatory:
         with self._lock:
             self.kernels = {k: _KernelStats() for k in KERNELS}
             self._shard_profile = {}
+        self.costs.reset()
 
 
 GLOBAL = KernelObservatory()

@@ -102,6 +102,12 @@ class StreamingPipeline:
         # per-stage busy walls (each key written by exactly one thread)
         self._busy = {s: 0.0 for s in STAGES}
         self._backpressure = {s: 0 for s in STAGES}
+        # measured wall actually spent in backpressure waits, per stalled
+        # stage (ISSUE 20): each `_backpressure` increment brackets one
+        # bounded `_work.wait`, so stall seconds <= count * poll_s * 10;
+        # the critical-path extractor attributes the delta across a
+        # drain's dispatch->commit window to its `backpressure` cause
+        self._stall_s = {s: 0.0 for s in STAGES}
         self._close_reasons = {"full": 0, "idle": 0, "budget": 0,
                                "feed": 0}
         self._batches = 0
@@ -131,6 +137,10 @@ class StreamingPipeline:
         if self.gc_pause:
             self._stack.enter_context(scheduling_gc_pause())
         self.sched.pipeline = self
+        # critical-path attribution baseline (scheduler.py): stall
+        # seconds are attributed drain-by-drain as deltas against the
+        # last committed checkpoint; a fresh pipeline starts the clock
+        self.sched._bp_stall_committed = 0.0
         for name, target in (("pipeline-ingest", self._ingest_loop),
                              ("pipeline-commit", self._commit_loop)):
             t = threading.Thread(target=target, name=name, daemon=True)
@@ -239,12 +249,16 @@ class StreamingPipeline:
             if len(sched._pending) >= self.dispatch_depth:
                 # dispatch depth caps ingest
                 self._backpressure["ingest"] += 1
+                t0 = time.perf_counter()
                 self._work.wait(timeout=self.poll_s * 10)
+                self._stall_s["ingest"] += time.perf_counter() - t0
                 continue
             if len(sched.dispatcher) >= self.commit_backlog_pods:
                 # commit backlog caps dispatch
                 self._backpressure["device"] += 1
+                t0 = time.perf_counter()
                 self._work.wait(timeout=self.poll_s * 10)
+                self._stall_s["device"] += time.perf_counter() - t0
                 continue
             break
         if self._stop:
@@ -303,7 +317,9 @@ class StreamingPipeline:
                 if not self._lock.acquire(blocking=False):
                     # ingest holds the host: commit is the stalled stage
                     self._backpressure["commit"] += 1
+                    t0 = time.perf_counter()
                     self._lock.acquire()
+                    self._stall_s["commit"] += time.perf_counter() - t0
                 try:
                     t0 = time.perf_counter()
                     if sched._pending and sched._pending[0] is head:
@@ -364,6 +380,13 @@ class StreamingPipeline:
             m.pipeline_backpressure._values[(stage,)] = float(
                 self._backpressure[stage])
 
+    def backpressure_stall_seconds(self) -> float:
+        """Total measured wall spent in backpressure waits across all
+        stages — monotonic while the pipeline runs. The scheduler diffs
+        this across each drain's commit to attribute stall seconds to
+        the drain's `backpressure` critical-path cause."""
+        return sum(self._stall_s.values())
+
     def stall_seconds(self) -> float:
         """Age of the last forward progress (dispatched batch or
         committed drain) while work is queued; 0.0 when the pipeline is
@@ -379,19 +402,27 @@ class StreamingPipeline:
 
     def stats(self) -> dict:
         """The /debug/pipeline occupancy block."""
+        # stage-share math is shared with bench.py's phase_pct/host_share
+        # summary (perf/critical_path.py phase_shares — the ISSUE 20
+        # bugfix: both surfaces must agree on the same window)
+        from .perf.critical_path import phase_shares
         self.publish_metrics()
         wall = ((self._stopped_at or time.perf_counter())
                 - self._started_at) if self._started_at else 0.0
-        busy_sum = sum(self._busy.values())
+        shares = phase_shares(self._busy, wall=wall)
+        busy_sum = shares["total"]
         return {
             "running": self._started and not self._stop,
             "wallSeconds": round(wall, 6),
             "busySeconds": {s: round(v, 6) for s, v in self._busy.items()},
             "busySum": round(busy_sum, 6),
+            "busyShares": shares["shares"],
             # >1.0 == measured stage overlap (the acceptance gate reads
             # this: sum of per-stage busy seconds vs wall)
-            "occupancy": round(busy_sum / wall, 4) if wall > 0 else 0.0,
+            "occupancy": shares["occupancy"] if wall > 0 else 0.0,
             "backpressure": dict(self._backpressure),
+            "backpressureStallSeconds": {
+                s: round(v, 6) for s, v in self._stall_s.items()},
             "stallSeconds": round(self.stall_seconds(), 6),
             "batchClose": dict(self._close_reasons),
             "batches": self._batches,

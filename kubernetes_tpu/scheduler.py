@@ -544,6 +544,18 @@ class Scheduler:
         self.observatory = _observatory
         self.observatory.enable(
             self.feature_gates.enabled("KernelObservatory"))
+        # critical-path observatory (perf/critical_path.py,
+        # `CriticalPathObservatory` gate): per-drain bottleneck verdicts
+        # stamped at commit, plus the device cost model fed by compile
+        # events (perf/costmodel.py via the observatory — process-global,
+        # most recent Scheduler's gate wins like the rails/observatory)
+        self.critical_path_enabled = self.feature_gates.enabled(
+            "CriticalPathObservatory")
+        self.observatory.enable_cost_model(self.critical_path_enabled)
+        # pipeline backpressure stall seconds already attributed to a
+        # committed drain's verdict (delta baseline; StreamingPipeline
+        # .start() zeroes it when a fresh pipeline attaches)
+        self._bp_stall_committed = 0.0
         # sharded-lane profile (parallel/sharding.py profile_shard_lanes):
         # the first sharded dispatch stashes its inputs; the profile runs
         # ONCE after that drain commits (and on demand via
@@ -2838,6 +2850,45 @@ class Scheduler:
         finally:
             self.events.current_drain = 0
 
+    def _backpressure_stall_delta(self) -> float:
+        """Pipeline stall seconds not yet attributed to a committed
+        drain: the monotonic stall total minus the checkpoint the last
+        commit left. Every stall second lands on exactly ONE drain (the
+        next to commit), so the per-cause metric sums stay conserved.
+        0.0 in lock-step operation — no pipeline, no backpressure."""
+        pipe = self.pipeline
+        if pipe is None:
+            return 0.0
+        total = pipe.backpressure_stall_seconds()
+        delta = total - self._bp_stall_committed
+        self._bp_stall_committed = total
+        return max(delta, 0.0)
+
+    def _critical_path_verdict(self, pd: "_PendingDrain") -> dict:
+        """Per-drain bottleneck attribution (perf/critical_path.py,
+        ISSUE 20), computed at commit when every segment of the drain's
+        wall is known: host_build and its children, device_dispatch with
+        the sharded lane profile's comms split, the readback wait, the
+        commit tail, and the pipeline's backpressure stall delta. The
+        verdict rides the FlightRecord and the two
+        scheduler_critical_path_* families. {} with the gate off."""
+        if not self.critical_path_enabled:
+            return {}
+        from .perf.critical_path import attribute_drain
+        comms = 0.0
+        if self.mesh is not None:
+            comms = float((self.observatory.shard_profile() or {}).get(
+                "commsShare", 0.0) or 0.0)
+        cp = attribute_drain(pd.phases, kernels=pd.kernels,
+                             comms_share=comms,
+                             backpressure_s=self._backpressure_stall_delta())
+        m = self.metrics
+        for cause, secs in cp["causes"].items():
+            if secs > 0.0:
+                m.critical_path_seconds.inc(cause, by=secs)
+        m.bottleneck_drains.inc(cp["verdict"])
+        return cp
+
     def _commit_assignments_inner(self, pd: _PendingDrain, out) -> int:
         t_commit = _time.perf_counter()
         qpis = pd.qpis
@@ -2951,6 +3002,7 @@ class Scheduler:
                 # the flight entry — "slow drain 17" answers itself
                 hot = tuple(self.profiler.top_frames(
                     5, seconds=max(total_s, 1.0) + 1.0))
+        cp = self._critical_path_verdict(pd)
         frec = self.flight.record(
             profile=profile.name, pods=n, bound=bound,
             failed=len(failures),
@@ -2964,7 +3016,8 @@ class Scheduler:
             events={"Scheduled": bound,
                     "FailedScheduling": len(failures)},
             drain_id=pd.drain_id, hot_frames=hot, probe=probe_snap,
-            kernels=dict(pd.kernels), shard=tuple(self.shard_ids))
+            kernels=dict(pd.kernels), shard=tuple(self.shard_ids),
+            critical_path=cp)
         if pd.audit is not None:
             # hand the committed decisions to the shadow-audit worker;
             # the replay + diff run off the hot path

@@ -43,7 +43,11 @@ in-process model:
   telemetry ring over all SLIs + probe outputs) and
   /debug/kernels?plans=N&lanes=refresh (the kernel observatory:
   per-kernel run-wall histograms keyed by plan/shape signature, compile
-  splits, the sharded-lane profile — ?lanes=refresh re-probes).
+  splits, the sharded-lane profile — ?lanes=refresh re-probes) and
+  /debug/criticalpath?limit=N (the critical-path observatory: the last-N
+  committed drains' bottleneck verdicts with per-cause seconds and
+  binding chains, plus the window aggregate — verdict histogram,
+  dominant cause, projected speedup ceiling).
 - Leader election moved to `kubernetes_tpu/ha/` (ISSUE 12): the Lease
   object lives in the API server (backend/apiserver.py, with generation
   fencing tokens), `LeaderElector` in ha/lease.py (renew deadlines,
@@ -83,6 +87,9 @@ DEBUG_ENDPOINTS = (
      "donation misses, h2d bytes"),
     ("/debug/kernels", "kernel observatory snapshot "
      "(?plans=N&lanes=refresh)"),
+    ("/debug/criticalpath", "per-drain critical-path verdicts + window "
+     "aggregate: bottleneck histogram, dominant cause, speedup ceiling "
+     "(?limit=N)"),
     ("/debug/audit", "shadow-oracle audit's hash-chained drain ledger "
      "(?limit=N&details=1)"),
     ("/debug/explain", "per-bind plugin-level score decomposition "
@@ -229,6 +236,28 @@ class SchedulerServer:
                     self._send(200, json.dumps(obs.snapshot(
                         top_plans=int(q.get("plans", "5"))),
                         indent=2), "application/json")
+                elif self.path.startswith("/debug/criticalpath"):
+                    sched = outer.scheduler
+                    if not getattr(sched, "critical_path_enabled", False):
+                        self._send(404, "critical path observatory off "
+                                        "(CriticalPathObservatory gate)")
+                        return
+                    from .perf.critical_path import aggregate
+                    q = self._query()
+                    limit = int(q.get("limit", "32"))
+                    rows = [
+                        {"seq": d["seq"], "drainId": d["drainId"],
+                         "pods": d["pods"], "profile": d["profile"],
+                         "criticalPath": d["criticalPath"]}
+                        for d in sched.flight.dump()
+                        if d.get("criticalPath")]
+                    if limit and len(rows) > limit:
+                        rows = rows[-limit:]
+                    self._send(200, json.dumps({
+                        "drains": rows,
+                        "aggregate": aggregate(
+                            r["criticalPath"] for r in rows),
+                    }, indent=2), "application/json")
                 elif self.path.startswith("/debug/audit"):
                     audit = getattr(outer.scheduler, "audit", None)
                     if audit is None:
@@ -376,6 +405,8 @@ class SchedulerServer:
         return {
             "/debug/hostprofile": getattr(s, "profiler", None) is not None,
             "/debug/kernels": s.observatory.enabled,
+            "/debug/criticalpath": getattr(s, "critical_path_enabled",
+                                           False),
             "/debug/audit": getattr(s, "audit", None) is not None,
             "/debug/pod": s.journey.enabled,
             "/debug/pipeline": getattr(s, "pipeline", None) is not None,

@@ -500,6 +500,26 @@ class TestExpositionLint:
         assert all(v == 0.0
                    for _l, v in series["scheduler_incidents_total"])
 
+    def test_issue20_families_covered_by_lint(self):
+        """ISSUE 20 satellite: the critical-path families are registered
+        AND pre-seeded with the EXACT cause taxonomy the verdicts emit
+        and bench_metrics.prom keys on — dashboards can rate() both
+        before the first drain commits."""
+        from kubernetes_tpu.perf.critical_path import CAUSES
+        m = SchedulerMetrics()
+        series, helps, types = _parse_exposition(m.exposition())
+        assert types["scheduler_critical_path_seconds"] == "counter"
+        assert types["scheduler_bottleneck_drains_total"] == "counter"
+        for fam in ("scheduler_critical_path_seconds",
+                    "scheduler_bottleneck_drains_total"):
+            causes = {lbl["cause"] for lbl, _v in series[fam]}
+            assert causes == set(CAUSES), fam
+            # zero-seeded: every cause series present before any verdict
+            assert all(v == 0.0 for _l, v in series[fam]), fam
+        assert set(CAUSES) == {"host_build", "device_compute",
+                               "device_comms", "commit", "backpressure",
+                               "idle"}
+
 
 class TestSchedulerMetrics:
     def test_series_move_during_scheduling(self):

@@ -57,13 +57,23 @@ Workloads present on only one side are reported but never fail (the case
 set grows over time); the `Sharded_` CPU-mesh probe is excluded — it is
 compile evidence, not a throughput contract. Since r19 the bench payload
 carries an `env` fingerprint (cpu model/count, python/jax/numpy
-versions, JAX_PLATFORMS): when BOTH sides carry one and they differ,
-THROUGHPUT failures are downgraded to warnings — numbers measured on
-different silicon are not an A/B — while every correctness/latency-ratio
-gate (SLO, divergence, double-bind, p99 growth ratios) stays strict.
-Same-fingerprint (same-container) comparisons are unchanged. `--check` is also wired in
-as a `slow`-marked pytest (tests/test_bench_compare.py), so CI enforces
-the trajectory instead of trusting the changelog.
+versions, JAX_PLATFORMS — and since r20 the resolved accelerator:
+jax backend, device kind, device count): when BOTH sides carry one and
+they differ, THROUGHPUT failures are downgraded to warnings — numbers
+measured on different silicon are not an A/B — while every
+correctness/latency-ratio gate (SLO, divergence, double-bind, p99 growth
+ratios) stays strict. Same-fingerprint (same-container) comparisons are
+unchanged. `--check` is also wired in as a `slow`-marked pytest
+(tests/test_bench_compare.py), so CI enforces the trajectory instead of
+trusting the changelog.
+
+`--attribute` (ISSUE 20) adds differential attribution: for every shared
+workload carrying a `critical_path` summary block on both sides, the
+throughput delta is explained by the cause whose per-drain seconds moved
+most ("SchedulingBasic dropped 8%" -> "commit seconds grew 2.1x") —
+informational lines, never gates. `--attribute-self-test` verifies the
+mode against a synthetic slowed-commit A/B and exits 2 unless it names
+'commit'.
 """
 
 from __future__ import annotations
@@ -226,7 +236,11 @@ def fingerprint_mismatch(base_env: dict, new_env: dict) -> list:
     stays strict rather than silently waiving the throughput gate."""
     if not base_env or not new_env:
         return []
-    fields = ("cpu_model", "cpu_count", "versions", "jax_platforms")
+    # `accelerator` (ISSUE 20 satellite): the RESOLVED jax backend +
+    # device kind/count — a GPU-vs-CPU (or 1-vs-8-device) pair is not an
+    # A/B even when JAX_PLATFORMS and the cpu model agree
+    fields = ("cpu_model", "cpu_count", "versions", "jax_platforms",
+              "accelerator")
     return [f for f in fields if base_env.get(f) != new_env.get(f)]
 
 
@@ -392,6 +406,61 @@ def compare(base: dict, new: dict) -> tuple[list, list]:
     return failures, report
 
 
+def attribution_lines(base: dict, new: dict) -> list:
+    """--attribute (ISSUE 20): explain each shared workload's throughput
+    delta by the critical-path cause whose PER-DRAIN seconds moved most
+    (perf/critical_path.attribute_delta over the summary blocks) —
+    "SchedulingBasic dropped 8%" becomes "commit seconds grew 2.1x".
+    Informational: the THROUGHPUT gate decides pass/fail; this answers
+    the reviewer's 'why'. Workloads lacking a critical_path block on
+    either side (pre-r20 baselines) are skipped."""
+    sys.path.insert(0, REPO)
+    from kubernetes_tpu.perf.critical_path import attribute_delta
+    lines: list[str] = []
+    for w in sorted(set(base) & set(new)):
+        if w.startswith(SKIP_PREFIXES):
+            continue
+        b, n = base[w], new[w]
+        moved = attribute_delta(b.get("critical_path") or {},
+                                n.get("critical_path") or {})
+        if not moved:
+            continue
+        b_tp = float(b.get("pods_per_s") or 0.0)
+        n_tp = float(n.get("pods_per_s") or 0.0)
+        tp = (f"throughput {n_tp / b_tp - 1.0:+.1%}" if b_tp > 0
+              else "throughput n/a")
+        ratio = moved.get("ratio")
+        how = f"{ratio:.2f}x" if ratio else "new cause"
+        lines.append(
+            f"ATTRIBUTION {w}: {tp} <- {moved['cause']} per-drain "
+            f"seconds {moved['base_s'] * 1e3:.3f} -> "
+            f"{moved['new_s'] * 1e3:.3f} ms ({how})")
+    return lines
+
+
+def attribute_self_test() -> int:
+    """--attribute-self-test: a synthetic A/B whose candidate grew its
+    commit seconds 2.1x (with a throughput drop) MUST be attributed to
+    'commit'; anything else exits 2 — the mode proves itself before
+    anyone trusts it on a real regression."""
+    def wl(tp: float, commit_s: float) -> dict:
+        return {"pods_per_s": tp, "critical_path": {
+            "drains": 10,
+            "causes": {"host_build": 0.8, "device_compute": 1.2,
+                       "device_comms": 0.0, "commit": commit_s,
+                       "backpressure": 0.0, "idle": 0.3}}}
+    base = {"SchedulingBasic_5000Nodes_10000Pods": wl(5000.0, 1.0)}
+    new = {"SchedulingBasic_5000Nodes_10000Pods": wl(4600.0, 2.1)}
+    lines = attribution_lines(base, new)
+    ok = bool(lines) and "<- commit per-drain" in lines[0]
+    for line in lines:
+        print(f"  {line}")
+    print("attribute self-test:",
+          "OK" if ok else
+          "FAIL (expected the synthetically slowed commit to be named)")
+    return 0 if ok else 2
+
+
 def run_fresh_bench(cases: str = "") -> dict:
     """Run bench.py in a subprocess; returns the raw payload."""
     cmd = [sys.executable, os.path.join(REPO, "bench.py")]
@@ -425,7 +494,20 @@ def main(argv=None) -> int:
                     help="also gate on the candidate's SLO block: fail "
                          "on any burn-rate breach or nonzero "
                          "shadow-oracle divergence (ISSUE 10)")
+    ap.add_argument("--attribute", action="store_true",
+                    help="differential attribution (ISSUE 20): explain "
+                         "each workload's throughput delta by the "
+                         "critical-path cause whose per-drain seconds "
+                         "moved most")
+    ap.add_argument("--attribute-self-test", action="store_true",
+                    dest="attribute_self_test",
+                    help="verify the attribution mode on a synthetic "
+                         "slowed-commit A/B (exit 2 unless it names "
+                         "'commit')")
     args = ap.parse_args(argv)
+
+    if args.attribute_self_test:
+        return attribute_self_test()
 
     trail = bench_files()
     if args.check:
@@ -480,6 +562,10 @@ def main(argv=None) -> int:
         slo_fails = slo_failures(new)
         failures.extend(slo_fails)
         report.append(f"SLO gate: {len(slo_fails)} failure(s)")
+    if args.attribute:
+        report.extend(attribution_lines(base, new) or
+                      ["ATTRIBUTION: no shared workload carries a "
+                       "critical_path block on both sides"])
     for line in report:
         print(f"  {line}")
     if failures:

@@ -10,7 +10,10 @@ Exit codes: 0 = no unwaived findings; 1 = findings; 2 = configuration
 error (a declared JIT entry point no longer reaches a jitted function —
 the lint silently lost device-path coverage — or is missing from the
 kernel observatory's ENTRY_KERNELS map, so its dispatches would go
-unmeasured, or the streaming pipeline grew a dispatch path that
+unmeasured, or its kernel has no device cost-model entry
+(perf/costmodel.py KERNEL_COSTS), so its observatory rows would carry
+no compute/memory/comms-bound classification — `cost_model_gaps` — or
+the streaming pipeline grew a dispatch path that
 bypasses the measured_call/observatory seams — `pipeline_stages` — or a
 telemetry surface lost coverage: a registered metric family without a
 pre-seeded sample / bench-archive TYPE line, or a journey event/cause
@@ -71,6 +74,30 @@ def observatory_gaps(entry_points=None) -> list:
             elif kernel not in KERNELS:
                 gaps.append(f"{mod}.{name} (maps to unknown kernel "
                             f"{kernel!r})")
+    return gaps
+
+
+def cost_model_gaps(entry_points=None) -> list:
+    """ISSUE 20 `cost_model_gaps` check: every jaxsan ENTRY_POINT must
+    resolve to a kernel with a host-estimator cost entry
+    (perf/costmodel.py KERNEL_COSTS) — a new JIT entry cannot land
+    without a flops/bytes model, or its observatory rows would carry no
+    bound classification when XLA's cost_analysis is unavailable.
+    Mirrors `observatory_gaps`. Returns ["mod.fn (reason)", ...];
+    empty = covered."""
+    from kubernetes_tpu.analysis.jaxsan import ENTRY_POINTS
+    from kubernetes_tpu.perf.costmodel import KERNEL_COSTS
+    from kubernetes_tpu.perf.observatory import ENTRY_KERNELS
+
+    gaps: list[str] = []
+    for mod, names in (entry_points or ENTRY_POINTS).items():
+        for name in names:
+            kernel = ENTRY_KERNELS.get(name)
+            if kernel is None:
+                continue     # observatory_gaps already reports this
+            if kernel not in KERNEL_COSTS:
+                gaps.append(f"{mod}.{name} (kernel {kernel!r} has no "
+                            "perf/costmodel.py KERNEL_COSTS entry)")
     return gaps
 
 
@@ -245,6 +272,7 @@ def main(argv=None) -> int:
     # points; an ad-hoc --entries override lints someone else's tree,
     # whose functions have no business in ENTRY_KERNELS
     obs_gaps = [] if entry_points is not None else observatory_gaps()
+    cost_gaps = [] if entry_points is not None else cost_model_gaps()
     pipe_gaps = [] if entry_points is not None else pipeline_stage_gaps()
     cov_gaps = [] if entry_points is not None else obs_coverage()
 
@@ -254,6 +282,7 @@ def main(argv=None) -> int:
             "waived": [f.to_dict() for f in waived],
             "missingEntries": an.missing_entries,
             "observatoryGaps": obs_gaps,
+            "costModelGaps": cost_gaps,
             "pipelineStageGaps": pipe_gaps,
             "obsCoverageGaps": cov_gaps,
             "modules": len(an.modules),
@@ -279,6 +308,11 @@ def main(argv=None) -> int:
         print("jaxsan: CONFIG ERROR — entries invisible to the kernel "
               "observatory (perf/observatory.py ENTRY_KERNELS): "
               + ", ".join(obs_gaps), file=sys.stderr)
+        return 2
+    if cost_gaps:
+        print("jaxsan: CONFIG ERROR — cost_model_gaps: entries without "
+              "a device cost-model entry (perf/costmodel.py "
+              "KERNEL_COSTS): " + ", ".join(cost_gaps), file=sys.stderr)
         return 2
     if pipe_gaps:
         print("jaxsan: CONFIG ERROR — pipeline_stages: a dispatch path "

@@ -38,8 +38,13 @@ Usage:
 Output: one JSON object keyed by backend →
 {backend, nodes, pods, knobs: {name: {default, points: [{value,
 pods_per_s, wall_s, kernels: {kernel: {calls, seconds, p50_ms,
-p99_ms}}}]}}}. CPU numbers rank RELATIVE cost only; re-run on the TPU
-backend for absolute tables.
+p99_ms}}, cost_model: {kernel: [{plan, flops, bytes, ai, modeledMs,
+measuredP50Ms, achievedFraction, bound, source}]}}]}}}. The cost_model
+rows (ISSUE 20, perf/costmodel.py) carry XLA cost_analysis-derived
+flops/bytes, arithmetic intensity and the achieved-vs-modeled fraction
+per compiled plan variant, so a sweep point ranks against the roofline,
+not only against its neighbors. CPU numbers rank RELATIVE cost only;
+re-run on the TPU backend for absolute tables.
 """
 
 from __future__ import annotations
@@ -158,12 +163,19 @@ def run_point(knob: str, value, nodes: int, pods: int) -> dict:
     t0 = time.perf_counter()
     bound = sched.schedule_pending()
     wall = time.perf_counter() - t0
+    kernels = obs.delta_since(chk)
+    # device cost-model rows for the kernels THIS point dispatched
+    # (ISSUE 20): flops/bytes/arithmetic-intensity + achieved-vs-modeled
+    # fraction per plan variant — the autotuner ranks measured seconds
+    # against the roofline instead of only against other sweep points
+    cost = {k: rows for k, rows in obs.cost_view().items() if k in kernels}
     return {
         "value": value,
         "bound": int(bound),
         "wall_s": round(wall, 4),
         "pods_per_s": round(bound / wall, 1) if wall > 0 else 0.0,
-        "kernels": obs.delta_since(chk),
+        "kernels": kernels,
+        "cost_model": cost,
     }
 
 
@@ -203,6 +215,15 @@ def self_test() -> int:
             # the drain must have dispatched SOMETHING measurable
             assert sum(k.get("dispatches", 0)
                        for k in p["kernels"].values()) > 0, (knob, p)
+            # cost-model contract (ISSUE 20): every dispatched kernel's
+            # rows carry the roofline fields
+            assert isinstance(p["cost_model"], dict)
+            assert p["cost_model"], (knob, p["kernels"].keys())
+            for kern, rows in p["cost_model"].items():
+                for row in rows:
+                    for fld in ("flops", "bytes", "ai",
+                                "achievedFraction", "bound", "source"):
+                        assert fld in row, (knob, kern, fld, row)
     print("kernel_sweep self-test: OK "
           f"({len(table['knobs'])} knobs x 2 points, "
           f"backend={table['backend']})")
